@@ -1,0 +1,15 @@
+"""automerge_tpu: a TPU-native CRDT framework with Automerge's capabilities.
+
+A JSON-like document (nested maps / lists / text / counters) that any number
+of actors mutate independently and merge deterministically, with a
+byte-compatible columnar storage format and Bloom-filter sync protocol —
+re-architected for TPU: op logs live as columnar JAX device arrays and N-way
+replica merge runs as batched kernels (segmented Lamport sort + pred/succ
+resolution + visibility masking).
+
+Reference behavior: aasthaagarwal2003/automerge (see SURVEY.md).
+"""
+
+__version__ = "0.1.0"
+
+from .types import ActorId, Action, ObjType, ScalarValue  # noqa: F401

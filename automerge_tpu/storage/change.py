@@ -1,0 +1,373 @@
+"""Change chunk encode/decode.
+
+Byte-compatible with the reference (reference:
+rust/automerge/src/storage/change.rs, change/change_op_columns.rs,
+change/change_actors.rs). Chunk body layout:
+
+    ULEB num_deps, then 32-byte change hashes (sorted)
+    ULEB actor byte length + actor bytes
+    ULEB seq
+    ULEB start_op
+    SLEB timestamp
+    ULEB message byte length + message utf8
+    ULEB num_other_actors, each ULEB length-prefixed
+    column metadata (see columns.py)
+    op column data
+    extra bytes
+
+Actor indices inside op columns are chunk-local: index 0 is the change author,
+indices 1.. are the other actors in lexicographic byte order. Op columns (by
+spec): obj actor/counter (1, 2), key actor/counter/string (17, 19, 21),
+insert (52), action (66), value meta/raw (86, 87), pred group/actor/counter
+(112, 113, 115), expand (148), mark name (165).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+from ..types import HEAD, Key, OpId, ScalarValue, is_head, is_root
+from ..utils.codecs import (
+    BooleanEncoder,
+    DeltaEncoder,
+    MaybeBooleanEncoder,
+    RleEncoder,
+    boolean_decode,
+    delta_decode,
+    rle_decode,
+)
+from ..utils.leb128 import decode_sleb, decode_uleb, encode_sleb, encode_uleb
+from . import columns as C
+from .chunk import CHUNK_CHANGE, chunk_hash, parse_chunk, write_chunk
+from .values import ValueEncoder, decode_values
+
+# Normalized column specs for change op columns
+COL_OBJ_ACTOR = C.spec(0, C.TYPE_ACTOR)  # 1
+COL_OBJ_CTR = C.spec(0, C.TYPE_INTEGER)  # 2
+COL_KEY_ACTOR = C.spec(1, C.TYPE_ACTOR)  # 17
+COL_KEY_CTR = C.spec(1, C.TYPE_DELTA)  # 19
+COL_KEY_STR = C.spec(1, C.TYPE_STRING)  # 21
+COL_INSERT = C.spec(3, C.TYPE_BOOLEAN)  # 52
+COL_ACTION = C.spec(4, C.TYPE_INTEGER)  # 66
+COL_VAL_META = C.spec(5, C.TYPE_VALUE_META)  # 86
+COL_VAL_RAW = C.spec(5, C.TYPE_VALUE)  # 87
+COL_PRED_GROUP = C.spec(7, C.TYPE_GROUP)  # 112
+COL_PRED_ACTOR = C.spec(7, C.TYPE_ACTOR)  # 113
+COL_PRED_CTR = C.spec(7, C.TYPE_DELTA)  # 115
+COL_EXPAND = C.spec(9, C.TYPE_BOOLEAN)  # 148
+COL_MARK_NAME = C.spec(10, C.TYPE_STRING)  # 165
+
+
+@dataclass
+class ChangeOp:
+    """One op as stored in a change chunk.
+
+    ``obj``/``key.elem``/``pred`` op ids carry chunk-local actor indices.
+    obj == ROOT is represented as (0, -1) here to distinguish "root" from
+    "op of actor 0"; elem HEAD is (0, -1) likewise.
+    """
+
+    obj: OpId
+    key: Key
+    insert: bool
+    action: int
+    value: ScalarValue
+    pred: List[OpId] = field(default_factory=list)
+    expand: bool = False
+    mark_name: Optional[str] = None
+
+
+ROOT_STORED: OpId = (0, -1)
+HEAD_STORED: OpId = (0, -1)
+
+
+@dataclass
+class StoredChange:
+    """A parsed or built change chunk."""
+
+    dependencies: List[bytes]
+    actor: bytes  # author actor id bytes
+    other_actors: List[bytes]
+    seq: int
+    start_op: int
+    timestamp: int
+    message: Optional[str]
+    ops: List[ChangeOp]
+    extra_bytes: bytes = b""
+    # Set when built/parsed:
+    hash: Optional[bytes] = None
+    raw_bytes: Optional[bytes] = None  # whole chunk incl. header
+
+    @property
+    def actors(self) -> List[bytes]:
+        """Chunk-local actor table: author first, then others sorted."""
+        return [self.actor, *self.other_actors]
+
+    @property
+    def max_op(self) -> int:
+        return self.start_op + len(self.ops) - 1 if self.ops else self.start_op - 1
+
+
+def encode_change_ops(ops: Sequence[ChangeOp]) -> List[Tuple[int, bytes]]:
+    """Encode op columns; returns [(normalized spec, bytes)] in order."""
+    obj_actor = RleEncoder("uint")
+    obj_ctr = RleEncoder("uint")
+    key_actor = RleEncoder("uint")
+    key_ctr = DeltaEncoder()
+    key_str = RleEncoder("str")
+    insert = BooleanEncoder()
+    action = RleEncoder("uint")
+    val = ValueEncoder()
+    pred_num = RleEncoder("uint")
+    pred_actor = RleEncoder("uint")
+    pred_ctr = DeltaEncoder()
+    expand = MaybeBooleanEncoder()
+    mark_name = RleEncoder("str")
+
+    for op in ops:
+        # Root and HEAD are identified by counter 0 alone — both the public
+        # (0, 0) sentinels (types.ROOT/HEAD) and the storage-layer (0, -1)
+        # forms encode identically (no real op has counter 0).
+        if is_root(op.obj):
+            obj_actor.append_null()
+            obj_ctr.append_null()
+        else:
+            obj_actor.append_value(op.obj[1])
+            obj_ctr.append_value(op.obj[0])
+        if op.key.prop is not None:
+            key_actor.append_null()
+            key_ctr.append(None)
+            key_str.append_value(op.key.prop)
+        elif is_head(op.key.elem):
+            key_actor.append_null()
+            key_ctr.append(0)
+            key_str.append_null()
+        else:
+            key_actor.append_value(op.key.elem[1])
+            key_ctr.append(op.key.elem[0])
+            key_str.append_null()
+        insert.append(op.insert)
+        action.append_value(op.action)
+        val.append(op.value)
+        pred_num.append_value(len(op.pred))
+        for p in op.pred:
+            pred_actor.append_value(p[1])
+            pred_ctr.append(p[0])
+        expand.append(op.expand)
+        if op.mark_name is None:
+            mark_name.append_null()
+        else:
+            mark_name.append_value(op.mark_name)
+
+    val_meta, val_raw = val.finish()
+    return [
+        (COL_OBJ_ACTOR, obj_actor.finish()),
+        (COL_OBJ_CTR, obj_ctr.finish()),
+        (COL_KEY_ACTOR, key_actor.finish()),
+        (COL_KEY_CTR, key_ctr.finish()),
+        (COL_KEY_STR, key_str.finish()),
+        (COL_INSERT, insert.finish()),
+        (COL_ACTION, action.finish()),
+        (COL_VAL_META, val_meta),
+        (COL_VAL_RAW, val_raw),
+        (COL_PRED_GROUP, pred_num.finish()),
+        (COL_PRED_ACTOR, pred_actor.finish()),
+        (COL_PRED_CTR, pred_ctr.finish()),
+        (COL_EXPAND, expand.finish()),
+        (COL_MARK_NAME, mark_name.finish()),
+    ]
+
+
+def decode_change_ops(col_data: dict[int, bytes]) -> List[ChangeOp]:
+    """Decode op columns from a dict of normalized spec -> bytes."""
+
+    def col(s):
+        return col_data.get(s, b"")
+
+    # Row count is the longest primary column; every column must then cover
+    # (or legitimately null-fill) all n rows — truncation is a parse error.
+    actions = rle_decode(col(COL_ACTION), "uint")
+    key_str = rle_decode(col(COL_KEY_STR), "str")
+    key_ctr = delta_decode(col(COL_KEY_CTR))
+    n = max(len(actions), len(key_str), len(key_ctr))
+    insert = boolean_decode(col(COL_INSERT), n)
+    actions = _pad(actions, n)
+    obj_actor = _pad(rle_decode(col(COL_OBJ_ACTOR), "uint"), n)
+    obj_ctr = _pad(rle_decode(col(COL_OBJ_CTR), "uint"), n)
+    key_actor = _pad(rle_decode(col(COL_KEY_ACTOR), "uint"), n)
+    key_ctr = _pad(key_ctr, n)
+    key_str = _pad(key_str, n)
+    values = decode_values(col(COL_VAL_META), col(COL_VAL_RAW), n)
+    pred_num = _pad(rle_decode(col(COL_PRED_GROUP), "uint"), n)
+    total_preds = sum(p or 0 for p in pred_num)
+    pred_actor = rle_decode(col(COL_PRED_ACTOR), "uint", total_preds)
+    pred_ctr = delta_decode(col(COL_PRED_CTR), total_preds)
+    expand = boolean_decode(col(COL_EXPAND), n)
+    mark_name = _pad(rle_decode(col(COL_MARK_NAME), "str"), n)
+
+    ops: List[ChangeOp] = []
+    pi = 0
+    for i in range(n):
+        if actions[i] is None:
+            raise ValueError(f"op {i}: missing action")
+        obj = _decode_objid(obj_ctr[i], obj_actor[i], i)
+        if key_str[i] is not None:
+            key = Key.map(key_str[i])
+        elif key_ctr[i] == 0 and key_actor[i] is None:
+            key = Key.seq(HEAD_STORED)
+        elif key_ctr[i] is not None and key_actor[i] is not None:
+            key = Key.seq((key_ctr[i], key_actor[i]))
+        else:
+            raise ValueError(f"op {i}: neither map key nor elem id present")
+        np = pred_num[i] or 0
+        pred = []
+        for _ in range(np):
+            if pi >= len(pred_ctr) or pred_ctr[pi] is None or pred_actor[pi] is None:
+                raise ValueError(f"op {i}: truncated pred column")
+            pred.append((pred_ctr[pi], pred_actor[pi]))
+            pi += 1
+        ops.append(
+            ChangeOp(
+                obj=obj,
+                key=key,
+                insert=insert[i],
+                action=actions[i],
+                value=values[i],
+                pred=pred,
+                expand=expand[i],
+                mark_name=mark_name[i],
+            )
+        )
+    return ops
+
+
+def _decode_objid(ctr, actor, i: int) -> OpId:
+    """Decode an obj id column pair: both null = root, both set = op id."""
+    if ctr is None and actor is None:
+        return ROOT_STORED
+    if ctr is None or actor is None:
+        raise ValueError(f"op {i}: half-null object id")
+    return (ctr, actor)
+
+
+def _pad(lst: list, n: int) -> list:
+    if len(lst) < n:
+        lst.extend([None] * (n - len(lst)))
+    return lst
+
+
+def build_change(change: StoredChange) -> StoredChange:
+    """Encode ``change`` into chunk bytes, filling ``hash``/``raw_bytes``."""
+    data = bytearray()
+    deps = sorted(change.dependencies)
+    change.dependencies = deps
+    encode_uleb(len(deps), data)
+    for d in deps:
+        if len(d) != 32:
+            raise ValueError("change hash must be 32 bytes")
+        data += d
+    encode_uleb(len(change.actor), data)
+    data += change.actor
+    encode_uleb(change.seq, data)
+    if change.start_op < 1:
+        raise ValueError("start_op must be >= 1")
+    encode_uleb(change.start_op, data)
+    encode_sleb(change.timestamp, data)
+    msg = (change.message or "").encode("utf-8")
+    encode_uleb(len(msg), data)
+    data += msg
+    encode_uleb(len(change.other_actors), data)
+    for a in change.other_actors:
+        encode_uleb(len(a), data)
+        data += a
+    cols = encode_change_ops(change.ops)
+    C.write_columns(cols, data)
+    data += change.extra_bytes
+    raw = write_chunk(CHUNK_CHANGE, bytes(data))
+    change.hash = chunk_hash(CHUNK_CHANGE, bytes(data))
+    change.raw_bytes = raw
+    return change
+
+
+def parse_change_data(data: bytes, chunk_hash_: bytes, raw: bytes) -> StoredChange:
+    """Parse the body of a change chunk (after the chunk header)."""
+    pos = 0
+    ndeps, pos = decode_uleb(data, pos)
+    deps = []
+    for _ in range(ndeps):
+        if pos + 32 > len(data):
+            raise ValueError("truncated change deps")
+        deps.append(bytes(data[pos : pos + 32]))
+        pos += 32
+    alen, pos = decode_uleb(data, pos)
+    actor = bytes(data[pos : pos + alen])
+    if len(actor) != alen:
+        raise ValueError("truncated actor id")
+    pos += alen
+    seq, pos = decode_uleb(data, pos)
+    start_op, pos = decode_uleb(data, pos)
+    if start_op < 1:
+        raise ValueError("start_op must be >= 1")
+    if start_op > 0xFFFFFFFF:
+        raise ValueError("op counter too large")  # reference rejects > u32
+    timestamp, pos = decode_sleb(data, pos)
+    mlen, pos = decode_uleb(data, pos)
+    message = data[pos : pos + mlen].decode("utf-8")
+    pos += mlen
+    nother, pos = decode_uleb(data, pos)
+    others = []
+    for _ in range(nother):
+        olen, pos = decode_uleb(data, pos)
+        others.append(bytes(data[pos : pos + olen]))
+        pos += olen
+    metas, pos = C.parse_columns(data, pos)
+    for s, _ in metas:
+        if C.spec_deflate(s):
+            raise ValueError("change chunks must not contain compressed columns")
+    col_data = C.slice_column_data(data, metas, pos)
+    pos += C.total_column_len(metas)
+    extra = bytes(data[pos:])
+    ops = decode_change_ops(col_data)
+    n_actors = 1 + len(others)
+    for i, op in enumerate(ops):
+        _check_actor_bounds(op, i, n_actors)
+    return StoredChange(
+        dependencies=deps,
+        actor=actor,
+        other_actors=others,
+        seq=seq,
+        start_op=start_op,
+        timestamp=timestamp,
+        message=message or None,
+        ops=ops,
+        extra_bytes=extra,
+        hash=chunk_hash_,
+        raw_bytes=raw,
+    )
+
+
+def _check_actor_bounds(op: ChangeOp, i: int, n_actors: int) -> None:
+    refs = []
+    if op.obj != ROOT_STORED:
+        refs.append(op.obj[1])
+    if op.key.elem is not None and op.key.elem != HEAD_STORED:
+        refs.append(op.key.elem[1])
+    refs.extend(p[1] for p in op.pred)
+    for a in refs:
+        if a < 0 or a >= n_actors:
+            raise ValueError(f"op {i} references missing actor index {a}")
+
+
+def parse_change(buf: bytes, pos: int = 0) -> tuple[StoredChange, int]:
+    chunk, end = parse_chunk(buf, pos)
+    if chunk.chunk_type != CHUNK_CHANGE:
+        raise ValueError(f"expected change chunk, got type {chunk.chunk_type}")
+    if not chunk.checksum_valid:
+        raise ValueError("change chunk checksum mismatch")
+    if buf[pos + 8] == 2:  # was stored compressed: rebuild uncompressed chunk
+        raw = write_chunk(CHUNK_CHANGE, chunk.data)
+    else:
+        raw = bytes(buf[pos:end])
+    change = parse_change_data(chunk.data, chunk.hash, raw)
+    return change, end

@@ -1,0 +1,120 @@
+"""Chunk framing: magic bytes, checksum, type byte, length prefix.
+
+Byte-compatible with the reference (reference:
+rust/automerge/src/storage/chunk.rs, storage.rs MAGIC_BYTES). A chunk is:
+
+    magic (4 bytes: 85 6f 4a 83)
+    checksum (4 bytes: first 4 bytes of the chunk hash)
+    chunk type (1 byte: 0=document, 1=change, 2=compressed change)
+    data length (ULEB128)
+    data
+
+The chunk hash — which doubles as the change hash for change chunks — is
+SHA-256 over (type byte || ULEB(len) || data).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import zlib
+from typing import Iterator, NamedTuple
+
+from ..utils.leb128 import decode_uleb, encode_uleb
+
+MAGIC_BYTES = bytes([0x85, 0x6F, 0x4A, 0x83])
+
+CHUNK_DOCUMENT = 0
+CHUNK_CHANGE = 1
+CHUNK_COMPRESSED = 2
+
+DEFLATE_MIN_SIZE = 256  # reference: storage/change.rs DEFLATE_MIN_SIZE
+
+
+class ChunkParseError(ValueError):
+    pass
+
+
+def chunk_hash(chunk_type: int, data: bytes) -> bytes:
+    body = bytearray([chunk_type])
+    encode_uleb(len(data), body)
+    body += data
+    return hashlib.sha256(bytes(body)).digest()
+
+
+class RawChunk(NamedTuple):
+    chunk_type: int
+    checksum: bytes  # 4 bytes as stored
+    hash: bytes  # 32-byte SHA-256 of (type || len || data)
+    data: bytes
+
+    @property
+    def checksum_valid(self) -> bool:
+        return self.hash[:4] == self.checksum
+
+
+def write_chunk(chunk_type: int, data: bytes) -> bytes:
+    h = chunk_hash(chunk_type, data)
+    out = bytearray(MAGIC_BYTES)
+    out += h[:4]
+    out.append(chunk_type)
+    encode_uleb(len(data), out)
+    out += data
+    return bytes(out)
+
+
+def parse_chunk(buf: bytes, pos: int = 0) -> tuple[RawChunk, int]:
+    """Parse one chunk starting at ``pos``; returns (chunk, new_pos).
+
+    Compressed change chunks are inflated transparently: the returned chunk is
+    the equivalent uncompressed change chunk (its stored checksum is the
+    original's, which the reference derives from the *uncompressed* data).
+    """
+    if pos + 8 > len(buf):
+        raise ChunkParseError("truncated chunk header")
+    if buf[pos : pos + 4] != MAGIC_BYTES:
+        raise ChunkParseError("invalid magic bytes")
+    checksum = bytes(buf[pos + 4 : pos + 8])
+    if pos + 8 >= len(buf):
+        raise ChunkParseError("truncated chunk header")
+    chunk_type = buf[pos + 8]
+    if chunk_type > CHUNK_COMPRESSED:
+        raise ChunkParseError(f"unknown chunk type {chunk_type}")
+    length, data_start = decode_uleb(buf, pos + 9)
+    data_end = data_start + length
+    if data_end > len(buf):
+        raise ChunkParseError("chunk data extends past end of input")
+    data = bytes(buf[data_start:data_end])
+    if chunk_type == CHUNK_COMPRESSED:
+        try:
+            data = zlib.decompress(data, wbits=-15)  # raw DEFLATE stream
+        except zlib.error as e:
+            raise ChunkParseError(f"invalid deflate stream: {e}") from e
+        chunk_type = CHUNK_CHANGE
+    h = chunk_hash(chunk_type, data)
+    return RawChunk(chunk_type, checksum, h, data), data_end
+
+
+def iter_chunks(buf: bytes) -> Iterator[RawChunk]:
+    pos = 0
+    while pos < len(buf):
+        chunk, pos = parse_chunk(buf, pos)
+        yield chunk
+
+
+def compress_chunk(chunk_bytes: bytes) -> bytes:
+    """Deflate a change chunk into a compressed chunk (type 2).
+
+    The checksum is preserved from the uncompressed chunk (reference:
+    storage/change/compressed.rs).
+    """
+    chunk, _ = parse_chunk(chunk_bytes)
+    if chunk.chunk_type != CHUNK_CHANGE:
+        raise ValueError("only change chunks can be compressed")
+    co = zlib.compressobj(level=6, wbits=-15)
+    deflated = co.compress(chunk.data) + co.flush()
+    out = bytearray(MAGIC_BYTES)
+    out += chunk.checksum
+    out.append(CHUNK_COMPRESSED)
+    encode_uleb(len(deflated), out)
+    out += deflated
+    return bytes(out)

@@ -1,0 +1,151 @@
+"""Scalar value column codec: a ValueMetadata RLE column + raw value column.
+
+Byte-compatible with the reference (reference:
+rust/automerge/src/columnar/column_range/value.rs). The metadata value is
+``(byte_length << 4) | type_code`` with type codes 0=null, 1=false, 2=true,
+3=uleb uint, 4=sleb int, 5=f64 LE, 6=utf8 string, 7=bytes, 8=counter (sleb of
+the start value), 9=timestamp (sleb); any other code is an unknown type whose
+raw bytes roundtrip unchanged.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from ..types import ScalarValue
+from ..utils.codecs import RleEncoder, rle_decode
+from ..utils.leb128 import (
+    decode_sleb,
+    decode_uleb,
+    lebsize,
+    sleb_bytes,
+    uleb_bytes,
+    ulebsize,
+)
+
+
+def value_meta(v: ScalarValue) -> int:
+    tag = v.tag
+    if tag == "null":
+        return 0
+    if tag == "bool":
+        return 2 if v.value else 1
+    if tag == "uint":
+        return (ulebsize(v.value) << 4) | 3
+    if tag == "int":
+        return (lebsize(v.value) << 4) | 4
+    if tag == "f64":
+        return (8 << 4) | 5
+    if tag == "str":
+        return (len(v.value.encode("utf-8")) << 4) | 6
+    if tag == "bytes":
+        return (len(v.value) << 4) | 7
+    if tag == "counter":
+        return (lebsize(v.value) << 4) | 8
+    if tag == "timestamp":
+        return (lebsize(v.value) << 4) | 9
+    if tag == "unknown":
+        type_code, raw = v.value
+        return (len(raw) << 4) | type_code
+    raise ValueError(f"unknown scalar tag {tag!r}")
+
+
+def encode_raw_value(v: ScalarValue, out: bytearray) -> None:
+    tag = v.tag
+    if tag in ("null", "bool"):
+        return
+    if tag == "uint":
+        out += uleb_bytes(v.value)
+    elif tag in ("int", "counter", "timestamp"):
+        out += sleb_bytes(v.value)
+    elif tag == "f64":
+        out += struct.pack("<d", v.value)
+    elif tag == "str":
+        out += v.value.encode("utf-8")
+    elif tag == "bytes":
+        out += v.value
+    elif tag == "unknown":
+        out += v.value[1]
+    else:
+        raise ValueError(f"unknown scalar tag {tag!r}")
+
+
+class ValueEncoder:
+    """Builds the (meta, raw) column pair for a sequence of scalars."""
+
+    def __init__(self):
+        self._meta = RleEncoder("uint")
+        self._raw = bytearray()
+
+    def append(self, v: ScalarValue) -> None:
+        self._meta.append_value(value_meta(v))
+        encode_raw_value(v, self._raw)
+
+    def finish(self) -> tuple[bytes, bytes]:
+        return self._meta.finish(), bytes(self._raw)
+
+
+def decode_values(meta_buf: bytes, raw_buf: bytes, count: int) -> list[ScalarValue]:
+    metas = rle_decode(meta_buf, "uint", count)
+    if len(metas) < count:
+        raise ValueError("value metadata column shorter than row count")
+    out: list[ScalarValue] = []
+    pos = 0
+    for m in metas:
+        if m is None:
+            raise ValueError("value metadata column contained a null")
+        type_code = m & 0x0F
+        length = m >> 4
+        raw = raw_buf[pos : pos + length]
+        if len(raw) != length:
+            raise ValueError("value column: truncated raw data")
+        pos += length
+        out.append(_decode_one(type_code, raw))
+    return out
+
+
+def _decode_one(type_code: int, raw: bytes) -> ScalarValue:
+    if type_code == 0:
+        _expect_empty(raw)
+        return ScalarValue("null")
+    if type_code == 1:
+        _expect_empty(raw)
+        return ScalarValue("bool", False)
+    if type_code == 2:
+        _expect_empty(raw)
+        return ScalarValue("bool", True)
+    if type_code == 3:
+        v, end = decode_uleb(raw, 0)
+        _expect_consumed(raw, end)
+        return ScalarValue("uint", v)
+    if type_code == 4:
+        v, end = decode_sleb(raw, 0)
+        _expect_consumed(raw, end)
+        return ScalarValue("int", v)
+    if type_code == 5:
+        if len(raw) != 8:
+            raise ValueError(f"float value should have length 8, had {len(raw)}")
+        return ScalarValue("f64", struct.unpack("<d", raw)[0])
+    if type_code == 6:
+        return ScalarValue("str", raw.decode("utf-8"))
+    if type_code == 7:
+        return ScalarValue("bytes", bytes(raw))
+    if type_code == 8:
+        v, end = decode_sleb(raw, 0)
+        _expect_consumed(raw, end)
+        return ScalarValue("counter", v)
+    if type_code == 9:
+        v, end = decode_sleb(raw, 0)
+        _expect_consumed(raw, end)
+        return ScalarValue("timestamp", v)
+    return ScalarValue("unknown", (type_code, bytes(raw)))
+
+
+def _expect_empty(raw: bytes) -> None:
+    if raw:
+        raise ValueError("zero-length value type had raw bytes")
+
+
+def _expect_consumed(raw: bytes, end: int) -> None:
+    if end != len(raw):
+        raise ValueError("value had extra bytes")

@@ -1,0 +1,141 @@
+"""LEB128 variable-length integer codecs.
+
+Byte-compatible with the reference encoding (reference:
+rust/automerge/src/columnar/encoding/encodable_impls.rs:134-200 and
+rust/automerge/src/storage/parse/leb128.rs). Unsigned values use ULEB128,
+signed values use SLEB128 (two's complement, sign-extended).
+"""
+
+from __future__ import annotations
+
+
+class LEBDecodeError(ValueError):
+    pass
+
+
+def encode_uleb(value: int, out: bytearray) -> int:
+    """Append the ULEB128 encoding of ``value`` to ``out``; return bytes written."""
+    if value < 0:
+        raise ValueError(f"cannot uleb-encode negative value {value}")
+    n = 0
+    while True:
+        byte = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(byte | 0x80)
+            n += 1
+        else:
+            out.append(byte)
+            return n + 1
+
+
+def encode_sleb(value: int, out: bytearray) -> int:
+    """Append the SLEB128 encoding of ``value`` to ``out``; return bytes written."""
+    n = 0
+    while True:
+        byte = value & 0x7F
+        # Arithmetic shift: Python ints shift preserves sign for negatives.
+        value >>= 7
+        sign_bit = byte & 0x40
+        done = (value == 0 and not sign_bit) or (value == -1 and sign_bit)
+        if done:
+            out.append(byte)
+            return n + 1
+        out.append(byte | 0x80)
+        n += 1
+
+
+def uleb_bytes(value: int) -> bytes:
+    buf = bytearray()
+    encode_uleb(value, buf)
+    return bytes(buf)
+
+
+def sleb_bytes(value: int) -> bytes:
+    buf = bytearray()
+    encode_sleb(value, buf)
+    return bytes(buf)
+
+
+def ulebsize(value: int) -> int:
+    """Number of bytes ULEB128 encoding of ``value`` occupies.
+
+    Mirrors reference rust/automerge/src/columnar/encoding/leb128.rs.
+    """
+    if value == 0:
+        return 1
+    n = 0
+    while value:
+        value >>= 7
+        n += 1
+    return n
+
+
+def lebsize(value: int) -> int:
+    """Number of bytes SLEB128 encoding of ``value`` occupies."""
+    if value >= 0:
+        bits = value.bit_length() + 1  # +1 for sign bit
+    else:
+        bits = (~value).bit_length() + 1
+    return (bits + 6) // 7
+
+
+def decode_uleb(buf, pos: int) -> tuple[int, int]:
+    """Decode a ULEB128 value from ``buf`` at ``pos``.
+
+    Returns (value, new_pos). Rejects truncated input, values exceeding u64,
+    and overlong encodings (trailing zero continuation byte) — matching the
+    reference's strict parser (storage/parse/leb128.rs).
+    """
+    result = 0
+    shift = 0
+    while True:
+        if pos >= len(buf):
+            raise LEBDecodeError("uleb: unexpected end of input")
+        byte = buf[pos]
+        pos += 1
+        result |= (byte & 0x7F) << shift
+        shift += 7
+        if not (byte & 0x80):
+            if shift > 64 and byte > 1:
+                raise LEBDecodeError("uleb: value out of u64 range")
+            if shift > 7 and byte == 0:
+                raise LEBDecodeError("uleb: overlong encoding")
+            return result, pos
+        if shift > 64:
+            raise LEBDecodeError("uleb: value out of u64 range")
+
+
+def decode_sleb(buf, pos: int) -> tuple[int, int]:
+    """Decode an SLEB128 value from ``buf`` at ``pos``. Returns (value, new_pos).
+
+    Rejects truncation, values outside i64, and overlong encodings (a final
+    byte that only repeats the penultimate byte's sign bit).
+    """
+    result = 0
+    shift = 0
+    prev = 0
+    while True:
+        if pos >= len(buf):
+            raise LEBDecodeError("sleb: unexpected end of input")
+        byte = buf[pos]
+        pos += 1
+        result |= (byte & 0x7F) << shift
+        shift += 7
+        if not (byte & 0x80):
+            if shift > 64 and byte != 0 and byte != 0x7F:
+                raise LEBDecodeError("sleb: value out of i64 range")
+            if shift > 7 and (
+                (byte == 0 and not (prev & 0x40)) or (byte == 0x7F and prev & 0x40)
+            ):
+                raise LEBDecodeError("sleb: overlong encoding")
+            if byte & 0x40:
+                result -= 1 << shift
+            # Wrap to i64 two's complement range like the reference's i64.
+            result &= (1 << 64) - 1
+            if result >= 1 << 63:
+                result -= 1 << 64
+            return result, pos
+        if shift > 64:
+            raise LEBDecodeError("sleb: value out of i64 range")
+        prev = byte

@@ -1,0 +1,108 @@
+"""Parse the reference's binary fixture corpus.
+
+These files were produced by the reference implementation
+(rust/automerge/tests/fixtures + fuzz-crashers); parsing them exercises
+byte-level compatibility of the chunk/column decoders. Storage-level parses
+here; full document-load semantics are covered in core tests.
+"""
+
+import os
+
+import pytest
+
+from automerge_tpu.storage.change import parse_change
+from automerge_tpu.storage.chunk import CHUNK_CHANGE, CHUNK_DOCUMENT, parse_chunk
+from automerge_tpu.storage.document import parse_document
+
+FIXTURES = "/root/reference/rust/automerge/tests/fixtures"
+CRASHERS = "/root/reference/rust/automerge/tests/fuzz-crashers"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(FIXTURES), reason="reference fixtures not available"
+)
+
+
+def fixture(name):
+    with open(os.path.join(FIXTURES, name), "rb") as f:
+        return f.read()
+
+
+def test_two_change_chunks_parse():
+    for name in (
+        "two_change_chunks.automerge",
+        "two_change_chunks_compressed.automerge",
+        "two_change_chunks_out_of_order.automerge",
+    ):
+        buf = fixture(name)
+        changes = []
+        pos = 0
+        while pos < len(buf):
+            change, pos = parse_change(buf, pos)
+            changes.append(change)
+        assert len(changes) == 2
+        for c in changes:
+            assert c.hash is not None
+            assert c.start_op >= 1
+
+
+def test_two_change_chunks_contents():
+    buf = fixture("two_change_chunks.automerge")
+    c1, pos = parse_change(buf, 0)
+    c2, _ = parse_change(buf, pos)
+    # second change depends on the first; first has no deps
+    assert c1.dependencies == [] or c2.dependencies == []
+    with_dep = c2 if c2.dependencies else c1
+    without = c1 if c2.dependencies else c2
+    assert with_dep.dependencies == [without.hash]
+
+
+def test_64bit_obj_id_doc_parses():
+    doc, _ = parse_document(fixture("64bit_obj_id_doc.automerge"))
+    assert doc.checksum_valid
+    assert len(doc.ops) > 0
+    assert len(doc.actors) >= 1
+
+
+def test_64bit_obj_id_change_parses():
+    buf = fixture("64bit_obj_id_change.automerge")
+    chunks = []
+    pos = 0
+    while pos < len(buf):
+        chunk, pos = parse_chunk(buf, pos)
+        chunks.append(chunk)
+    assert any(c.chunk_type in (CHUNK_CHANGE, CHUNK_DOCUMENT) for c in chunks)
+
+
+def test_counter_fixture_ok():
+    change, _ = parse_change(fixture("counter_value_is_ok.automerge"))
+    assert any(op.value.tag == "counter" for op in change.ops)
+
+
+def test_counter_fixture_overlong_rejected():
+    # Overlong LEB encodings inside the counter value must error, not panic.
+    with pytest.raises(Exception):
+        parse_change(fixture("counter_value_is_overlong.automerge"))
+
+
+def test_counter_fixture_bad_meta_rejected():
+    with pytest.raises(Exception):
+        parse_change(fixture("counter_value_has_incorrect_meta.automerge"))
+
+
+def test_fuzz_crashers_do_not_crash():
+    """Malformed inputs must raise clean errors, never hang or corrupt."""
+    if not os.path.isdir(CRASHERS):
+        pytest.skip("no crasher corpus")
+    for name in os.listdir(CRASHERS):
+        with open(os.path.join(CRASHERS, name), "rb") as f:
+            buf = f.read()
+        try:
+            pos = 0
+            while pos < len(buf):
+                chunk, pos = parse_chunk(buf, pos)
+                if chunk.chunk_type == CHUNK_DOCUMENT:
+                    parse_document(buf[buf.find(b"\x85o"):])
+                elif chunk.chunk_type == CHUNK_CHANGE:
+                    parse_change(buf)
+        except Exception:
+            pass  # clean failure is the requirement

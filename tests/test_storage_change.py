@@ -1,0 +1,187 @@
+"""Change chunk and chunk framing tests."""
+
+import hashlib
+
+import pytest
+
+from automerge_tpu.storage.change import (
+    ChangeOp,
+    HEAD_STORED,
+    ROOT_STORED,
+    StoredChange,
+    build_change,
+    parse_change,
+)
+from automerge_tpu.storage.chunk import (
+    CHUNK_CHANGE,
+    ChunkParseError,
+    MAGIC_BYTES,
+    compress_chunk,
+    parse_chunk,
+    write_chunk,
+)
+from automerge_tpu.types import Key, ScalarValue
+
+
+class TestChunkFraming:
+    def test_header_layout(self):
+        raw = write_chunk(CHUNK_CHANGE, b"hello")
+        assert raw[:4] == MAGIC_BYTES
+        assert raw[8] == CHUNK_CHANGE
+        assert raw[9] == 5
+        assert raw[10:] == b"hello"
+        # checksum = first 4 bytes of sha256(type || uleb(len) || data)
+        assert raw[4:8] == hashlib.sha256(b"\x01\x05hello").digest()[:4]
+
+    def test_roundtrip(self):
+        raw = write_chunk(CHUNK_CHANGE, bytes(range(200)))
+        chunk, end = parse_chunk(raw)
+        assert end == len(raw)
+        assert chunk.checksum_valid
+        assert chunk.data == bytes(range(200))
+
+    def test_bad_magic_rejected(self):
+        with pytest.raises(ChunkParseError):
+            parse_chunk(b"\x00\x00\x00\x00" + b"\x00" * 10)
+
+    def test_truncated_rejected(self):
+        raw = write_chunk(CHUNK_CHANGE, b"hello")
+        with pytest.raises(ChunkParseError):
+            parse_chunk(raw[:-1])
+
+    def test_compressed_roundtrip(self):
+        data = b"abcdef" * 100
+        raw = write_chunk(CHUNK_CHANGE, data)
+        comp = compress_chunk(raw)
+        assert len(comp) < len(raw)
+        chunk, _ = parse_chunk(comp)
+        assert chunk.chunk_type == CHUNK_CHANGE
+        assert chunk.data == data
+        assert chunk.checksum_valid
+
+
+def _sample_change():
+    actor = bytes.fromhex("aabbccdd" * 4)
+    other = bytes.fromhex("00112233" * 4)
+    ops = [
+        # make a text object under root
+        ChangeOp(
+            obj=ROOT_STORED,
+            key=Key.map("content"),
+            insert=False,
+            action=4,
+            value=ScalarValue.null(),
+        ),
+        # insert two chars at head of it
+        ChangeOp(
+            obj=(1, 0),
+            key=Key.seq(HEAD_STORED),
+            insert=True,
+            action=1,
+            value=ScalarValue("str", "h"),
+        ),
+        ChangeOp(
+            obj=(1, 0),
+            key=Key.seq((2, 0)),
+            insert=True,
+            action=1,
+            value=ScalarValue("str", "i"),
+        ),
+        # a put with a pred from another actor
+        ChangeOp(
+            obj=ROOT_STORED,
+            key=Key.map("n"),
+            insert=False,
+            action=1,
+            value=ScalarValue("int", -42),
+            pred=[(9, 1)],
+        ),
+    ]
+    return StoredChange(
+        dependencies=[b"\x11" * 32],
+        actor=actor,
+        other_actors=[other],
+        seq=2,
+        start_op=10,
+        timestamp=1700000000,
+        message="hello world",
+        ops=ops,
+    )
+
+
+class TestChangeChunk:
+    def test_roundtrip(self):
+        change = build_change(_sample_change())
+        assert change.hash is not None and len(change.hash) == 32
+        parsed, end = parse_change(change.raw_bytes)
+        assert end == len(change.raw_bytes)
+        assert parsed.hash == change.hash
+        assert parsed.actor == change.actor
+        assert parsed.other_actors == change.other_actors
+        assert parsed.seq == 2
+        assert parsed.start_op == 10
+        assert parsed.timestamp == 1700000000
+        assert parsed.message == "hello world"
+        assert parsed.dependencies == change.dependencies
+        assert len(parsed.ops) == 4
+        for a, b in zip(parsed.ops, change.ops):
+            assert (a.obj, a.key, a.insert, a.action, a.value, a.pred) == (
+                b.obj,
+                b.key,
+                b.insert,
+                b.action,
+                b.value,
+                b.pred,
+            )
+
+    def test_deterministic_bytes(self):
+        c1 = build_change(_sample_change())
+        c2 = build_change(_sample_change())
+        assert c1.raw_bytes == c2.raw_bytes
+        assert c1.hash == c2.hash
+
+    def test_compressed_parse(self):
+        change = build_change(_sample_change())
+        comp = compress_chunk(change.raw_bytes)
+        parsed, _ = parse_change(comp)
+        assert parsed.hash == change.hash
+        assert parsed.raw_bytes == change.raw_bytes
+
+    def test_scalar_kinds_roundtrip(self):
+        kinds = [
+            ScalarValue.null(),
+            ScalarValue("bool", True),
+            ScalarValue("bool", False),
+            ScalarValue("uint", 2**40),
+            ScalarValue("int", -7),
+            ScalarValue("f64", 3.5),
+            ScalarValue("str", "héllo"),
+            ScalarValue("bytes", b"\x00\x01"),
+            ScalarValue("counter", 10),
+            ScalarValue("timestamp", 1234567),
+            ScalarValue("unknown", (12, b"xyz")),
+        ]
+        ops = [
+            ChangeOp(
+                obj=ROOT_STORED,
+                key=Key.map(f"k{i}"),
+                insert=False,
+                action=1,
+                value=v,
+            )
+            for i, v in enumerate(kinds)
+        ]
+        change = build_change(
+            StoredChange(
+                dependencies=[],
+                actor=b"\x01" * 16,
+                other_actors=[],
+                seq=1,
+                start_op=1,
+                timestamp=0,
+                message=None,
+                ops=ops,
+            )
+        )
+        parsed, _ = parse_change(change.raw_bytes)
+        assert [op.value for op in parsed.ops] == kinds
