@@ -12,4 +12,7 @@ Reference behavior: aasthaagarwal2003/automerge (see SURVEY.md).
 
 __version__ = "0.1.0"
 
+from .api import AutoDoc  # noqa: F401
+from .core.document import AutomergeError, Document, ROOT  # noqa: F401
+from .core.transaction import Transaction  # noqa: F401
 from .types import ActorId, Action, ObjType, ScalarValue  # noqa: F401

@@ -1,0 +1,214 @@
+"""AutoDoc: the implicit-transaction document API.
+
+Mirrors the reference's AutoCommit (reference:
+rust/automerge/src/autocommit.rs): every mutating call opens a transaction if
+none is open; reads and history operations commit it first. This is the
+primary user-facing API of the framework (the analogue of the reference's
+wasm/JS surface is built on top of it).
+
+    doc = AutoDoc()
+    text = doc.put_object("_root", "content", ObjType.TEXT)
+    doc.splice_text(text, 0, 0, "hello")
+    data = doc.save()
+    doc2 = AutoDoc.load(data)
+    doc2.merge(doc)
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .core.document import Document, ROOT
+from .core.transaction import Transaction
+from .types import ActorId, ObjType
+
+
+class AutoDoc:
+    def __init__(self, actor: Optional[ActorId] = None, document: Optional[Document] = None):
+        self.doc = document or Document(actor)
+        self._tx: Optional[Transaction] = None
+        self._isolation: Optional[List[bytes]] = None
+
+    # -- transaction management --------------------------------------------
+
+    def _ensure_tx(self) -> Transaction:
+        if self._tx is None:
+            scope = None
+            actor = self.doc.actor
+            if self._isolation is not None:
+                scope = self.doc.clock_at(self._isolation)
+                level = len(self.doc.states.get(self.doc.actors.cache(self.doc.actor), ()))
+                actor = self.doc.actor.with_concurrency_suffix(level)
+            self._tx = Transaction(self.doc, scope=scope, actor=actor)
+            if self._isolation is not None:
+                self._tx.deps = list(self._isolation)
+        return self._tx
+
+    def commit(self, message: Optional[str] = None, timestamp: Optional[int] = None) -> Optional[bytes]:
+        tx = self._tx
+        self._tx = None
+        if tx is None:
+            return None
+        if message is not None:
+            tx.message = message
+        if timestamp is not None:
+            tx.timestamp = timestamp
+        return tx.commit()
+
+    def rollback(self) -> int:
+        tx = self._tx
+        self._tx = None
+        return tx.rollback() if tx is not None else 0
+
+    def pending_ops(self) -> int:
+        return self._tx.pending_ops() if self._tx else 0
+
+    def transaction(self, message=None, timestamp=None) -> Transaction:
+        """Open a manual transaction (commit/rollback is the caller's job)."""
+        self.commit()
+        return Transaction(self.doc, message=message, timestamp=timestamp)
+
+    def isolate(self, heads: List[bytes]) -> None:
+        """Scope subsequent edits to ``heads`` (reference: autocommit isolate)."""
+        self.commit()
+        self._isolation = list(heads)
+
+    def integrate(self) -> None:
+        self.commit()
+        self._isolation = None
+
+    # -- identity ----------------------------------------------------------
+
+    def get_actor(self) -> ActorId:
+        return self.doc.actor
+
+    def set_actor(self, actor: ActorId) -> "AutoDoc":
+        self.commit()
+        self.doc.set_actor(actor)
+        return self
+
+    # -- mutation (delegates through the open transaction) ------------------
+
+    def put(self, obj: str, prop, value) -> None:
+        self._ensure_tx().put(obj, prop, value)
+
+    def put_object(self, obj: str, prop, obj_type: ObjType) -> str:
+        return self._ensure_tx().put_object(obj, prop, obj_type)
+
+    def insert(self, obj: str, index: int, value) -> None:
+        self._ensure_tx().insert(obj, index, value)
+
+    def insert_object(self, obj: str, index: int, obj_type: ObjType) -> str:
+        return self._ensure_tx().insert_object(obj, index, obj_type)
+
+    def delete(self, obj: str, prop) -> None:
+        self._ensure_tx().delete(obj, prop)
+
+    def increment(self, obj: str, prop, by: int) -> None:
+        self._ensure_tx().increment(obj, prop, by)
+
+    def splice_text(self, obj: str, pos: int, delete: int, text: str) -> None:
+        self._ensure_tx().splice_text(obj, pos, delete, text)
+
+    def splice(self, obj: str, pos: int, delete: int, values) -> None:
+        self._ensure_tx().splice(obj, pos, delete, values)
+
+    def mark(self, obj: str, start: int, end: int, name: str, value, expand="after") -> None:
+        self._ensure_tx().mark(obj, start, end, name, value, expand)
+
+    def unmark(self, obj: str, start: int, end: int, name: str) -> None:
+        self._ensure_tx().unmark(obj, start, end, name)
+
+    # -- reads -------------------------------------------------------------
+    # Reads see the open transaction's ops in place (the store is updated as
+    # ops are created). Under isolation they read at the isolation clock so
+    # reads and mutations agree on what is visible.
+
+    def _read_clock(self, heads):
+        if heads is not None:
+            return self.doc.clock_at(heads)
+        if self._isolation is not None:
+            if self._tx is not None and self._tx.scope is not None:
+                return self._tx.scope
+            return self.doc.clock_at(self._isolation)
+        return None
+
+    def get(self, obj: str, prop, heads=None):
+        return self.doc.get(obj, prop, clock=self._read_clock(heads))
+
+    def get_all(self, obj: str, prop, heads=None):
+        return self.doc.get_all(obj, prop, clock=self._read_clock(heads))
+
+    def keys(self, obj: str = ROOT, heads=None):
+        return self.doc.keys(obj, clock=self._read_clock(heads))
+
+    def length(self, obj: str = ROOT, heads=None) -> int:
+        return self.doc.length(obj, clock=self._read_clock(heads))
+
+    def text(self, obj: str, heads=None) -> str:
+        return self.doc.text(obj, clock=self._read_clock(heads))
+
+    def list_items(self, obj: str, heads=None):
+        return self.doc.list_items(obj, clock=self._read_clock(heads))
+
+    def map_entries(self, obj: str = ROOT, heads=None):
+        return self.doc.map_entries(obj, clock=self._read_clock(heads))
+
+    def hydrate(self, obj: str = ROOT, heads=None):
+        return self.doc.hydrate(obj, clock=self._read_clock(heads))
+
+    def object_type(self, obj: str) -> ObjType:
+        return self.doc.object_type(obj)
+
+    def parents(self, obj: str):
+        return self.doc.parents(obj)
+
+    # -- history -----------------------------------------------------------
+
+    def get_heads(self) -> List[bytes]:
+        self.commit()
+        return self.doc.get_heads()
+
+    def merge(self, other: "AutoDoc") -> List[bytes]:
+        self.commit()
+        other.commit()
+        return self.doc.merge(other.doc)
+
+    def fork(self, actor: Optional[ActorId] = None) -> "AutoDoc":
+        self.commit()
+        return AutoDoc(document=self.doc.fork(actor))
+
+    def fork_at(self, heads: List[bytes], actor: Optional[ActorId] = None) -> "AutoDoc":
+        self.commit()
+        return AutoDoc(document=self.doc.fork_at(heads, actor))
+
+    def apply_changes(self, changes) -> None:
+        self.commit()
+        self.doc.apply_changes(changes)
+
+    def get_changes(self, have_deps: List[bytes]):
+        self.commit()
+        return self.doc.get_changes(have_deps)
+
+    def get_last_local_change(self):
+        self.commit()
+        idxs = self.doc.states.get(self.doc.actors.lookup(self.doc.actor), [])
+        return self.doc.history[idxs[-1]].stored if idxs else None
+
+    # -- save / load -------------------------------------------------------
+
+    def save(self, deflate: bool = True) -> bytes:
+        self.commit()
+        return self.doc.save(deflate)
+
+    def save_incremental_after(self, heads: List[bytes]) -> bytes:
+        self.commit()
+        return self.doc.save_incremental_after(heads)
+
+    @classmethod
+    def load(cls, data: bytes, actor: Optional[ActorId] = None, verify: bool = True) -> "AutoDoc":
+        return cls(document=Document.load(data, actor, verify))
+
+    def load_incremental(self, data: bytes, verify: bool = True) -> None:
+        self.commit()
+        self.doc.load_incremental(data, verify)

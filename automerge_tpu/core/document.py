@@ -1,0 +1,762 @@
+"""The document/history layer: apply, merge, fork, save, load, reads.
+
+Semantics mirror the reference's Automerge struct (reference:
+rust/automerge/src/automerge.rs): a causally-ordered change history with a
+queue for not-yet-ready changes, a change DAG for clock derivation, an op
+store for current state, and a uniform read API with ``*_at(heads)``
+historical variants driven by vector clocks.
+
+Public object ids use the Automerge convention: "_root" or "<ctr>@<actorhex>".
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..storage.change import (
+    ChangeOp,
+    HEAD_STORED,
+    ROOT_STORED,
+    StoredChange,
+    build_change,
+    parse_change,
+)
+from ..storage.chunk import (
+    CHUNK_CHANGE,
+    CHUNK_DOCUMENT,
+    MAGIC_BYTES,
+    parse_chunk,
+)
+from ..storage.document import (
+    DocChangeMeta,
+    DocOp,
+    ParsedDocument,
+    build_document,
+    parse_document,
+)
+from ..types import (
+    Action,
+    ActorId,
+    HEAD,
+    Key,
+    ObjType,
+    OpId,
+    ScalarValue,
+    is_make_action,
+    objtype_for_action,
+)
+from ..utils.indexed_cache import IndexedCache
+from .change_graph import ChangeGraph
+from .clock import Clock
+from .op_store import (
+    LIST_ENC,
+    TEXT_ENC,
+    MapObject,
+    Op,
+    OpStore,
+    ROOT_OBJ,
+    SeqObject,
+)
+
+ROOT = "_root"
+
+
+class AutomergeError(ValueError):
+    pass
+
+
+class AppliedChange:
+    """A change in the history with its actor translation table."""
+
+    __slots__ = ("stored", "actor_idx", "actor_map")
+
+    def __init__(self, stored: StoredChange, actor_idx: int, actor_map: List[int]):
+        self.stored = stored
+        self.actor_idx = actor_idx
+        self.actor_map = actor_map  # chunk-local actor index -> global index
+
+    @property
+    def hash(self) -> bytes:
+        return self.stored.hash
+
+
+class Document:
+    """A CRDT document: nested maps/lists/text/counters with full history."""
+
+    def __init__(self, actor: Optional[ActorId] = None):
+        self.actor = actor or ActorId()
+        self.actors: IndexedCache[ActorId] = IndexedCache()
+        self.props: IndexedCache[str] = IndexedCache()
+        self.ops = OpStore(self.actors)
+        self.history: List[AppliedChange] = []
+        self.history_index: Dict[bytes, int] = {}
+        self.states: Dict[int, List[int]] = {}
+        self.queue: List[StoredChange] = []
+        self.deps: Set[bytes] = set()
+        self.change_graph = ChangeGraph()
+        self.max_op = 0
+
+    # -- identity ----------------------------------------------------------
+
+    def set_actor(self, actor: ActorId) -> None:
+        self.actor = actor
+
+    def get_actor(self) -> ActorId:
+        return self.actor
+
+    # -- object id conversion ----------------------------------------------
+
+    def export_id(self, obj: OpId) -> str:
+        if obj[0] == 0:
+            return ROOT
+        return f"{obj[0]}@{self.actors.get(obj[1]).to_hex()}"
+
+    def import_id(self, exid: str) -> OpId:
+        if exid == ROOT:
+            return ROOT_OBJ
+        try:
+            ctr_s, actor_hex = exid.split("@", 1)
+            ctr = int(ctr_s)
+            idx = self.actors.lookup(ActorId.from_hex(actor_hex))
+        except (ValueError, AttributeError) as e:
+            raise AutomergeError(f"invalid object id {exid!r}") from e
+        if idx is None:
+            raise AutomergeError(f"object id {exid!r} references unknown actor")
+        return (ctr, idx)
+
+    def import_obj(self, exid: str) -> OpId:
+        obj = self.import_id(exid)
+        if not self.ops.has_obj(obj):
+            raise AutomergeError(f"no such object {exid!r}")
+        return obj
+
+    # -- heads / clocks ----------------------------------------------------
+
+    def get_heads(self) -> List[bytes]:
+        return sorted(self.deps)
+
+    def clock_at(self, heads: Optional[Iterable[bytes]]) -> Optional[Clock]:
+        if heads is None:
+            return None
+        return self.change_graph.clock_for_heads(heads)
+
+    # -- change application ------------------------------------------------
+
+    def apply_changes(self, changes: Iterable[StoredChange]) -> None:
+        for change in changes:
+            if change.hash in self.history_index:
+                continue
+            if self._is_duplicate_seq(change):
+                raise AutomergeError(
+                    f"duplicate seq {change.seq} for actor {change.actor.hex()}"
+                )
+            if self._is_causally_ready(change):
+                self._apply_change(change)
+            else:
+                self.queue.append(change)
+        self._drain_queue()
+        # Changes still in the queue wait for their dependencies; the
+        # reference likewise holds not-yet-ready changes without erroring.
+
+    def _drain_queue(self) -> None:
+        applied = True
+        while applied:
+            applied = False
+            remaining = []
+            for change in self.queue:
+                if change.hash in self.history_index:
+                    applied = True
+                    continue
+                if self._is_causally_ready(change):
+                    self._apply_change(change)
+                    applied = True
+                else:
+                    remaining.append(change)
+            self.queue = remaining
+
+    def _is_causally_ready(self, change: StoredChange) -> bool:
+        return all(d in self.history_index for d in change.dependencies)
+
+    def _is_duplicate_seq(self, change: StoredChange) -> bool:
+        actor_idx = self.actors.lookup(ActorId(change.actor))
+        if actor_idx is None:
+            return False
+        for hist_idx in self.states.get(actor_idx, []):
+            if self.history[hist_idx].stored.seq == change.seq:
+                return True
+        return False
+
+    def get_missing_deps(self, heads: Iterable[bytes]) -> List[bytes]:
+        """Dependencies required before queued changes (and ``heads``) apply."""
+        in_queue = {c.hash for c in self.queue}
+        missing = set()
+        for change in self.queue:
+            for dep in change.dependencies:
+                if dep not in self.history_index and dep not in in_queue:
+                    missing.add(dep)
+        for h in heads:
+            if h not in self.history_index and h not in in_queue:
+                missing.add(h)
+        return sorted(missing)
+
+    def _apply_change(self, change: StoredChange) -> None:
+        actor_map = [self.actors.cache(ActorId(a)) for a in change.actors]
+        applied = AppliedChange(change, actor_map[0], actor_map)
+        ops = self._import_ops(change, actor_map)
+        self._update_history(applied)
+        for obj_id, op in ops:
+            self.ops.insert_op(obj_id, op)
+
+    def _import_ops(
+        self, change: StoredChange, actor_map: List[int]
+    ) -> List[Tuple[OpId, Op]]:
+        """Translate chunk-local ChangeOps to store ops with global indices.
+
+        Mirrors reference import_ops (automerge.rs:860-914).
+        """
+        out = []
+        author = actor_map[0]
+        for i, cop in enumerate(change.ops):
+            opid = (change.start_op + i, author)
+            obj = self._import_objid(cop.obj, actor_map)
+            key = None
+            elem = None
+            if cop.key.prop is not None:
+                key = self.props.cache(cop.key.prop)
+            else:
+                e = cop.key.elem
+                elem = HEAD if e[0] == 0 else (e[0], actor_map[e[1]])
+            pred = self.ops.sort_opids(
+                [(p[0], actor_map[p[1]]) for p in cop.pred]
+            )
+            op = Op(
+                id=opid,
+                action=cop.action,
+                value=cop.value,
+                key=key,
+                elem=elem,
+                insert=cop.insert,
+                pred=pred,
+                mark_name=cop.mark_name,
+                expand=cop.expand,
+            )
+            out.append((obj, op))
+        return out
+
+    @staticmethod
+    def _import_objid(obj: OpId, actor_map: List[int]) -> OpId:
+        if obj[0] == 0:
+            return ROOT_OBJ
+        return (obj[0], actor_map[obj[1]])
+
+    def _update_history(self, applied: AppliedChange) -> None:
+        idx = len(self.history)
+        self.history.append(applied)
+        self.history_index[applied.hash] = idx
+        self.states.setdefault(applied.actor_idx, []).append(idx)
+        self.change_graph.add_change(
+            applied.hash,
+            applied.actor_idx,
+            applied.stored.seq,
+            applied.stored.max_op,
+            applied.stored.dependencies,
+        )
+        for dep in applied.stored.dependencies:
+            self.deps.discard(dep)
+        self.deps.add(applied.hash)
+        self.max_op = max(self.max_op, applied.stored.max_op)
+
+    # -- merge / fork ------------------------------------------------------
+
+    def get_changes(self, have_deps: List[bytes]) -> List[StoredChange]:
+        """Changes not reachable from ``have_deps``, in causal order."""
+        known = self.change_graph.ancestor_hashes(have_deps)
+        return [c.stored for c in self.history if c.hash not in known]
+
+    def get_change_by_hash(self, h: bytes) -> Optional[StoredChange]:
+        idx = self.history_index.get(h)
+        return self.history[idx].stored if idx is not None else None
+
+    def get_changes_added(self, other: "Document") -> List[StoredChange]:
+        """Changes in ``other`` that this document lacks (reference:
+        automerge.rs get_changes_added — DFS from other's heads)."""
+        return [
+            c.stored for c in other.history if c.hash not in self.history_index
+        ]
+
+    def merge(self, other: "Document") -> List[bytes]:
+        changes = self.get_changes_added(other)
+        self.apply_changes(changes)
+        return self.get_heads()
+
+    def fork(self, actor: Optional[ActorId] = None) -> "Document":
+        doc = Document(actor or ActorId())
+        doc.apply_changes(c.stored for c in self.history)
+        return doc
+
+    def fork_at(self, heads: List[bytes], actor: Optional[ActorId] = None) -> "Document":
+        keep = self.change_graph.ancestor_hashes(heads)
+        missing = [h for h in heads if h not in self.history_index]
+        if missing:
+            raise AutomergeError(f"fork_at: unknown heads {missing}")
+        doc = Document(actor or ActorId())
+        doc.apply_changes(c.stored for c in self.history if c.hash in keep)
+        return doc
+
+    # -- reads -------------------------------------------------------------
+
+    def object_type(self, obj: str) -> ObjType:
+        return self.ops.obj_type(self.import_obj(obj))
+
+    def _render_op(self, op: Op, clock) -> object:
+        """The public value of a visible op: obj / counter / scalar tuple."""
+        if is_make_action(op.action):
+            return ("obj", objtype_for_action(op.action), self.export_id(op.id))
+        if op.is_counter:
+            return ("counter", op.counter_value_at(clock))
+        return ("scalar", op.value)
+
+    def _resolve_clock(self, heads, clock):
+        return clock if clock is not None else self.clock_at(heads)
+
+    def get(self, obj: str, prop, heads=None, clock=None):
+        """Winner value at ``prop`` (a key or an index): (value, id) or None."""
+        vals = self.get_all(obj, prop, heads, clock)
+        return vals[-1] if vals else None
+
+    def get_all(self, obj: str, prop, heads=None, clock=None) -> List[Tuple[object, str]]:
+        """All conflicting values at ``prop``, winner last."""
+        obj_id = self.import_obj(obj)
+        clock = self._resolve_clock(heads, clock)
+        info = self.ops.get_obj(obj_id)
+        if isinstance(info.data, MapObject):
+            if not isinstance(prop, str):
+                raise AutomergeError("map lookup requires a string key")
+            key = self.props.lookup(prop)
+            if key is None:
+                return []
+            vis = self.ops.visible_map_ops(obj_id, key, clock)
+        else:
+            if not isinstance(prop, int):
+                raise AutomergeError("sequence lookup requires an integer index")
+            el = self.ops.nth(obj_id, prop, LIST_ENC, clock)
+            if el is None:
+                return []
+            vis = el.visible_ops(clock)
+        return [(self._render_op(op, clock), self.export_id(op.id)) for op in vis]
+
+    def keys(self, obj: str, heads=None, clock=None) -> List[str]:
+        obj_id = self.import_obj(obj)
+        clock = self._resolve_clock(heads, clock)
+        idxs = self.ops.map_keys(obj_id, clock)
+        return sorted(self.props.get(i) for i in idxs)
+
+    def length(self, obj: str, heads=None, clock=None) -> int:
+        obj_id = self.import_obj(obj)
+        info = self.ops.get_obj(obj_id)
+        clock = self._resolve_clock(heads, clock)
+        if isinstance(info.data, MapObject):
+            return len(self.ops.map_keys(obj_id, clock))
+        enc = TEXT_ENC if info.data.obj_type == ObjType.TEXT else LIST_ENC
+        return self.ops.seq_length(obj_id, enc, clock)
+
+    def text(self, obj: str, heads=None, clock=None) -> str:
+        obj_id = self.import_obj(obj)
+        clock = self._resolve_clock(heads, clock)
+        return self.ops.text(obj_id, clock)
+
+    def list_items(self, obj: str, heads=None, clock=None) -> List[Tuple[object, str]]:
+        obj_id = self.import_obj(obj)
+        clock = self._resolve_clock(heads, clock)
+        return [
+            (self._render_op(w, clock), self.export_id(w.id))
+            for _, w in self.ops.visible_elements(obj_id, clock)
+        ]
+
+    def map_entries(self, obj: str, heads=None, clock=None) -> List[Tuple[str, object, str]]:
+        obj_id = self.import_obj(obj)
+        clock = self._resolve_clock(heads, clock)
+        out = []
+        for key_idx in self.ops.map_keys(obj_id, clock):
+            run = self.ops.visible_map_ops(obj_id, key_idx, clock)
+            if run:
+                w = run[-1]
+                out.append(
+                    (self.props.get(key_idx), self._render_op(w, clock), self.export_id(w.id))
+                )
+        out.sort(key=lambda kv: kv[0])
+        return out
+
+    def parents(self, obj: str) -> List[Tuple[str, object]]:
+        """Path from ``obj`` up to the root: [(parent id, key-or-index), ...]."""
+        obj_id = self.import_obj(obj)
+        path = []
+        while obj_id != ROOT_OBJ:
+            info = self.ops.get_obj(obj_id)
+            parent = info.parent
+            if info.parent_key is not None:
+                path.append((self.export_id(parent), self.props.get(info.parent_key)))
+            else:
+                # resolve the element's current index in the parent sequence
+                idx = self._elem_index(parent, info.parent_elem)
+                path.append((self.export_id(parent), idx))
+            obj_id = parent
+        return path
+
+    def _elem_index(self, parent: OpId, elem: OpId) -> Optional[int]:
+        for i, (el, _) in enumerate(self.ops.visible_elements(parent)):
+            if el.elem_id == elem:
+                return i
+        return None
+
+    # -- materialization ---------------------------------------------------
+
+    def hydrate(self, obj: str = ROOT, heads=None, clock=None):
+        """Materialize an object tree into plain Python values."""
+        obj_id = self.import_obj(obj)
+        return self._hydrate(obj_id, self._resolve_clock(heads, clock))
+
+    def _hydrate(self, obj_id: OpId, clock):
+        info = self.ops.get_obj(obj_id)
+        if isinstance(info.data, MapObject):
+            out = {}
+            for key_idx in self.ops.map_keys(obj_id, clock):
+                run = self.ops.visible_map_ops(obj_id, key_idx, clock)
+                if run:
+                    out[self.props.get(key_idx)] = self._hydrate_op(run[-1], clock)
+            return out
+        if info.data.obj_type == ObjType.TEXT:
+            return self.ops.text(obj_id, clock)
+        return [
+            self._hydrate_op(w, clock)
+            for _, w in self.ops.visible_elements(obj_id, clock)
+        ]
+
+    def _hydrate_op(self, op: Op, clock):
+        if is_make_action(op.action):
+            return self._hydrate(op.id, clock)
+        if op.is_counter:
+            return op.counter_value_at(clock)
+        return op.value.to_py()
+
+    # -- save / load -------------------------------------------------------
+
+    def save(self, deflate: bool = True) -> bytes:
+        sorted_idx = self.actors.sorted_order()  # sorted position -> global idx
+        remap = [0] * len(sorted_idx)  # global idx -> sorted position
+        for pos, g in enumerate(sorted_idx):
+            remap[g] = pos
+        actors = [self.actors.get(g).bytes for g in sorted_idx]
+
+        doc_ops = self._doc_ops(remap)
+        changes = [
+            DocChangeMeta(
+                actor=remap[c.actor_idx],
+                seq=c.stored.seq,
+                max_op=c.stored.max_op,
+                timestamp=c.stored.timestamp,
+                message=c.stored.message,
+                deps=sorted(self.history_index[d] for d in c.stored.dependencies),
+                extra=c.stored.extra_bytes,
+            )
+            for c in self.history
+        ]
+        heads = [(h, self.history_index[h]) for h in self.get_heads()]
+        return build_document(actors, heads, doc_ops, changes, deflate)
+
+    def _doc_ops(self, remap: List[int]) -> List[DocOp]:
+        """All stored ops in document order with save-time actor indices."""
+
+        def rid(opid: OpId) -> OpId:
+            return (opid[0], remap[opid[1]])
+
+        out: List[DocOp] = []
+        objs = sorted(
+            self.ops.objects.keys(),
+            key=lambda o: (o[0], remap[o[1]] if o[0] else -1),
+        )
+        for obj_id in objs:
+            info = self.ops.get_obj(obj_id)
+            stored_obj = ROOT_STORED if obj_id == ROOT_OBJ else rid(obj_id)
+            if isinstance(info.data, MapObject):
+                for key_idx in sorted(
+                    info.data.props.keys(), key=lambda k: self.props.get(k)
+                ):
+                    for op in info.data.props[key_idx]:
+                        out.append(
+                            self._doc_op(op, stored_obj, Key.map(self.props.get(key_idx)), rid)
+                        )
+            else:
+                for el in info.data.elements():
+                    first = True
+                    for op in el.run():
+                        if first:
+                            e = op.elem
+                            key = (
+                                Key.seq(HEAD_STORED)
+                                if e[0] == 0
+                                else Key.seq(rid(e))
+                            )
+                            first = False
+                        else:
+                            key = Key.seq(rid(el.elem_id))
+                        out.append(self._doc_op(op, stored_obj, key, rid))
+        return out
+
+    def _doc_op(self, op: Op, stored_obj, key, rid) -> DocOp:
+        return DocOp(
+            id=rid(op.id),
+            obj=stored_obj,
+            key=key,
+            insert=op.insert,
+            action=op.action,
+            value=op.value,
+            succ=[rid(s) for s in op.succ],
+            expand=op.expand,
+            mark_name=op.mark_name,
+        )
+
+    def save_incremental_after(self, heads: List[bytes]) -> bytes:
+        """Concatenated change chunks for everything not covered by ``heads``."""
+        out = bytearray()
+        for c in self.get_changes(heads):
+            out += c.raw_bytes
+        return bytes(out)
+
+    @classmethod
+    def load(cls, data: bytes, actor: Optional[ActorId] = None, verify: bool = True) -> "Document":
+        doc = cls(actor)
+        doc.load_incremental(data, verify=verify)
+        return doc
+
+    def load_incremental(self, data: bytes, verify: bool = True) -> None:
+        pos = 0
+        while pos < len(data):
+            if pos + 9 > len(data):
+                raise AutomergeError("truncated chunk header")
+            if data[pos : pos + 4] != MAGIC_BYTES:
+                raise AutomergeError("invalid chunk magic bytes")
+            chunk_type = data[pos + 8]
+            if chunk_type == CHUNK_DOCUMENT:
+                parsed, pos = parse_document(data, pos)
+                changes = reconstruct_changes(parsed, verify=verify)
+                self.apply_changes(changes)
+            else:
+                change, pos = parse_change(data, pos)
+                self.apply_changes([change])
+
+
+class _ReOp:
+    """An op reconstructed from the document format, with rebuilt pred."""
+
+    __slots__ = ("id", "obj", "key", "insert", "action", "value", "pred", "expand", "mark_name")
+
+    def __init__(self, id, obj, key, insert, action, value, pred, expand, mark_name):
+        self.id = id
+        self.obj = obj
+        self.key = key
+        self.insert = insert
+        self.action = action
+        self.value = value
+        self.pred = pred
+        self.expand = expand
+        self.mark_name = mark_name
+
+
+def reconstruct_changes(doc: ParsedDocument, verify: bool = True) -> List[StoredChange]:
+    """Rebuild the change chunks encoded in a document chunk.
+
+    Mirrors the reference's reconstruction (reference:
+    storage/load/reconstruct_document.rs, load/change_collector.rs):
+    rebuild ``pred`` from ``succ``, synthesize delete ops for dangling
+    succ entries, regroup ops into per-actor changes by op-counter range,
+    re-encode each change, and verify derived head hashes.
+
+    Actor indices in the document are positions in the *sorted* actor table,
+    so (counter, index) order equals Lamport order throughout.
+    """
+    # Changes per actor, ordered by max_op, for counter-range assignment.
+    by_actor: Dict[int, List[int]] = {}
+    for i, ch in enumerate(doc.changes):
+        by_actor.setdefault(ch.actor, []).append(i)
+    for lst in by_actor.values():
+        prev = -1
+        for i in lst:
+            if doc.changes[i].max_op < prev:
+                raise AutomergeError("document changes out of order")
+            prev = doc.changes[i].max_op
+
+    per_change_ops: Dict[int, List[_ReOp]] = {}
+
+    def assign(op: _ReOp) -> None:
+        actor_changes = by_actor.get(op.id[1])
+        if not actor_changes:
+            raise AutomergeError(f"op {op.id} has no owning change")
+        lo, hi = 0, len(actor_changes)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if doc.changes[actor_changes[mid]].max_op < op.id[0]:
+                lo = mid + 1
+            else:
+                hi = mid
+        if lo == len(actor_changes):
+            raise AutomergeError(f"op {op.id} beyond last change of its actor")
+        per_change_ops.setdefault(actor_changes[lo], []).append(op)
+
+    # Walk ops object by object (doc ops are object-grouped), rebuilding
+    # pred from succ and synthesizing deletes from dangling succ entries.
+    current_obj = None
+    preds: Dict[OpId, List[OpId]] = {}
+    set_keys: Dict[OpId, Key] = {}
+    rows: List[DocOp] = []
+
+    def flush_object() -> None:
+        nonlocal preds, set_keys, rows
+        if not rows and not preds:
+            return
+        row_ids = {r.id for r in rows}
+        obj = rows[0].obj if rows else ROOT_STORED
+        for row in rows:
+            assign(
+                _ReOp(
+                    id=row.id,
+                    obj=row.obj,
+                    key=row.key,
+                    insert=row.insert,
+                    action=row.action,
+                    value=row.value,
+                    pred=sorted(preds.get(row.id, [])),
+                    expand=row.expand,
+                    mark_name=row.mark_name,
+                )
+            )
+        for opid in sorted(preds.keys()):
+            if opid in row_ids:
+                continue
+            plist = preds[opid]
+            key = set_keys.get(plist[0])
+            if key is None:
+                raise AutomergeError(f"no set op found for delete {opid}")
+            assign(
+                _ReOp(
+                    id=opid,
+                    obj=obj,
+                    key=key,
+                    insert=False,
+                    action=int(Action.DELETE),
+                    value=ScalarValue.null(),
+                    pred=sorted(plist),
+                    expand=False,
+                    mark_name=None,
+                )
+            )
+        preds, set_keys, rows = {}, {}, []
+
+    last_obj_sort = None
+    for row in doc.ops:
+        if row.obj != current_obj:
+            flush_object()
+            current_obj = row.obj
+            sort_key = (row.obj[0], row.obj[1]) if row.obj != ROOT_STORED else (-1, -1)
+            if last_obj_sort is not None and sort_key < last_obj_sort:
+                raise AutomergeError("document ops out of object order")
+            last_obj_sort = sort_key
+        rows.append(row)
+        if row.action in (0, 1, 2, 4, 6):  # put or make: remembers the key
+            if row.key.prop is not None:
+                set_keys[row.id] = row.key
+            else:
+                elem = row.id if row.insert else row.key.elem
+                set_keys[row.id] = Key.seq(elem)
+        for s in row.succ:
+            preds.setdefault(s, []).append(row.id)
+    flush_object()
+
+    # Build each change chunk: ops sorted by op id, chunk-local actor table.
+    changes: List[StoredChange] = []
+    hash_by_index: Dict[int, bytes] = {}
+    derived_heads: Set[bytes] = set()
+    for idx, meta in enumerate(doc.changes):
+        ops = sorted(per_change_ops.get(idx, []), key=lambda o: o.id)
+        num_ops = len(ops)
+        if num_ops > meta.max_op:
+            raise AutomergeError("incorrect max_op in document change")
+        start_op = meta.max_op - num_ops + 1
+        if start_op < 1:
+            raise AutomergeError("change start_op underflow")
+        author = meta.actor
+        other: List[int] = []
+        other_set = set()
+        for op in ops:
+            for ref in _op_actor_refs(op):
+                if ref != author and ref not in other_set:
+                    other_set.add(ref)
+                    other.append(ref)
+        other.sort(key=lambda g: doc.actors[g])
+        local = {author: 0}
+        for j, g in enumerate(other):
+            local[g] = j + 1
+
+        def tr(opid: OpId) -> OpId:
+            return (opid[0], local[opid[1]])
+
+        change_ops = []
+        for op in ops:
+            if op.key.prop is not None:
+                key = op.key
+            elif op.key.elem[0] == 0:
+                key = Key.seq(HEAD_STORED)
+            else:
+                key = Key.seq(tr(op.key.elem))
+            change_ops.append(
+                ChangeOp(
+                    obj=ROOT_STORED if op.obj == ROOT_STORED else tr(op.obj),
+                    key=key,
+                    insert=op.insert,
+                    action=op.action,
+                    value=op.value,
+                    pred=[tr(p) for p in op.pred],
+                    expand=op.expand,
+                    mark_name=op.mark_name,
+                )
+            )
+        deps = []
+        for d in meta.deps:
+            if d not in hash_by_index:
+                raise AutomergeError(f"change {idx} depends on later change {d}")
+            deps.append(hash_by_index[d])
+        change = build_change(
+            StoredChange(
+                dependencies=deps,
+                actor=doc.actors[author],
+                other_actors=[doc.actors[g] for g in other],
+                seq=meta.seq,
+                start_op=start_op,
+                timestamp=meta.timestamp,
+                message=meta.message,
+                ops=change_ops,
+                extra_bytes=meta.extra,
+            )
+        )
+        hash_by_index[idx] = change.hash
+        for d in deps:
+            derived_heads.discard(d)
+        derived_heads.add(change.hash)
+        changes.append(change)
+
+    if verify and derived_heads != set(doc.heads):
+        raise AutomergeError(
+            "mismatching heads: derived "
+            f"{sorted(h.hex()[:8] for h in derived_heads)} vs stored "
+            f"{sorted(h.hex()[:8] for h in doc.heads)}"
+        )
+    return changes
+
+
+def _op_actor_refs(op: _ReOp):
+    if op.obj != ROOT_STORED:
+        yield op.obj[1]
+    if op.key.elem is not None and op.key.elem[0] != 0:
+        yield op.key.elem[1]
+    for p in op.pred:
+        yield p[1]

@@ -1,0 +1,438 @@
+"""Transactions: local op creation, commit/rollback, autocommit.
+
+Semantics mirror the reference's transaction layer (reference:
+rust/automerge/src/transaction/inner.rs, autocommit.rs): ops apply to the op
+store immediately as they are created (local reads see them), commit encodes
+a columnar change chunk and updates history, rollback removes ops in reverse
+and un-succs their predecessors. ``scope`` (a Clock) gives isolated
+transactions at historical heads with an actor suffix to avoid opid
+collisions (reference: automerge.rs isolate_actor).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..storage.change import ChangeOp, HEAD_STORED, ROOT_STORED, StoredChange, build_change
+from ..types import (
+    Action,
+    ActorId,
+    HEAD,
+    Key,
+    ObjType,
+    OpId,
+    ScalarValue,
+    action_for_objtype,
+)
+from .clock import Clock
+from .document import AppliedChange, AutomergeError, Document, ROOT
+from .op_store import LIST_ENC, TEXT_ENC, MapObject, Op, ROOT_OBJ, SeqObject
+
+
+class Transaction:
+    """A manual transaction over a Document."""
+
+    def __init__(
+        self,
+        doc: Document,
+        message: Optional[str] = None,
+        timestamp: Optional[int] = None,
+        scope: Optional[Clock] = None,
+        actor: Optional[ActorId] = None,
+    ):
+        self.doc = doc
+        self.message = message
+        self.timestamp = timestamp
+        actor = actor or doc.actor
+        self.actor_idx = doc.actors.cache(actor)
+        self.seq = len(doc.states.get(self.actor_idx, ())) + 1
+        self.start_op = doc.max_op + 1
+        self.deps = doc.get_heads()
+        self.scope = scope
+        if scope is not None:
+            scope.isolate(self.actor_idx)
+        self.operations: List[Tuple[OpId, Op]] = []
+        self._done = False
+
+    # -- helpers -----------------------------------------------------------
+
+    def _next_id(self) -> OpId:
+        return (self.start_op + len(self.operations), self.actor_idx)
+
+    def _check_open(self) -> None:
+        if self._done:
+            raise AutomergeError("transaction already committed or rolled back")
+
+    def _apply(self, obj_id: OpId, op: Op) -> None:
+        self.doc.ops.insert_op(obj_id, op)
+        self.operations.append((obj_id, op))
+
+    def _obj(self, obj: str) -> OpId:
+        return self.doc.import_obj(obj)
+
+    def _pred_for_map(self, obj_id: OpId, key_idx: int) -> List[OpId]:
+        ops = self.doc.ops.visible_map_ops(obj_id, key_idx, self.scope)
+        return self.doc.ops.sort_opids([o.id for o in ops])
+
+    def _pred_for_elem(self, el) -> List[OpId]:
+        return self.doc.ops.sort_opids(
+            [o.id for o in el.visible_ops(self.scope)]
+        )
+
+    # -- map mutations -----------------------------------------------------
+
+    def put(self, obj: str, prop, value) -> None:
+        self._check_open()
+        obj_id = self._obj(obj)
+        info = self.doc.ops.get_obj(obj_id)
+        sv = ScalarValue.from_py(value)
+        if isinstance(info.data, MapObject):
+            self._map_op(obj_id, prop, Action.PUT, sv)
+        else:
+            self._seq_set(obj_id, prop, Action.PUT, sv)
+
+    def put_object(self, obj: str, prop, obj_type: ObjType) -> str:
+        self._check_open()
+        obj_id = self._obj(obj)
+        info = self.doc.ops.get_obj(obj_id)
+        action = action_for_objtype(obj_type)
+        if isinstance(info.data, MapObject):
+            op = self._map_op(obj_id, prop, action, ScalarValue.null())
+        else:
+            op = self._seq_set(obj_id, prop, action, ScalarValue.null())
+        return self.doc.export_id(op.id)
+
+    def _map_op(self, obj_id: OpId, prop: str, action: int, value: ScalarValue) -> Op:
+        if not isinstance(prop, str):
+            raise AutomergeError("map keys must be strings")
+        if prop == "":
+            raise AutomergeError("map keys may not be empty")
+        key_idx = self.doc.props.cache(prop)
+        pred = self._pred_for_map(obj_id, key_idx)
+        op = Op(
+            id=self._next_id(),
+            action=action,
+            value=value,
+            key=key_idx,
+            pred=pred,
+        )
+        self._apply(obj_id, op)
+        return op
+
+    def delete(self, obj: str, prop) -> None:
+        self._check_open()
+        obj_id = self._obj(obj)
+        info = self.doc.ops.get_obj(obj_id)
+        if isinstance(info.data, MapObject):
+            key_idx = self.doc.props.lookup(prop) if isinstance(prop, str) else None
+            if key_idx is None:
+                raise AutomergeError(f"cannot delete missing key {prop!r}")
+            pred = self._pred_for_map(obj_id, key_idx)
+            if not pred:
+                raise AutomergeError(f"cannot delete missing key {prop!r}")
+            op = Op(
+                id=self._next_id(),
+                action=Action.DELETE,
+                value=ScalarValue.null(),
+                key=key_idx,
+                pred=pred,
+            )
+            self._apply(obj_id, op)
+        else:
+            enc = self._encoding(info.data)
+            el = self.doc.ops.nth(obj_id, prop, enc, self.scope)
+            if el is None:
+                raise AutomergeError(f"index {prop} out of bounds")
+            op = Op(
+                id=self._next_id(),
+                action=Action.DELETE,
+                value=ScalarValue.null(),
+                elem=el.elem_id,
+                pred=self._pred_for_elem(el),
+            )
+            self._apply(obj_id, op)
+
+    def increment(self, obj: str, prop, by: int) -> None:
+        self._check_open()
+        obj_id = self._obj(obj)
+        info = self.doc.ops.get_obj(obj_id)
+        if isinstance(info.data, MapObject):
+            key_idx = self.doc.props.lookup(prop) if isinstance(prop, str) else None
+            pred_ops = (
+                self.doc.ops.visible_map_ops(obj_id, key_idx, self.scope)
+                if key_idx is not None
+                else []
+            )
+            if not any(o.is_counter for o in pred_ops):
+                raise AutomergeError(f"no counter at {prop!r} to increment")
+            op = Op(
+                id=self._next_id(),
+                action=Action.INCREMENT,
+                value=ScalarValue("int", by),
+                key=key_idx,
+                pred=self.doc.ops.sort_opids([o.id for o in pred_ops if o.is_counter]),
+            )
+            self._apply(obj_id, op)
+        else:
+            enc = self._encoding(info.data)
+            el = self.doc.ops.nth(obj_id, prop, enc, self.scope)
+            if el is None:
+                raise AutomergeError(f"index {prop} out of bounds")
+            counters = [o for o in el.visible_ops(self.scope) if o.is_counter]
+            if not counters:
+                raise AutomergeError(f"no counter at index {prop} to increment")
+            op = Op(
+                id=self._next_id(),
+                action=Action.INCREMENT,
+                value=ScalarValue("int", by),
+                elem=el.elem_id,
+                pred=self.doc.ops.sort_opids([o.id for o in counters]),
+            )
+            self._apply(obj_id, op)
+
+    # -- sequence mutations ------------------------------------------------
+
+    @staticmethod
+    def _encoding(data: SeqObject) -> int:
+        return TEXT_ENC if data.obj_type == ObjType.TEXT else LIST_ENC
+
+    def _seq_set(self, obj_id: OpId, index, action: int, value: ScalarValue) -> Op:
+        """Overwrite the element at ``index`` (width-aware for text)."""
+        if not isinstance(index, int):
+            raise AutomergeError("sequence positions must be integers")
+        info = self.doc.ops.get_obj(obj_id)
+        enc = self._encoding(info.data)
+        el = self.doc.ops.nth(obj_id, index, enc, self.scope)
+        if el is None:
+            raise AutomergeError(f"index {index} out of bounds")
+        op = Op(
+            id=self._next_id(),
+            action=action,
+            value=value,
+            elem=el.elem_id,
+            pred=self._pred_for_elem(el),
+        )
+        self._apply(obj_id, op)
+        return op
+
+    def insert(self, obj: str, index: int, value) -> None:
+        self._check_open()
+        obj_id = self._obj(obj)
+        self._insert_op(obj_id, index, Action.PUT, ScalarValue.from_py(value))
+
+    def insert_object(self, obj: str, index: int, obj_type: ObjType) -> str:
+        self._check_open()
+        obj_id = self._obj(obj)
+        op = self._insert_op(
+            obj_id, index, action_for_objtype(obj_type), ScalarValue.null()
+        )
+        return self.doc.export_id(op.id)
+
+    def _insert_op(self, obj_id: OpId, index: int, action: int, value: ScalarValue) -> Op:
+        info = self.doc.ops.get_obj(obj_id)
+        if not isinstance(info.data, SeqObject):
+            raise AutomergeError("insert on a non-sequence object")
+        enc = self._encoding(info.data)
+        if index == 0:
+            elem = HEAD
+        else:
+            el = self.doc.ops.nth(obj_id, index - 1, enc, self.scope)
+            if el is None:
+                raise AutomergeError(f"index {index} out of bounds")
+            elem = el.elem_id
+        op = Op(
+            id=self._next_id(),
+            action=action,
+            value=value,
+            elem=elem,
+            insert=True,
+        )
+        self._apply(obj_id, op)
+        return op
+
+    def splice_text(self, obj: str, pos: int, delete: int, text: str) -> None:
+        self._check_open()
+        obj_id = self._obj(obj)
+        info = self.doc.ops.get_obj(obj_id)
+        if not isinstance(info.data, SeqObject):
+            raise AutomergeError("splice_text on a non-sequence object")
+        enc = self._encoding(info.data)
+        values = [ScalarValue("str", ch) for ch in text]
+        self._splice(obj_id, pos, delete, values, enc)
+
+    def splice(self, obj: str, pos: int, delete: int, values) -> None:
+        self._check_open()
+        obj_id = self._obj(obj)
+        info = self.doc.ops.get_obj(obj_id)
+        if not isinstance(info.data, SeqObject):
+            raise AutomergeError("splice on a non-sequence object")
+        svals = [ScalarValue.from_py(v) for v in values]
+        self._splice(obj_id, pos, delete, svals, self._encoding(info.data))
+
+    def _splice(self, obj_id, pos, delete, values, enc) -> None:
+        # Deletes first (reference inner_splice deletes then inserts).
+        for _ in range(delete):
+            el = self.doc.ops.nth(obj_id, pos, enc, self.scope)
+            if el is None:
+                raise AutomergeError(f"splice: index {pos} out of bounds")
+            op = Op(
+                id=self._next_id(),
+                action=Action.DELETE,
+                value=ScalarValue.null(),
+                elem=el.elem_id,
+                pred=self._pred_for_elem(el),
+            )
+            self._apply(obj_id, op)
+        # Inserts chain off one another (reference inner.rs:672-683).
+        if values:
+            if pos == 0:
+                elem = HEAD
+            else:
+                el = self.doc.ops.nth(obj_id, pos - 1, enc, self.scope)
+                if el is None:
+                    raise AutomergeError(f"splice: index {pos} out of bounds")
+                elem = el.elem_id
+            for v in values:
+                op = Op(
+                    id=self._next_id(),
+                    action=Action.PUT,
+                    value=v,
+                    elem=elem,
+                    insert=True,
+                )
+                self._apply(obj_id, op)
+                elem = op.id
+
+    # -- marks -------------------------------------------------------------
+
+    def mark(self, obj: str, start: int, end: int, name: str, value, expand="after") -> None:
+        """Mark a span of a sequence (Peritext-style rich text)."""
+        self._check_open()
+        obj_id = self._obj(obj)
+        info = self.doc.ops.get_obj(obj_id)
+        if not isinstance(info.data, SeqObject):
+            raise AutomergeError("mark on a non-sequence object")
+        enc = self._encoding(info.data)
+        expand_start = expand in ("before", "both")
+        expand_end = expand in ("after", "both")
+        el_start = self.doc.ops.nth(obj_id, start, enc, self.scope)
+        if el_start is None:
+            raise AutomergeError(f"mark start {start} out of bounds")
+        # end is exclusive: anchor at the element before it
+        el_end = self.doc.ops.nth(obj_id, end - 1, enc, self.scope)
+        if el_end is None:
+            raise AutomergeError(f"mark end {end} out of bounds")
+        begin = Op(
+            id=self._next_id(),
+            action=Action.MARK,
+            value=ScalarValue.from_py(value),
+            elem=el_start.elem_id,
+            mark_name=name,
+            expand=expand_start,
+        )
+        self._apply(obj_id, begin)
+        end_op = Op(
+            id=self._next_id(),
+            action=Action.MARK,
+            value=ScalarValue.null(),
+            elem=el_end.elem_id,
+            mark_name=None,
+            expand=expand_end,
+        )
+        self._apply(obj_id, end_op)
+
+    def unmark(self, obj: str, start: int, end: int, name: str) -> None:
+        self.mark(obj, start, end, name, None, expand="none")
+
+    # -- commit / rollback -------------------------------------------------
+
+    def pending_ops(self) -> int:
+        return len(self.operations)
+
+    def commit(self) -> Optional[bytes]:
+        """Encode the pending ops as a change and append it to history."""
+        self._check_open()
+        self._done = True
+        if not self.operations and self.message is None:
+            return None
+        change = self._export_change()
+        applied = AppliedChange(
+            change, self.actor_idx, self._export_actor_map(change)
+        )
+        self.doc._update_history(applied)
+        return change.hash
+
+    def rollback(self) -> int:
+        self._check_open()
+        self._done = True
+        n = len(self.operations)
+        for obj_id, op in reversed(self.operations):
+            self.doc.ops.remove_op(obj_id, op)
+        self.operations = []
+        return n
+
+    def _export_change(self) -> StoredChange:
+        doc = self.doc
+        author = self.actor_idx
+        other: List[int] = []
+        seen = {author}
+        # collect actor refs (obj, elem, pred) for the chunk-local table
+        for obj_id, op in self.operations:
+            for a in self._op_actor_refs(obj_id, op):
+                if a not in seen:
+                    seen.add(a)
+                    other.append(a)
+        other.sort(key=lambda g: doc.actors.get(g).bytes)
+        local = {author: 0}
+        for j, g in enumerate(other):
+            local[g] = j + 1
+
+        def tr(opid: OpId) -> OpId:
+            return (opid[0], local[opid[1]])
+
+        ops = []
+        for obj_id, op in self.operations:
+            if op.key is not None:
+                key = Key.map(doc.props.get(op.key))
+            elif op.elem[0] == 0:
+                key = Key.seq(HEAD_STORED)
+            else:
+                key = Key.seq(tr(op.elem))
+            ops.append(
+                ChangeOp(
+                    obj=ROOT_STORED if obj_id == ROOT_OBJ else tr(obj_id),
+                    key=key,
+                    insert=op.insert,
+                    action=op.action,
+                    value=op.value,
+                    pred=[tr(p) for p in op.pred],
+                    expand=op.expand,
+                    mark_name=op.mark_name,
+                )
+            )
+        ts = self.timestamp if self.timestamp is not None else 0
+        return build_change(
+            StoredChange(
+                dependencies=list(self.deps),
+                actor=doc.actors.get(author).bytes,
+                other_actors=[doc.actors.get(g).bytes for g in other],
+                seq=self.seq,
+                start_op=self.start_op,
+                timestamp=ts,
+                message=self.message,
+                ops=ops,
+            )
+        )
+
+    def _export_actor_map(self, change: StoredChange) -> List[int]:
+        return [
+            self.doc.actors.cache(ActorId(a)) for a in change.actors
+        ]
+
+    def _op_actor_refs(self, obj_id: OpId, op: Op):
+        if obj_id != ROOT_OBJ:
+            yield obj_id[1]
+        if op.elem is not None and op.elem[0] != 0:
+            yield op.elem[1]
+        for p in op.pred:
+            yield p[1]
