@@ -18,7 +18,7 @@ from __future__ import annotations
 
 from typing import List, Optional
 
-from .core.document import Document, ROOT
+from .core.document import AutomergeError, Document, ROOT
 from .core.transaction import Transaction
 from .types import ActorId, ObjType
 
@@ -27,11 +27,22 @@ class AutoDoc:
     def __init__(self, actor: Optional[ActorId] = None, document: Optional[Document] = None):
         self.doc = document or Document(actor)
         self._tx: Optional[Transaction] = None
+        self._manual: Optional[Transaction] = None
         self._isolation: Optional[List[bytes]] = None
 
     # -- transaction management --------------------------------------------
 
+    def _check_manual(self) -> None:
+        if self._manual is not None:
+            if not self._manual._done:
+                raise AutomergeError(
+                    "a manual transaction is open; commit or roll it back "
+                    "before mutating through the document"
+                )
+            self._manual = None
+
     def _ensure_tx(self) -> Transaction:
+        self._check_manual()
         if self._tx is None:
             scope = None
             actor = self.doc.actor
@@ -53,7 +64,12 @@ class AutoDoc:
             tx.message = message
         if timestamp is not None:
             tx.timestamp = timestamp
-        return tx.commit()
+        h = tx.commit()
+        if h is not None and self._isolation is not None:
+            # isolated edits build on each other: advance the isolation
+            # point to the committed change (reference: autocommit isolate)
+            self._isolation = [h]
+        return h
 
     def rollback(self) -> int:
         tx = self._tx
@@ -64,9 +80,15 @@ class AutoDoc:
         return self._tx.pending_ops() if self._tx else 0
 
     def transaction(self, message=None, timestamp=None) -> Transaction:
-        """Open a manual transaction (commit/rollback is the caller's job)."""
+        """Open a manual transaction (commit/rollback is the caller's job).
+
+        While it is open, autocommit mutations on this document raise —
+        two live transactions would mint duplicate opids.
+        """
+        self._check_manual()
         self.commit()
-        return Transaction(self.doc, message=message, timestamp=timestamp)
+        self._manual = Transaction(self.doc, message=message, timestamp=timestamp)
+        return self._manual
 
     def isolate(self, heads: List[bytes]) -> None:
         """Scope subsequent edits to ``heads`` (reference: autocommit isolate)."""

@@ -19,6 +19,7 @@ from ..storage.change import (
     ROOT_STORED,
     StoredChange,
     build_change,
+    chunk_local_ops,
     parse_change,
 )
 from ..storage.chunk import (
@@ -685,41 +686,9 @@ def reconstruct_changes(doc: ParsedDocument, verify: bool = True) -> List[Stored
         if start_op < 1:
             raise AutomergeError("change start_op underflow")
         author = meta.actor
-        other: List[int] = []
-        other_set = set()
-        for op in ops:
-            for ref in _op_actor_refs(op):
-                if ref != author and ref not in other_set:
-                    other_set.add(ref)
-                    other.append(ref)
-        other.sort(key=lambda g: doc.actors[g])
-        local = {author: 0}
-        for j, g in enumerate(other):
-            local[g] = j + 1
-
-        def tr(opid: OpId) -> OpId:
-            return (opid[0], local[opid[1]])
-
-        change_ops = []
-        for op in ops:
-            if op.key.prop is not None:
-                key = op.key
-            elif op.key.elem[0] == 0:
-                key = Key.seq(HEAD_STORED)
-            else:
-                key = Key.seq(tr(op.key.elem))
-            change_ops.append(
-                ChangeOp(
-                    obj=ROOT_STORED if op.obj == ROOT_STORED else tr(op.obj),
-                    key=key,
-                    insert=op.insert,
-                    action=op.action,
-                    value=op.value,
-                    pred=[tr(p) for p in op.pred],
-                    expand=op.expand,
-                    mark_name=op.mark_name,
-                )
-            )
+        change_ops, other = chunk_local_ops(
+            ops, author, lambda g: doc.actors[g]
+        )
         deps = []
         for d in meta.deps:
             if d not in hash_by_index:
@@ -752,11 +721,3 @@ def reconstruct_changes(doc: ParsedDocument, verify: bool = True) -> List[Stored
         )
     return changes
 
-
-def _op_actor_refs(op: _ReOp):
-    if op.obj != ROOT_STORED:
-        yield op.obj[1]
-    if op.key.elem is not None and op.key.elem[0] != 0:
-        yield op.key.elem[1]
-    for p in op.pred:
-        yield p[1]

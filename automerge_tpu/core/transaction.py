@@ -13,7 +13,14 @@ from __future__ import annotations
 
 from typing import List, Optional, Tuple
 
-from ..storage.change import ChangeOp, HEAD_STORED, ROOT_STORED, StoredChange, build_change
+from ..storage.change import (
+    ChangeOp,
+    HEAD_STORED,
+    ROOT_STORED,
+    StoredChange,
+    build_change,
+    chunk_local_ops,
+)
 from ..types import (
     Action,
     ActorId,
@@ -374,42 +381,26 @@ class Transaction:
     def _export_change(self) -> StoredChange:
         doc = self.doc
         author = self.actor_idx
-        other: List[int] = []
-        seen = {author}
-        # collect actor refs (obj, elem, pred) for the chunk-local table
-        for obj_id, op in self.operations:
-            for a in self._op_actor_refs(obj_id, op):
-                if a not in seen:
-                    seen.add(a)
-                    other.append(a)
-        other.sort(key=lambda g: doc.actors.get(g).bytes)
-        local = {author: 0}
-        for j, g in enumerate(other):
-            local[g] = j + 1
-
-        def tr(opid: OpId) -> OpId:
-            return (opid[0], local[opid[1]])
-
-        ops = []
-        for obj_id, op in self.operations:
-            if op.key is not None:
-                key = Key.map(doc.props.get(op.key))
-            elif op.elem[0] == 0:
-                key = Key.seq(HEAD_STORED)
-            else:
-                key = Key.seq(tr(op.elem))
-            ops.append(
-                ChangeOp(
-                    obj=ROOT_STORED if obj_id == ROOT_OBJ else tr(obj_id),
-                    key=key,
-                    insert=op.insert,
-                    action=op.action,
-                    value=op.value,
-                    pred=[tr(p) for p in op.pred],
-                    expand=op.expand,
-                    mark_name=op.mark_name,
-                )
+        rows = [
+            ChangeOp(
+                obj=ROOT_STORED if obj_id == ROOT_OBJ else obj_id,
+                key=(
+                    Key.map(doc.props.get(op.key))
+                    if op.key is not None
+                    else Key.seq(op.elem)
+                ),
+                insert=op.insert,
+                action=op.action,
+                value=op.value,
+                pred=list(op.pred),
+                expand=op.expand,
+                mark_name=op.mark_name,
             )
+            for obj_id, op in self.operations
+        ]
+        ops, other = chunk_local_ops(
+            rows, author, lambda g: doc.actors.get(g).bytes
+        )
         ts = self.timestamp if self.timestamp is not None else 0
         return build_change(
             StoredChange(
@@ -429,10 +420,3 @@ class Transaction:
             self.doc.actors.cache(ActorId(a)) for a in change.actors
         ]
 
-    def _op_actor_refs(self, obj_id: OpId, op: Op):
-        if obj_id != ROOT_OBJ:
-            yield obj_id[1]
-        if op.elem is not None and op.elem[0] != 0:
-            yield op.elem[1]
-        for p in op.pred:
-            yield p[1]

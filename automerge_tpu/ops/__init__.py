@@ -1,0 +1,5 @@
+from .device_doc import DeviceDoc
+from .merge import merge_columns, merge_kernel
+from .oplog import OpLog
+
+__all__ = ["DeviceDoc", "OpLog", "merge_columns", "merge_kernel"]

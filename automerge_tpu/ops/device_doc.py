@@ -1,0 +1,224 @@
+"""DeviceDoc: read API over a kernel-resolved op log.
+
+The batched alternative to the host OpStore for N-way merges: build an
+OpLog from many replicas' changes, run ops/merge.py once on device, then
+answer reads (text/get/keys/length/hydrate) from the resolved columns.
+Mirrors the reference ReadDoc surface (reference: rust/automerge/src/
+read.rs:32-236) for the current-state case; historical ``*_at`` reads stay
+on the host document, which shares the same change history.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from ..types import ObjType, is_make_action, objtype_for_action
+from .merge import merge_columns
+from .oplog import OpLog, TAG_COUNTER
+
+_MAKE_OBJ = {0: ObjType.MAP, 2: ObjType.LIST, 4: ObjType.TEXT, 6: ObjType.TABLE}
+_OBJ_REPLACEMENT = "￼"
+_INCREMENT = 5
+
+
+class DeviceDoc:
+    def __init__(self, log: OpLog, res: Dict[str, np.ndarray]):
+        self.log = log
+        self.res = res
+        n = log.n
+        self.visible = res["visible"][:n]
+        self.winner = res["winner"][:n]
+        self.conflicts = res["conflicts"][:n]
+        self.elem_index = res["elem_index"][:n]
+        # exact int64 counter totals, host-side (the device kernel keeps the
+        # int32 fast path; reference counters are i64, value.rs:369)
+        self.counter_val = log.value_int.copy()
+        if len(log.pred_src):
+            mask = (log.action[log.pred_src] == _INCREMENT) & (log.pred_tgt >= 0)
+            np.add.at(
+                self.counter_val,
+                log.pred_tgt[mask],
+                log.value_int[log.pred_src[mask]],
+            )
+        # object id -> object type, from make ops (+ root)
+        self._obj_type: Dict[int, ObjType] = {0: ObjType.MAP}
+        for r in np.flatnonzero(np.isin(log.action[:n], (0, 2, 4, 6))):
+            self._obj_type[int(log.id_key[r])] = _MAKE_OBJ[int(log.action[r])]
+        # row ranges by object
+        order = np.argsort(log.obj_key[:n], kind="stable")
+        self._rows_by_obj = order.astype(np.int64)
+        self._obj_sorted = log.obj_key[:n][order]
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def merge(cls, docs: Sequence) -> "DeviceDoc":
+        """N-way fan-in merge of documents (AutoDoc or Document)."""
+        return cls.resolve(OpLog.from_documents(docs))
+
+    @classmethod
+    def resolve(cls, log: OpLog) -> "DeviceDoc":
+        return cls(log, merge_columns(log.padded_columns()))
+
+    # -- row selection ------------------------------------------------------
+
+    def _obj_rows(self, obj_key: int) -> np.ndarray:
+        lo = np.searchsorted(self._obj_sorted, obj_key, side="left")
+        hi = np.searchsorted(self._obj_sorted, obj_key, side="right")
+        return self._rows_by_obj[lo:hi]
+
+    def _check_obj(self, obj_key: int) -> ObjType:
+        t = self._obj_type.get(obj_key)
+        if t is None:
+            raise KeyError(f"no such object {self.log.export_id(obj_key)}")
+        return t
+
+    # -- value rendering ----------------------------------------------------
+
+    def _render(self, row: int):
+        a = int(self.log.action[row])
+        if is_make_action(a):
+            return (
+                "obj",
+                objtype_for_action(a),
+                self.log.export_id(int(self.log.id_key[row])),
+            )
+        if a == 1 and int(self.log.value_tag[row]) == TAG_COUNTER:
+            return ("counter", int(self.counter_val[row]))
+        return ("scalar", self.log.values[row])
+
+    # -- reads (mirror core/document.py) ------------------------------------
+
+    def object_type(self, obj: str) -> ObjType:
+        return self._check_obj(self.log.import_id(obj))
+
+    def keys(self, obj: str = "_root") -> List[str]:
+        ok = self.log.import_id(obj)
+        self._check_obj(ok)
+        rows = self._obj_rows(ok)
+        props = {
+            int(self.log.prop[r])
+            for r in rows
+            if self.log.prop[r] >= 0 and self.winner[r] >= 0
+        }
+        return sorted(self.log.props[p] for p in props)
+
+    def map_entries(self, obj: str = "_root") -> List[Tuple[str, object, str]]:
+        ok = self.log.import_id(obj)
+        self._check_obj(ok)
+        best: Dict[int, int] = {}
+        for r in self._obj_rows(ok):
+            p = int(self.log.prop[r])
+            if p >= 0 and self.winner[r] >= 0:
+                best[p] = int(self.winner[r])
+        out = [
+            (
+                self.log.props[p],
+                self._render(w),
+                self.log.export_id(int(self.log.id_key[w])),
+            )
+            for p, w in best.items()
+        ]
+        out.sort(key=lambda kv: kv[0])
+        return out
+
+    def _seq_elems(self, obj_key: int) -> List[Tuple[int, int]]:
+        """Visible elements of a sequence: [(elem_row, winner_row)] in order."""
+        elems = [
+            (int(self.elem_index[r]), int(r), int(self.winner[r]))
+            for r in self._obj_rows(obj_key)
+            if self.log.insert[r] and self.winner[r] >= 0 and self.elem_index[r] >= 0
+        ]
+        elems.sort()
+        return [(r, w) for _, r, w in elems]
+
+    def list_items(self, obj: str) -> List[Tuple[object, str]]:
+        ok = self.log.import_id(obj)
+        self._check_obj(ok)
+        return [
+            (self._render(w), self.log.export_id(int(self.log.id_key[w])))
+            for _, w in self._seq_elems(ok)
+        ]
+
+    def text(self, obj: str) -> str:
+        ok = self.log.import_id(obj)
+        self._check_obj(ok)
+        parts = []
+        for _, w in self._seq_elems(ok):
+            v = self.log.values[w]
+            parts.append(v.value if v.tag == "str" else _OBJ_REPLACEMENT)
+        return "".join(parts)
+
+    def length(self, obj: str = "_root") -> int:
+        ok = self.log.import_id(obj)
+        t = self._check_obj(ok)
+        if t in (ObjType.MAP, ObjType.TABLE):
+            return len(self.keys(obj))
+        dense = int(np.searchsorted(self.log.obj_table, ok))
+        if t == ObjType.TEXT:
+            return int(self.res["obj_text_width"][dense])
+        return int(self.res["obj_vis_len"][dense])
+
+    def get_all(self, obj: str, prop) -> List[Tuple[object, str]]:
+        ok = self.log.import_id(obj)
+        t = self._check_obj(ok)
+        rows = self._obj_rows(ok)
+        if isinstance(prop, str):
+            if t not in (ObjType.MAP, ObjType.TABLE):
+                raise ValueError("map lookup requires a map object")
+            try:
+                p = self.log.props.index(prop)
+            except ValueError:
+                return []
+            vis = [int(r) for r in rows if int(self.log.prop[r]) == p and self.visible[r]]
+        else:
+            elems = self._seq_elems(ok)
+            if not 0 <= prop < len(elems):
+                return []
+            er = elems[prop][0]
+            vis = [
+                int(r)
+                for r in rows
+                if self.visible[r]
+                and (
+                    (self.log.insert[r] and int(r) == er)
+                    or (not self.log.insert[r] and int(self.log.elem_ref[r]) == er)
+                )
+            ]
+        vis.sort()  # rows are in Lamport order; winner last
+        return [
+            (self._render(r), self.log.export_id(int(self.log.id_key[r])))
+            for r in vis
+        ]
+
+    def get(self, obj: str, prop):
+        vals = self.get_all(obj, prop)
+        return vals[-1] if vals else None
+
+    # -- materialization ----------------------------------------------------
+
+    def hydrate(self, obj: str = "_root"):
+        return self._hydrate(self.log.import_id(obj))
+
+    def _hydrate(self, obj_key: int):
+        t = self._check_obj(obj_key)
+        if t in (ObjType.MAP, ObjType.TABLE):
+            return {
+                name: self._hydrate_val(val)
+                for name, val, _ in self.map_entries(self.log.export_id(obj_key))
+            }
+        if t == ObjType.TEXT:
+            return self.text(self.log.export_id(obj_key))
+        return [
+            self._hydrate_val(self._render(w)) for _, w in self._seq_elems(obj_key)
+        ]
+
+    def _hydrate_val(self, rendered):
+        kind = rendered[0]
+        if kind == "obj":
+            return self._hydrate(self.log.import_id(rendered[2]))
+        if kind == "counter":
+            return rendered[1]
+        return rendered[1].to_py()

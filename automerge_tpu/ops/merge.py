@@ -1,0 +1,243 @@
+"""The batched causal-resolution merge kernel (the north star).
+
+Replaces the reference's sequential per-op seek/insert loop
+(reference: rust/automerge/src/automerge.rs:1258-1280, op_tree.rs:212-239)
+with one jit-compiled pass over the whole op log:
+
+  1. succ resolution     — pred references (pre-resolved to row indices by
+                           the host columnizer) scatter-added into per-op
+                           succ / increment counters (batched ``add_succ``,
+                           op_set.rs:194-203).
+  2. visibility          — op visible iff it has no non-increment successor
+                           (counters) / no successor at all (everything
+                           else); deletes, increments and marks are never
+                           visible (types.rs:712-744).
+  3. per-key winners     — lexsort by (obj, key, row) + segmented reductions
+                           give the winning op and conflict count for every
+                           map prop and list-element run (vectorized
+                           ``TopOps``, iter/top_ops.rs:44-103). Rows are in
+                           Lamport order, so "max row" is "max Lamport".
+  4. RGA linearization   — insert ops form a forest (parent = reference
+                           element, siblings ordered by descending Lamport
+                           id, query/insert.rs); document order is its
+                           preorder traversal, computed with pointer-doubling
+                           successor threading + Wyllie list ranking: two
+                           O(log n)-step gather loops instead of a pointer
+                           walk.
+
+Everything is int32 with static power-of-two shapes: no 64-bit emulation on
+TPU, one compiled kernel per capacity bucket, and the hot work is sorts,
+gathers and segmented reductions — shapes XLA maps well onto the VPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .oplog import ELEM_HEAD, PAD_ACTION, TAG_COUNTER
+
+_DELETE = 3
+_INCREMENT = 5
+_MARK = 7
+_PUT = 1
+
+NONE32 = jnp.int32(-1)
+
+
+def _ceil_log2(n: int) -> int:
+    return max(1, int(n - 1).bit_length())
+
+
+@jax.jit
+def merge_kernel(c):
+    """Resolve a padded column dict (see OpLog.padded_columns) to doc state.
+
+    Returns a dict of device arrays (all int32/bool, per-row unless noted):
+      visible      — op currently visible
+      counter_inc  — summed increment payloads landing on this op
+      winner       — row of the winning visible op of this row's key group
+                     (-1 if none visible)
+      conflicts    — number of visible ops in this row's key group
+      elem_index   — document-order position of this insert op among its
+                     object's elements (-1 for non-inserts)
+      obj_vis_len  — per dense-object visible element count   [indexed by
+      obj_text_width — per dense-object visible text width     obj_dense]
+      succ_count / inc_count — successor bookkeeping (patches/debug)
+    """
+    P = c["action"].shape[0]
+    rows = jnp.arange(P, dtype=jnp.int32)
+    action = c["action"]
+    valid = action != PAD_ACTION
+    insert = c["insert"]
+    elem_ref = c["elem_ref"]
+    obj_dense = c["obj_dense"]
+
+    # --- 1. succ resolution ------------------------------------------------
+    tgt = c["pred_tgt"]
+    hit = tgt >= 0
+    src = c["pred_src"]
+    src_is_inc = action[src] == _INCREMENT
+    tgt_c = jnp.where(hit, tgt, 0)
+    one = jnp.ones_like(tgt_c)
+    succ_count = jnp.zeros(P, jnp.int32).at[tgt_c].add(
+        jnp.where(hit & ~src_is_inc, one, 0)
+    )
+    inc_count = jnp.zeros(P, jnp.int32).at[tgt_c].add(
+        jnp.where(hit & src_is_inc, one, 0)
+    )
+    counter_inc = jnp.zeros(P, jnp.int32).at[tgt_c].add(
+        jnp.where(hit & src_is_inc, c["value_i32"][src], 0)
+    )
+
+    # --- 2. visibility -----------------------------------------------------
+    never = (action == _DELETE) | (action == _INCREMENT) | (action == _MARK)
+    is_counter = (action == _PUT) & (c["value_tag"] == TAG_COUNTER)
+    # counter puts survive increment successors (types.rs:712-720)
+    visible = (
+        valid
+        & ~never
+        & jnp.where(is_counter, succ_count == 0, (succ_count + inc_count) == 0)
+    )
+
+    # --- 3. per-key winners ------------------------------------------------
+    is_map = c["prop"] >= 0
+    # an insert op heads its own element run; updates/deletes name the run
+    # they target via their (row-resolved) elem reference
+    run_key = jnp.where(insert, rows, elem_ref)
+    g_obj = jnp.where(valid, obj_dense, jnp.int32(P))
+    g_kind = is_map.astype(jnp.int32)
+    g_key = jnp.where(is_map, c["prop"], run_key)
+    sort_idx = jnp.lexsort((rows, g_key, g_kind, g_obj)).astype(jnp.int32)
+    g_obj_s = g_obj[sort_idx]
+    g_kind_s = g_kind[sort_idx]
+    g_key_s = g_key[sort_idx]
+    newseg = jnp.concatenate(
+        [
+            jnp.array([True]),
+            (g_obj_s[1:] != g_obj_s[:-1])
+            | (g_kind_s[1:] != g_kind_s[:-1])
+            | (g_key_s[1:] != g_key_s[:-1]),
+        ]
+    )
+    seg = (jnp.cumsum(newseg) - 1).astype(jnp.int32)
+    vis_s = visible[sort_idx]
+    cand = jnp.where(vis_s, jnp.arange(P, dtype=jnp.int32), NONE32)
+    win_pos = jax.ops.segment_max(cand, seg, num_segments=P)
+    seg_vis = jax.ops.segment_sum(vis_s.astype(jnp.int32), seg, num_segments=P)
+    win_row = jnp.where(win_pos >= 0, sort_idx[jnp.clip(win_pos, 0, P - 1)], NONE32)
+    seg_of_row = jnp.zeros(P, jnp.int32).at[sort_idx].set(seg)
+    winner = win_row[seg_of_row]
+    conflicts = seg_vis[seg_of_row]
+
+    # --- 4. RGA linearization ---------------------------------------------
+    # node space: [0,P) element nodes (insert rows), [P,2P+2) object roots
+    # (indexed P + obj_dense), sentinel S terminates every chain
+    N = 2 * P + 3
+    S = jnp.int32(N - 1)
+    is_elem = insert & valid
+    root_of_row = P + obj_dense
+    parent_row = jnp.where(
+        is_elem,
+        jnp.where(elem_ref == ELEM_HEAD, root_of_row, jnp.where(elem_ref >= 0, elem_ref, S)),
+        S,
+    ).astype(jnp.int32)
+
+    # sibling sort: children of one parent contiguous, descending Lamport
+    # (= descending row, query/insert.rs lamport tie-breaking)
+    sib_parent = jnp.where(is_elem, parent_row, jnp.int32(N))
+    sib_idx = jnp.lexsort((-rows, sib_parent)).astype(jnp.int32)
+    sp_s = sib_parent[sib_idx]
+    elem_cnt = jnp.sum(is_elem.astype(jnp.int32))
+    pos32 = jnp.arange(P, dtype=jnp.int32)
+    in_range = pos32 < elem_cnt
+
+    # first child node per parent (min sorted position per parent)
+    parents_pad = jnp.where(in_range, sp_s, N - 1)
+    big = jnp.int32(P)
+    fc_pos = (
+        jnp.full(N, big, jnp.int32)
+        .at[parents_pad]
+        .min(jnp.where(in_range, pos32, big))
+    )
+    first_child = jnp.where(fc_pos < P, sib_idx[jnp.clip(fc_pos, 0, P - 1)], NONE32)
+    # next sibling per element node
+    nxt_same = jnp.concatenate([sp_s[1:] == sp_s[:-1], jnp.array([False])])
+    nxt_row = jnp.concatenate([sib_idx[1:], jnp.array([-1], jnp.int32)])
+    next_sib = (
+        jnp.full(N, NONE32, jnp.int32)
+        .at[jnp.where(in_range, sib_idx, N - 1)]
+        .set(jnp.where(nxt_same & in_range, nxt_row, NONE32))
+    )
+
+    # A(i): next sibling of i, else of nearest ancestor (threaded successor),
+    # resolved by pointer doubling over the parent chain
+    node_parent = (
+        jnp.full(N, S, jnp.int32)
+        .at[jnp.where(is_elem, rows, N - 1)]
+        .set(jnp.where(is_elem, parent_row, S))
+    )
+    node_is_elem = (
+        jnp.zeros(N, jnp.bool_)
+        .at[jnp.where(is_elem, rows, N - 1)]
+        .set(is_elem)
+    )
+    has_sib = next_sib != NONE32
+    done = has_sib | ~node_is_elem  # roots & sentinel resolve to END (-1)
+    ans = jnp.where(has_sib & node_is_elem, next_sib, NONE32)
+    jump = node_parent
+
+    def _thread(_, st):
+        ans, done, jump = st
+        take = (~done) & done[jump]
+        ans = jnp.where(take, ans[jump], ans)
+        done = done | take
+        jump = jump[jump]
+        return ans, done, jump
+
+    ans, done, jump = jax.lax.fori_loop(
+        0, _ceil_log2(N) + 1, _thread, (ans, done, jump)
+    )
+
+    # preorder successor: first child, else A(i); Wyllie ranking gives the
+    # distance to the chain end, hence the document-order index
+    succ_node = jnp.where(first_child != NONE32, first_child, ans)
+    nxt = jnp.where(succ_node < 0, S, succ_node)
+    nxt = nxt.at[S].set(S)
+    dist = jnp.where(jnp.arange(N, dtype=jnp.int32) == S, 0, 1).astype(jnp.int32)
+
+    def _rank(_, st):
+        dist, nxt = st
+        return dist + dist[nxt], nxt[nxt]
+
+    dist, nxt = jax.lax.fori_loop(0, _ceil_log2(N) + 1, _rank, (dist, nxt))
+    elem_index = jnp.where(is_elem, dist[root_of_row] - dist[rows] - 1, NONE32)
+
+    # --- per-object stats --------------------------------------------------
+    elem_vis = is_elem & (winner >= 0)
+    obj_idx = jnp.where(valid, obj_dense, jnp.int32(P + 1))
+    obj_vis_len = jax.ops.segment_sum(
+        elem_vis.astype(jnp.int32), obj_idx, num_segments=P + 2
+    )
+    w_width = jnp.where(elem_vis, c["width"][jnp.clip(winner, 0, P - 1)], 0)
+    obj_text_width = jax.ops.segment_sum(w_width, obj_idx, num_segments=P + 2)
+
+    return {
+        "visible": visible,
+        "counter_inc": counter_inc,
+        "winner": winner,
+        "conflicts": conflicts,
+        "elem_index": elem_index,
+        "obj_vis_len": obj_vis_len,
+        "obj_text_width": obj_text_width,
+        "succ_count": succ_count,
+        "inc_count": inc_count,
+    }
+
+
+def merge_columns(cols_np):
+    """Host entry: numpy columns in, numpy resolution out (blocks on device)."""
+    cols = {k: jnp.asarray(v) for k, v in cols_np.items()}
+    out = merge_kernel(cols)
+    return {k: np.asarray(v) for k, v in out.items()}

@@ -1,0 +1,319 @@
+"""Columnar op-log: the device representation of a document's op set.
+
+The reference's *storage* format (rust/automerge/src/storage/document/
+doc_op_columns.rs — obj/key/id/insert/action/val/succ columns) is the
+blueprint for this layout, not its in-memory B-tree: ops live as a
+struct-of-arrays so an entire multi-replica merge is a handful of sorts,
+scatters and segmented reductions on device (see ops/merge.py).
+
+Lamport order (reference: types.rs:517-521) compares (counter, actor-bytes).
+The host flattens changes, ranks actors by byte order, packs every OpId into
+an int64 ``counter << ACTOR_BITS | actor_rank`` key, and **sorts the whole
+log by that key once** — after which the row index itself is a dense int32
+Lamport rank. All cross-op references (pred targets, RGA reference elements,
+containing objects) are resolved to row indices host-side with vectorized
+searchsorted, so the device kernel is pure int32: no 64-bit emulation on
+TPU, no device-side joins, comparisons are plain row-index comparisons.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+import numpy as np
+
+from ..storage.change import StoredChange
+from ..types import ActorId, ScalarValue
+
+# Up to 2^20 distinct actors per merged log; counters up to 2^43.
+ACTOR_BITS = 20
+ACTOR_MASK = (1 << ACTOR_BITS) - 1
+PAD_ACTION = 15
+
+# elem_ref sentinels (column is an int32 row index otherwise)
+ELEM_HEAD = -1  # insert at list HEAD
+ELEM_MAP = -2  # a map op (no element reference)
+ELEM_MISSING = -3  # reference element not in this log
+
+# value_tag codes (aligned with storage value-metadata type codes where
+# they exist; reference: value.rs ValueType)
+TAG_NULL = 0
+TAG_FALSE = 1
+TAG_TRUE = 2
+TAG_UINT = 3
+TAG_INT = 4
+TAG_F64 = 5
+TAG_STR = 6
+TAG_BYTES = 7
+TAG_COUNTER = 8
+TAG_TIMESTAMP = 9
+TAG_UNKNOWN = 10
+
+_TAG_FOR = {
+    "null": TAG_NULL,
+    "uint": TAG_UINT,
+    "int": TAG_INT,
+    "f64": TAG_F64,
+    "str": TAG_STR,
+    "bytes": TAG_BYTES,
+    "counter": TAG_COUNTER,
+    "timestamp": TAG_TIMESTAMP,
+    "unknown": TAG_UNKNOWN,
+}
+
+
+def pack_id(ctr: int, rank: int) -> int:
+    return (int(ctr) << ACTOR_BITS) | int(rank)
+
+
+def unpack_id(key: int) -> Tuple[int, int]:
+    return int(key) >> ACTOR_BITS, int(key) & ACTOR_MASK
+
+
+class OpLog:
+    """A merged, deduplicated change set flattened into Lamport-ordered
+    op columns.
+
+    Host-side (int64/object) state: ``id_key`` packed op ids, ``obj_key``
+    packed object ids, the ``values`` heap, actor/prop tables. Device-facing
+    int32 columns: action/insert/prop/value_tag/value_i32/width plus
+    resolved references ``elem_ref``, ``obj_dense``, ``pred_src``/
+    ``pred_tgt`` (see padded_columns).
+    """
+
+    __slots__ = (
+        "actors",
+        "props",
+        "values",
+        "changes",
+        "mark_names",
+        "n",
+        "n_objs",
+        "id_key",
+        "obj_key",
+        "obj_table",
+        "obj_dense",
+        "prop",
+        "elem_ref",
+        "action",
+        "insert",
+        "value_tag",
+        "value_int",
+        "width",
+        "pred_src",
+        "pred_tgt",
+        "expand",
+        "mark_name_idx",
+    )
+
+    def __init__(self):
+        self.actors: List[ActorId] = []
+        self.props: List[str] = []
+        self.values: List[ScalarValue] = []
+        self.changes: List[StoredChange] = []
+        self.mark_names: List[str] = []
+        self.n = 0
+        self.n_objs = 1
+
+    # -- construction --------------------------------------------------
+
+    @classmethod
+    def from_changes(cls, changes: Iterable[StoredChange]) -> "OpLog":
+        """Flatten changes (deduped by hash) into Lamport-ordered columns.
+
+        Order-independent: visibility and RGA order depend only on op ids
+        and pred links, never on application order — which is what makes the
+        N-way fan-in merge a single batched kernel instead of the
+        reference's per-op seek/insert loop (automerge.rs:1258-1280).
+        """
+        log = cls()
+        seen = set()
+        deduped: List[StoredChange] = []
+        actor_bytes = set()
+        for ch in changes:
+            if ch.hash in seen:
+                continue
+            seen.add(ch.hash)
+            deduped.append(ch)
+            for a in ch.actors:
+                actor_bytes.add(bytes(a))
+        log.changes = deduped
+        ranked = sorted(actor_bytes)
+        rank_of = {a: i for i, a in enumerate(ranked)}
+        log.actors = [ActorId(a) for a in ranked]
+        if len(ranked) >= (1 << ACTOR_BITS):
+            raise ValueError("too many actors for packed id encoding")
+
+        prop_of: Dict[str, int] = {}
+        mark_of: Dict[str, int] = {}
+        id_key, obj, prop, elem = [], [], [], []
+        action, insert, vtag, vint, width = [], [], [], [], []
+        pred_src, pred_key = [], []
+        expand, mark_idx = [], []
+        values: List[ScalarValue] = []
+
+        for ch in deduped:
+            ranks = [rank_of[bytes(a)] for a in ch.actors]
+            author = ranks[0]
+            for i, cop in enumerate(ch.ops):
+                row = len(id_key)
+                id_key.append(pack_id(ch.start_op + i, author))
+                if cop.obj[0] == 0:
+                    obj.append(0)
+                else:
+                    obj.append(pack_id(cop.obj[0], ranks[cop.obj[1]]))
+                if cop.key.prop is not None:
+                    prop.append(prop_of.setdefault(cop.key.prop, len(prop_of)))
+                    elem.append(-1)
+                else:
+                    e = cop.key.elem
+                    prop.append(-1)
+                    elem.append(0 if e[0] == 0 else pack_id(e[0], ranks[e[1]]))
+                action.append(int(cop.action))
+                insert.append(bool(cop.insert))
+                v = cop.value
+                vtag.append(_value_tag(v))
+                vint.append(_int_payload(v))
+                values.append(v)
+                width.append(len(v.value) if v.tag == "str" else 1)
+                for pc, pa in cop.pred:
+                    pred_src.append(row)
+                    pred_key.append(pack_id(pc, ranks[pa]))
+                expand.append(bool(cop.expand))
+                if cop.mark_name is not None:
+                    mark_idx.append(mark_of.setdefault(cop.mark_name, len(mark_of)))
+                else:
+                    mark_idx.append(-1)
+
+        log.props = [p for p, _ in sorted(prop_of.items(), key=lambda kv: kv[1])]
+        log.mark_names = [m for m, _ in sorted(mark_of.items(), key=lambda kv: kv[1])]
+        n = len(id_key)
+        log.n = n
+
+        id_key = np.asarray(id_key, np.int64)
+        # one argsort makes row index == dense Lamport rank
+        order = np.argsort(id_key, kind="stable")
+        log.id_key = id_key[order]
+        obj = np.asarray(obj, np.int64)[order]
+        log.obj_key = obj
+        log.prop = np.asarray(prop, np.int32)[order]
+        elem = np.asarray(elem, np.int64)[order]
+        log.action = np.asarray(action, np.int32)[order]
+        log.insert = np.asarray(insert, np.bool_)[order]
+        log.value_tag = np.asarray(vtag, np.int32)[order]
+        log.value_int = np.asarray(vint, np.int64)[order]
+        log.width = np.asarray(width, np.int32)[order]
+        log.expand = np.asarray(expand, np.bool_)[order]
+        log.mark_name_idx = np.asarray(mark_idx, np.int32)[order]
+        log.values = [values[i] for i in order]
+
+        # resolve cross-op references to row indices (vectorized joins)
+        inv = np.empty(n, np.int32)  # old row -> new row
+        inv[order] = np.arange(n, dtype=np.int32)
+
+        def rows_of(keys: np.ndarray, missing: int) -> np.ndarray:
+            pos = np.searchsorted(log.id_key, keys)
+            posc = np.clip(pos, 0, max(n - 1, 0)).astype(np.int32)
+            hit = (log.id_key[posc] == keys) if n else np.zeros(len(keys), bool)
+            return np.where(hit, posc, np.int32(missing)).astype(np.int32)
+
+        # element references: HEAD=-1, map op=-2, missing=-3
+        log.elem_ref = np.where(
+            log.prop >= 0,
+            np.int32(ELEM_MAP),
+            np.where(elem == 0, np.int32(ELEM_HEAD), rows_of(elem, ELEM_MISSING)),
+        ).astype(np.int32)
+
+        # dense object ids: 0 = root, then by packed object id order
+        log.obj_table = np.unique(np.concatenate([[0], obj]))
+        log.n_objs = len(log.obj_table)
+        log.obj_dense = np.searchsorted(log.obj_table, obj).astype(np.int32)
+
+        # pred references -> (src row, tgt row) pairs
+        pred_src = np.asarray(pred_src, np.int64)
+        pred_key = np.asarray(pred_key, np.int64)
+        log.pred_src = inv[pred_src] if len(pred_src) else np.empty(0, np.int32)
+        tgt = rows_of(pred_key, -1) if len(pred_key) else np.empty(0, np.int32)
+        log.pred_tgt = tgt.astype(np.int32)
+        return log
+
+    @classmethod
+    def from_documents(cls, docs: Sequence) -> "OpLog":
+        """Union of several documents' histories (the N-way fan-in input)."""
+        changes: List[StoredChange] = []
+        for d in docs:
+            doc = getattr(d, "doc", d)  # AutoDoc or Document
+            changes.extend(a.stored for a in doc.history)
+        return cls.from_changes(changes)
+
+    # -- device prep -----------------------------------------------------
+
+    def padded_columns(self, min_capacity: int = 16):
+        """Pad to power-of-two capacities for shape-stable jit.
+
+        Everything is int32/bool — deliberately: int64 is emulated on TPU.
+        Counter payloads are truncated to int32 on device (exact int64
+        totals are recovered host-side from ``value_int`` when needed).
+        """
+        p = _next_pow2(max(self.n, min_capacity))
+        q = _next_pow2(max(len(self.pred_src), min_capacity))
+        return {
+            "action": _pad(self.action, p, PAD_ACTION),
+            "insert": _pad(self.insert, p, False),
+            "prop": _pad(self.prop, p, -1),
+            "elem_ref": _pad(self.elem_ref, p, ELEM_MAP),
+            "obj_dense": _pad(self.obj_dense, p, np.int32(self.n_objs)),
+            "value_tag": _pad(self.value_tag, p, TAG_NULL),
+            "value_i32": _pad(self.value_int.astype(np.int32), p, 0),
+            "width": _pad(self.width, p, 0),
+            "pred_src": _pad(self.pred_src, q, 0),
+            "pred_tgt": _pad(self.pred_tgt, q, -1),
+        }
+
+    # -- host-side id helpers ---------------------------------------------
+
+    def export_id(self, key: int) -> str:
+        if key == 0:
+            return "_root"
+        ctr, rank = unpack_id(key)
+        return f"{ctr}@{self.actors[rank].to_hex()}"
+
+    def import_id(self, exid: str) -> int:
+        if exid == "_root":
+            return 0
+        ctr_s, actor_hex = exid.split("@", 1)
+        target = bytes.fromhex(actor_hex)
+        for rank, a in enumerate(self.actors):
+            if a.bytes == target:
+                return pack_id(int(ctr_s), rank)
+        raise KeyError(f"unknown actor in id {exid!r}")
+
+    def row_of_id(self, key: int) -> int:
+        pos = int(np.searchsorted(self.id_key, key))
+        if pos < self.n and self.id_key[pos] == key:
+            return pos
+        raise KeyError(f"no op with id {self.export_id(key)}")
+
+
+def _value_tag(v: ScalarValue) -> int:
+    if v.tag == "bool":
+        return TAG_TRUE if v.value else TAG_FALSE
+    return _TAG_FOR.get(v.tag, TAG_UNKNOWN)
+
+
+def _int_payload(v: ScalarValue) -> int:
+    if v.tag in ("int", "uint", "counter", "timestamp"):
+        return int(v.value)
+    if v.tag == "bool":
+        return int(v.value)
+    return 0
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << (n - 1).bit_length()
+
+
+def _pad(a: np.ndarray, size: int, fill) -> np.ndarray:
+    out = np.full(size, fill, dtype=a.dtype)
+    out[: len(a)] = a
+    return out

@@ -371,3 +371,60 @@ def parse_change(buf: bytes, pos: int = 0) -> tuple[StoredChange, int]:
         raw = bytes(buf[pos:end])
     change = parse_change_data(chunk.data, chunk.hash, raw)
     return change, end
+
+
+def chunk_local_ops(rows, author, actor_bytes_of):
+    """Translate ops with *global* actor indices into chunk-local ChangeOps.
+
+    Builds the chunk-local actor table — author first, remaining referenced
+    actors sorted by their bytes (reference: change/change_actors.rs) — and
+    rewrites obj / elem / pred references through it. ``rows`` are ChangeOp-
+    shaped records whose OpIds carry global indices; ``actor_bytes_of`` maps
+    a global index to actor bytes. Returns (chunk_ops, other_global_indices).
+
+    This is the single encoder shared by transaction commit and document
+    save/reconstruct so both always produce byte-identical change chunks for
+    the same logical change.
+    """
+    other: List[int] = []
+    seen = {author}
+    for r in rows:
+        refs = []
+        if r.obj != ROOT_STORED:
+            refs.append(r.obj[1])
+        if r.key.elem is not None and r.key.elem[0] != 0:
+            refs.append(r.key.elem[1])
+        refs.extend(p[1] for p in r.pred)
+        for a in refs:
+            if a not in seen:
+                seen.add(a)
+                other.append(a)
+    other.sort(key=actor_bytes_of)
+    local = {author: 0}
+    for j, g in enumerate(other):
+        local[g] = j + 1
+
+    def tr(opid: OpId) -> OpId:
+        return (opid[0], local[opid[1]])
+
+    ops = []
+    for r in rows:
+        if r.key.prop is not None:
+            key = r.key
+        elif r.key.elem[0] == 0:
+            key = Key.seq(HEAD_STORED)
+        else:
+            key = Key.seq(tr(r.key.elem))
+        ops.append(
+            ChangeOp(
+                obj=ROOT_STORED if r.obj == ROOT_STORED else tr(r.obj),
+                key=key,
+                insert=r.insert,
+                action=r.action,
+                value=r.value,
+                pred=[tr(p) for p in r.pred],
+                expand=r.expand,
+                mark_name=r.mark_name,
+            )
+        )
+    return ops, other
