@@ -50,9 +50,34 @@ def _ceil_log2(n: int) -> int:
     return max(1, int(n - 1).bit_length())
 
 
-@jax.jit
-def merge_kernel(c):
-    """Resolve a padded column dict (see OpLog.padded_columns) to doc state.
+def succ_resolution(c):
+    """Phase 1: pred scatter -> per-op succ/inc counters (batched add_succ).
+
+    The bandwidth-heavy phase; parallel/sharding.py shards the pred stream
+    across a device mesh and psums these partial counters.
+    """
+    P = c["action"].shape[0]
+    action = c["action"]
+    tgt = c["pred_tgt"]
+    hit = tgt >= 0
+    src = c["pred_src"]
+    src_is_inc = action[src] == _INCREMENT
+    tgt_c = jnp.where(hit, tgt, 0)
+    one = jnp.ones_like(tgt_c)
+    succ_count = jnp.zeros(P, jnp.int32).at[tgt_c].add(
+        jnp.where(hit & ~src_is_inc, one, 0)
+    )
+    inc_count = jnp.zeros(P, jnp.int32).at[tgt_c].add(
+        jnp.where(hit & src_is_inc, one, 0)
+    )
+    counter_inc = jnp.zeros(P, jnp.int32).at[tgt_c].add(
+        jnp.where(hit & src_is_inc, c["value_i32"][src], 0)
+    )
+    return succ_count, inc_count, counter_inc
+
+
+def resolve_state(c, succ_count, inc_count, counter_inc):
+    """Phases 2-4: visibility, per-key winners, RGA linearization.
 
     Returns a dict of device arrays (all int32/bool, per-row unless noted):
       visible      — op currently visible
@@ -73,23 +98,6 @@ def merge_kernel(c):
     insert = c["insert"]
     elem_ref = c["elem_ref"]
     obj_dense = c["obj_dense"]
-
-    # --- 1. succ resolution ------------------------------------------------
-    tgt = c["pred_tgt"]
-    hit = tgt >= 0
-    src = c["pred_src"]
-    src_is_inc = action[src] == _INCREMENT
-    tgt_c = jnp.where(hit, tgt, 0)
-    one = jnp.ones_like(tgt_c)
-    succ_count = jnp.zeros(P, jnp.int32).at[tgt_c].add(
-        jnp.where(hit & ~src_is_inc, one, 0)
-    )
-    inc_count = jnp.zeros(P, jnp.int32).at[tgt_c].add(
-        jnp.where(hit & src_is_inc, one, 0)
-    )
-    counter_inc = jnp.zeros(P, jnp.int32).at[tgt_c].add(
-        jnp.where(hit & src_is_inc, c["value_i32"][src], 0)
-    )
 
     # --- 2. visibility -----------------------------------------------------
     never = (action == _DELETE) | (action == _INCREMENT) | (action == _MARK)
@@ -234,6 +242,12 @@ def merge_kernel(c):
         "succ_count": succ_count,
         "inc_count": inc_count,
     }
+
+
+@jax.jit
+def merge_kernel(c):
+    """Single-device merge: succ resolution + state resolution in one jit."""
+    return resolve_state(c, *succ_resolution(c))
 
 
 def merge_columns(cols_np):

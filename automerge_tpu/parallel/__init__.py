@@ -1,0 +1,3 @@
+from .sharding import default_mesh, make_sharded_merge, sharded_merge_columns
+
+__all__ = ["default_mesh", "make_sharded_merge", "sharded_merge_columns"]

