@@ -217,6 +217,25 @@ class AutoDoc:
         idxs = self.doc.states.get(self.doc.actors.lookup(self.doc.actor), [])
         return self.doc.history[idxs[-1]].stored if idxs else None
 
+    # -- sync ---------------------------------------------------------------
+
+    def generate_sync_message(self, state):
+        """Next sync message for the peer tracked by ``state`` (or None).
+
+        Commits any open transaction first (reference: autocommit.rs sync
+        adapter).
+        """
+        from .sync import generate_sync_message
+
+        self.commit()
+        return generate_sync_message(self.doc, state)
+
+    def receive_sync_message(self, state, message) -> None:
+        from .sync import receive_sync_message
+
+        self.commit()
+        receive_sync_message(self.doc, state, message)
+
     # -- save / load -------------------------------------------------------
 
     def save(self, deflate: bool = True) -> bytes:

@@ -1,0 +1,21 @@
+from .bloom import BloomFilter
+from .protocol import (
+    Have,
+    Message,
+    SyncError,
+    SyncState,
+    generate_sync_message,
+    receive_sync_message,
+    sync,
+)
+
+__all__ = [
+    "BloomFilter",
+    "Have",
+    "Message",
+    "SyncError",
+    "SyncState",
+    "generate_sync_message",
+    "receive_sync_message",
+    "sync",
+]

@@ -1,0 +1,261 @@
+"""Sync protocol: convergence, wire codecs, bloom behavior, state reuse.
+
+Mirrors the reference's in-process sync tests (reference:
+rust/automerge/src/sync.rs doctests, javascript/test/sync_test.ts): peers
+are values in one process and messages are shuttled as bytes — no
+transport needed.
+"""
+
+import random
+
+import pytest
+
+from automerge_tpu.api import AutoDoc
+from automerge_tpu.sync import (
+    BloomFilter,
+    Have,
+    Message,
+    SyncState,
+    generate_sync_message,
+    receive_sync_message,
+    sync,
+)
+from automerge_tpu.types import ActorId, ObjType, ScalarValue
+
+
+def actor(i):
+    return ActorId(bytes([i]) * 16)
+
+
+def sync_autodocs(a, b, sa=None, sb=None):
+    a.commit()
+    b.commit()
+    return sync(a.doc, b.doc, sa, sb)
+
+
+def test_empty_docs_converge_immediately():
+    a = AutoDoc(actor=actor(1))
+    b = AutoDoc(actor=actor(2))
+    sync_autodocs(a, b)
+    assert a.get_heads() == b.get_heads() == []
+
+
+def test_one_sided_catchup():
+    a = AutoDoc(actor=actor(1))
+    t = a.put_object("_root", "t", ObjType.TEXT)
+    a.splice_text(t, 0, 0, "hello sync")
+    a.commit()
+    b = AutoDoc(actor=actor(2))
+    sync_autodocs(a, b)
+    assert b.get_heads() == a.get_heads()
+    assert b.text(t) == "hello sync"
+
+
+def test_bidirectional_divergence():
+    base = AutoDoc(actor=actor(1))
+    base.put("_root", "x", 1)
+    base.commit()
+    b = base.fork(actor=actor(2))
+    base.put("_root", "a", "from-a")
+    base.commit()
+    b.put("_root", "b", "from-b")
+    b.commit()
+    sync_autodocs(base, b)
+    assert base.get_heads() == b.get_heads()
+    assert base.hydrate() == b.hydrate() == {"x": 1, "a": "from-a", "b": "from-b"}
+
+
+def test_multi_round_interleaved_edits():
+    a = AutoDoc(actor=actor(1))
+    t = a.put_object("_root", "t", ObjType.TEXT)
+    a.splice_text(t, 0, 0, "v0")
+    a.commit()
+    b = a.fork(actor=actor(2))
+    sa, sb = sync_autodocs(a, b)
+    for i in range(3):
+        a.splice_text(t, a.length(t), 0, f" a{i}")
+        a.commit()
+        b.splice_text(t, 0, 0, f"b{i} ")
+        b.commit()
+        sa, sb = sync_autodocs(a, b, sa, sb)
+        assert a.text(t) == b.text(t)
+        assert sorted(sa.shared_heads) == sorted(a.get_heads())
+
+
+def test_sync_reuses_state_incrementally():
+    """After initial sync, new rounds only carry the new changes."""
+    a = AutoDoc(actor=actor(1))
+    for i in range(20):
+        a.put("_root", f"k{i}", i)
+        a.commit()
+    b = AutoDoc(actor=actor(2))
+    sa, sb = sync_autodocs(a, b)
+    a.put("_root", "new", True)
+    a.commit()
+    msg = a.generate_sync_message(sa)
+    assert msg is not None
+    assert len(msg.changes) == 1  # only the fresh change travels
+
+
+def test_counter_merge_through_sync():
+    a = AutoDoc(actor=actor(1))
+    a.put("_root", "c", ScalarValue("counter", 100))
+    a.commit()
+    b = AutoDoc(actor=actor(2))
+    sync_autodocs(a, b)
+    a.increment("_root", "c", 5)
+    a.commit()
+    b.increment("_root", "c", 7)
+    b.commit()
+    sync_autodocs(a, b)
+    assert a.get("_root", "c")[0] == ("counter", 112)
+    assert b.get("_root", "c")[0] == ("counter", 112)
+
+
+def test_message_roundtrip_bytes():
+    a = AutoDoc(actor=actor(1))
+    a.put("_root", "k", "v")
+    a.commit()
+    state = SyncState()
+    msg = a.generate_sync_message(state)
+    data = msg.encode()
+    assert data[0] == 0x42
+    decoded = Message.decode(data)
+    assert decoded.heads == msg.heads
+    assert decoded.need == msg.need
+    assert len(decoded.have) == len(msg.have)
+    assert [c.hash for c in decoded.changes] == [c.hash for c in msg.changes]
+    assert decoded.encode() == data
+
+
+def test_state_roundtrip_bytes():
+    a = AutoDoc(actor=actor(1))
+    a.put("_root", "k", 1)
+    a.commit()
+    b = AutoDoc(actor=actor(2))
+    sa, sb = sync_autodocs(a, b)
+    data = sa.encode()
+    assert data[0] == 0x43
+    revived = SyncState.decode(data)
+    assert revived.shared_heads == sa.shared_heads
+    # a revived state still syncs correctly
+    a.put("_root", "k2", 2)
+    a.commit()
+    sync_autodocs(a, b, revived, SyncState())
+    assert b.hydrate() == a.hydrate()
+
+
+def test_peer_data_loss_triggers_reset():
+    """If B loses everything, A must do a full resend (reference:
+    sync.rs auto-reset when last_sync is unknown)."""
+    a = AutoDoc(actor=actor(1))
+    a.put("_root", "k", 1)
+    a.commit()
+    b = AutoDoc(actor=actor(2))
+    sa, sb = sync_autodocs(a, b)
+    # B is wiped and restarts with the persisted shared_heads state
+    b2 = AutoDoc(actor=actor(3))
+    sb2 = SyncState.decode(sb.encode())
+    sync_autodocs(a, b2, SyncState.decode(sa.encode()), sb2)
+    assert b2.hydrate() == a.hydrate()
+
+
+def test_bloom_false_positive_recovery_via_need():
+    """Even if the bloom filter hides every change (forced false positive),
+    the explicit need list still fetches what is missing."""
+    a = AutoDoc(actor=actor(1))
+    a.put("_root", "k", 1)
+    a.commit()
+    b = AutoDoc(actor=actor(2))
+    a.commit()
+    b.commit()
+    sa, sb = SyncState(), SyncState()
+    for _ in range(20):
+        ma = generate_sync_message(a.doc, sa)
+        if ma is not None:
+            # tamper: every bloom claims to contain everything
+            for h in ma.have:
+                h.bloom.bits = bytearray(b"\xff" * max(len(h.bloom.bits), 2))
+                h.bloom.num_entries = max(h.bloom.num_entries, 1)
+            receive_sync_message(b.doc, sb, Message.decode(ma.encode()))
+        mb = generate_sync_message(b.doc, sb)
+        if mb is not None:
+            for h in mb.have:
+                h.bloom.bits = bytearray(b"\xff" * max(len(h.bloom.bits), 2))
+                h.bloom.num_entries = max(h.bloom.num_entries, 1)
+            receive_sync_message(a.doc, sa, Message.decode(mb.encode()))
+        if ma is None and mb is None:
+            break
+    assert b.hydrate() == a.hydrate() == {"k": 1}
+
+
+def test_malformed_messages_raise_syncerror():
+    import pytest as _pytest
+    from automerge_tpu.sync import SyncError
+
+    a = AutoDoc(actor=actor(1))
+    a.put("_root", "k", 1)
+    a.commit()
+    msg = a.generate_sync_message(SyncState()).encode()
+    for bad in (
+        b"",
+        b"\x41\x00",
+        msg[:5],
+        msg[:-3],
+        msg + b"",  # sanity: well-formed decodes
+    ):
+        if bad == msg:
+            Message.decode(bad)
+            continue
+        with _pytest.raises(SyncError):
+            Message.decode(bad)
+    # hostile bloom parameters must be rejected, not looped on
+    hostile = bytearray([0x42, 0]) + bytearray([0]) + bytearray([1])
+    hostile += bytes([0])  # last_sync count 0
+    from automerge_tpu.utils.leb128 import uleb_bytes
+
+    bloom = uleb_bytes(1) + uleb_bytes(10) + uleb_bytes(10**15) + b"\x00\x02"
+    hostile += uleb_bytes(len(bloom)) + bloom
+    hostile += bytes([0])  # changes count 0
+    with _pytest.raises(SyncError):
+        Message.decode(bytes(hostile))
+
+
+def test_bloom_filter_basics():
+    import hashlib
+
+    hashes = [hashlib.sha256(bytes([i])).digest() for i in range(100)]
+    f = BloomFilter.from_hashes(hashes)
+    assert all(f.contains(h) for h in hashes)
+    other = [hashlib.sha256(b"x" + bytes([i])).digest() for i in range(200)]
+    fp = sum(f.contains(h) for h in other)
+    assert fp <= 12  # ~1% expected with 10 bits/entry; generous slack
+    assert BloomFilter.from_bytes(f.to_bytes()) == f
+    assert BloomFilter.from_bytes(b"") == BloomFilter()
+    assert not BloomFilter().contains(hashes[0])
+
+
+def test_random_topology_convergence():
+    rng = random.Random(42)
+    docs = [AutoDoc(actor=actor(10 + i)) for i in range(4)]
+    docs[0].put("_root", "seed", 1)
+    docs[0].commit()
+    for d in docs[1:]:
+        sync_autodocs(docs[0], d)
+    lst = docs[0].put_object("_root", "l", ObjType.LIST)
+    docs[0].commit()
+    for d in docs[1:]:
+        sync_autodocs(docs[0], d)
+    for step in range(10):
+        d = rng.choice(docs)
+        ln = d.length(lst)
+        d.insert(lst, rng.randrange(ln + 1), step)
+        d.commit()
+        x, y = rng.sample(range(len(docs)), 2)
+        sync_autodocs(docs[x], docs[y])
+    # full pairwise sweep to settle
+    for i in range(len(docs)):
+        for j in range(i + 1, len(docs)):
+            sync_autodocs(docs[i], docs[j])
+    states = [d.hydrate() for d in docs]
+    assert all(s == states[0] for s in states)
