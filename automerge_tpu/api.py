@@ -29,6 +29,7 @@ class AutoDoc:
         self._tx: Optional[Transaction] = None
         self._manual: Optional[Transaction] = None
         self._isolation: Optional[List[bytes]] = None
+        self._diff_cursor: List[bytes] = []
 
     # -- transaction management --------------------------------------------
 
@@ -179,6 +180,15 @@ class AutoDoc:
     def hydrate(self, obj: str = ROOT, heads=None):
         return self.doc.hydrate(obj, clock=self._read_clock(heads))
 
+    def get_cursor(self, obj: str, position: int, heads=None) -> str:
+        return self.doc.get_cursor(obj, position, clock=self._read_clock(heads))
+
+    def get_cursor_position(self, obj: str, cursor: str, heads=None) -> int:
+        return self.doc.get_cursor_position(obj, cursor, clock=self._read_clock(heads))
+
+    def marks(self, obj: str, heads=None):
+        return self.doc.marks(obj, clock=self._read_clock(heads))
+
     def object_type(self, obj: str) -> ObjType:
         return self.doc.object_type(obj)
 
@@ -216,6 +226,28 @@ class AutoDoc:
         self.commit()
         idxs = self.doc.states.get(self.doc.actors.lookup(self.doc.actor), [])
         return self.doc.history[idxs[-1]].stored if idxs else None
+
+    # -- diff / patches ------------------------------------------------------
+
+    def diff(self, before_heads, after_heads):
+        self.commit()
+        return self.doc.diff(before_heads, after_heads)
+
+    def diff_incremental(self):
+        """Patches since the last diff_incremental / update_diff_cursor call
+        (reference: autocommit.rs diff cursor)."""
+        self.commit()
+        before = self._diff_cursor
+        after = self.doc.get_heads()
+        self._diff_cursor = after
+        return self.doc.diff(before, after)
+
+    def update_diff_cursor(self) -> None:
+        self.commit()
+        self._diff_cursor = self.doc.get_heads()
+
+    def reset_diff_cursor(self) -> None:
+        self._diff_cursor = []
 
     # -- sync ---------------------------------------------------------------
 

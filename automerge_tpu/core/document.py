@@ -410,6 +410,60 @@ class Document:
                 return i
         return None
 
+    # -- cursors -------------------------------------------------------------
+
+    def get_cursor(self, obj: str, position: int, heads=None, clock=None) -> str:
+        """A stable reference to the element at ``position`` — the element
+        op's id, exported as "<ctr>@<actorhex>" (reference: cursor.rs)."""
+        obj_id = self.import_obj(obj)
+        info = self.ops.get_obj(obj_id)
+        if not isinstance(info.data, SeqObject):
+            raise AutomergeError("cursors only apply to sequences")
+        clock = self._resolve_clock(heads, clock)
+        enc = TEXT_ENC if info.data.obj_type == ObjType.TEXT else LIST_ENC
+        el = self.ops.nth(obj_id, position, enc, clock)
+        if el is None:
+            raise AutomergeError(f"cursor position {position} out of bounds")
+        return self.export_id(el.elem_id)
+
+    def get_cursor_position(self, obj: str, cursor: str, heads=None, clock=None) -> int:
+        """Current index of the element ``cursor`` refers to; if that element
+        is gone, the index it would occupy (reference: automerge.rs
+        seek_opid)."""
+        obj_id = self.import_obj(obj)
+        info = self.ops.get_obj(obj_id)
+        if not isinstance(info.data, SeqObject):
+            raise AutomergeError("cursors only apply to sequences")
+        clock = self._resolve_clock(heads, clock)
+        enc = TEXT_ENC if info.data.obj_type == ObjType.TEXT else LIST_ENC
+        target = self.import_id(cursor)
+        index = 0
+        for el in info.data.elements():
+            if el.elem_id == target:
+                return index
+            w = el.winner(clock)
+            if w is not None:
+                index += w.text_width() if enc == TEXT_ENC else 1
+        raise AutomergeError(f"cursor {cursor!r} not found in {obj!r}")
+
+    # -- marks ---------------------------------------------------------------
+
+    def marks(self, obj: str, heads=None, clock=None):
+        """Resolved mark spans for a sequence (reference: ReadDoc::marks)."""
+        from .marks import calculate_marks
+
+        obj_id = self.import_obj(obj)
+        return calculate_marks(self, obj_id, self._resolve_clock(heads, clock))
+
+    # -- diff ----------------------------------------------------------------
+
+    def diff(self, before_heads: List[bytes], after_heads: List[bytes]):
+        """Patches transforming the state at ``before_heads`` into the state
+        at ``after_heads`` (reference: automerge.rs diff via two clocks)."""
+        from ..patches.diff import diff as _diff
+
+        return _diff(self, before_heads, after_heads)
+
     # -- materialization ---------------------------------------------------
 
     def hydrate(self, obj: str = ROOT, heads=None, clock=None):

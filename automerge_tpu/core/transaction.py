@@ -235,18 +235,64 @@ class Transaction:
         )
         return self.doc.export_id(op.id)
 
+    def _insert_ref(self, obj_id: OpId, index: int, enc: int) -> OpId:
+        """Reference element for an insert at ``index``.
+
+        Scans forward over invisible elements applying Peritext "sticky"
+        mark boundaries (reference: query/insert.rs
+        identify_valid_insertion_spot): insertion moves past an expanding
+        MarkBegin (new text joins the span) and past a non-expanding
+        MarkEnd (new text stays outside the span); a whole begin/end pair
+        encountered in between is ignored.
+        """
+        from .marks import is_mark_begin, is_mark_end
+
+        obj = self.doc.ops.get_obj(obj_id).data
+        if index == 0:
+            floor = HEAD
+            cur = obj.head.next
+        else:
+            el = self.doc.ops.nth(obj_id, index - 1, enc, self.scope)
+            if el is None:
+                raise AutomergeError(f"index {index} out of bounds")
+            floor = el.elem_id
+            cur = el.next
+        candidates = []  # mark elements pushing the insertion point right
+        while cur is not None:
+            if cur.winner(self.scope) is not None:
+                break  # next visible element: insert lands before it
+            op = cur.op
+            if op.is_mark:
+                if is_mark_end(op):
+                    begin_id = (op.id[0] - 1, op.id[1])
+                    hit = next(
+                        (
+                            i
+                            for i, c in enumerate(candidates)
+                            if c.op.id == begin_id
+                        ),
+                        None,
+                    )
+                    if hit is not None:
+                        # a whole begin/end pair: points inside are invalid
+                        del candidates[hit:]
+                        cur = cur.next
+                        continue
+                    if not op.expand:
+                        candidates.append(cur)
+                elif is_mark_begin(op) and op.expand:
+                    candidates.append(cur)
+            cur = cur.next
+        if candidates:
+            return candidates[-1].elem_id
+        return floor
+
     def _insert_op(self, obj_id: OpId, index: int, action: int, value: ScalarValue) -> Op:
         info = self.doc.ops.get_obj(obj_id)
         if not isinstance(info.data, SeqObject):
             raise AutomergeError("insert on a non-sequence object")
         enc = self._encoding(info.data)
-        if index == 0:
-            elem = HEAD
-        else:
-            el = self.doc.ops.nth(obj_id, index - 1, enc, self.scope)
-            if el is None:
-                raise AutomergeError(f"index {index} out of bounds")
-            elem = el.elem_id
+        elem = self._insert_ref(obj_id, index, enc)
         op = Op(
             id=self._next_id(),
             action=action,
@@ -292,13 +338,7 @@ class Transaction:
             self._apply(obj_id, op)
         # Inserts chain off one another (reference inner.rs:672-683).
         if values:
-            if pos == 0:
-                elem = HEAD
-            else:
-                el = self.doc.ops.nth(obj_id, pos - 1, enc, self.scope)
-                if el is None:
-                    raise AutomergeError(f"splice: index {pos} out of bounds")
-                elem = el.elem_id
+            elem = self._insert_ref(obj_id, pos, enc)
             for v in values:
                 op = Op(
                     id=self._next_id(),
@@ -313,27 +353,35 @@ class Transaction:
     # -- marks -------------------------------------------------------------
 
     def mark(self, obj: str, start: int, end: int, name: str, value, expand="after") -> None:
-        """Mark a span of a sequence (Peritext-style rich text)."""
+        """Mark a span [start, end) of a sequence (Peritext-style rich text).
+
+        Begin/end are inserted as zero-width invisible elements so that
+        concurrent edits at the boundaries resolve by the expand policy
+        (reference: inner.rs mark inserts MarkBegin/MarkEnd via do_insert).
+        The end op id is always begin id + 1 — the pairing key.
+        """
         self._check_open()
         obj_id = self._obj(obj)
         info = self.doc.ops.get_obj(obj_id)
         if not isinstance(info.data, SeqObject):
             raise AutomergeError("mark on a non-sequence object")
+        if end <= start:
+            raise AutomergeError("mark span must be non-empty")
         enc = self._encoding(info.data)
+        # validate both anchors before creating any op: a failed end lookup
+        # must not leave a dangling unpaired MarkBegin behind
+        if self.doc.ops.nth(obj_id, start, enc, self.scope) is None and start != 0:
+            raise AutomergeError(f"mark start {start} out of bounds")
+        if self.doc.ops.nth(obj_id, end - 1, enc, self.scope) is None:
+            raise AutomergeError(f"mark end {end} out of bounds")
         expand_start = expand in ("before", "both")
         expand_end = expand in ("after", "both")
-        el_start = self.doc.ops.nth(obj_id, start, enc, self.scope)
-        if el_start is None:
-            raise AutomergeError(f"mark start {start} out of bounds")
-        # end is exclusive: anchor at the element before it
-        el_end = self.doc.ops.nth(obj_id, end - 1, enc, self.scope)
-        if el_end is None:
-            raise AutomergeError(f"mark end {end} out of bounds")
         begin = Op(
             id=self._next_id(),
             action=Action.MARK,
             value=ScalarValue.from_py(value),
-            elem=el_start.elem_id,
+            elem=self._insert_ref(obj_id, start, enc),
+            insert=True,
             mark_name=name,
             expand=expand_start,
         )
@@ -342,7 +390,8 @@ class Transaction:
             id=self._next_id(),
             action=Action.MARK,
             value=ScalarValue.null(),
-            elem=el_end.elem_id,
+            elem=self._insert_ref(obj_id, end, enc),
+            insert=True,
             mark_name=None,
             expand=expand_end,
         )

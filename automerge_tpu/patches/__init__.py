@@ -1,0 +1,29 @@
+from .diff import diff
+from .patch import (
+    DeleteMap,
+    DeleteSeq,
+    FlagConflict,
+    IncrementPatch,
+    Insert,
+    MarkPatch,
+    Patch,
+    PutMap,
+    PutSeq,
+    SpliceText,
+    apply_patches,
+)
+
+__all__ = [
+    "Patch",
+    "PutMap",
+    "PutSeq",
+    "Insert",
+    "SpliceText",
+    "DeleteMap",
+    "DeleteSeq",
+    "IncrementPatch",
+    "MarkPatch",
+    "FlagConflict",
+    "apply_patches",
+    "diff",
+]

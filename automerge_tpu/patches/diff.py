@@ -1,0 +1,188 @@
+"""Diff: patches that transform the document state at one set of heads
+into the state at another.
+
+Semantics mirror the reference (reference: rust/automerge/src/automerge/
+diff.rs log_diff): for every key pick the winning op at each clock and
+emit New / Delete / Update / Increment patches; sequences walk elements in
+document order with indices tracked against the evolving (before→after)
+state so patches apply cleanly in order.
+
+Host implementation over the op store; the per-key winner-at-clock
+comparison is the same computation the device kernel performs with clock
+masks (``counter <= clock[actor]`` — vectorized Clock::covers), so a
+device-resident diff for huge histories is a planned extension of
+ops/merge.py rather than a redesign.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..core.clock import Clock
+from ..core.op_store import MapObject, Op, ROOT_OBJ, SeqObject
+from ..types import ObjType, is_make_action
+from .patch import (
+    DeleteMap,
+    DeleteSeq,
+    FlagConflict,
+    IncrementPatch,
+    Insert,
+    Patch,
+    PutMap,
+    PutSeq,
+    SpliceText,
+)
+
+
+def diff(doc, before_heads: List[bytes], after_heads: List[bytes]) -> List[Patch]:
+    """Patches turning the state at ``before_heads`` into ``after_heads``."""
+    before = doc.clock_at(before_heads) if before_heads is not None else Clock()
+    after = doc.clock_at(after_heads)
+    patches: List[Patch] = []
+    _diff_obj(doc, ROOT_OBJ, before, after, patches, path=[])
+    return patches
+
+
+def _winner(ops: List[Op], clock) -> Optional[Op]:
+    vis = [o for o in ops if o.visible_at(clock)]
+    return vis[-1] if vis else None
+
+
+def _render(doc, op: Op, clock):
+    """Patch value of a winning op: hydrated subtree / counter / scalar."""
+    if is_make_action(op.action):
+        return doc._hydrate(op.id, clock)
+    if op.is_counter:
+        return op.counter_value_at(clock)
+    return op.value.to_py()
+
+
+def _diff_obj(doc, obj_id, before, after, patches, path):
+    info = doc.ops.get_obj(obj_id)
+    exid = doc.export_id(obj_id)
+    if isinstance(info.data, MapObject):
+        _diff_map(doc, obj_id, exid, info.data, before, after, patches, path)
+    elif info.data.obj_type == ObjType.TEXT:
+        _diff_text(doc, obj_id, exid, info.data, before, after, patches, path)
+    else:
+        _diff_list(doc, obj_id, exid, info.data, before, after, patches, path)
+
+
+def _diff_map(doc, obj_id, exid, data, before, after, patches, path):
+    for key_idx in sorted(data.props, key=lambda k: doc.props.get(k)):
+        run = data.props[key_idx]
+        key = doc.props.get(key_idx)
+        wb = _winner(run, before)
+        wa = _winner(run, after)
+        if wa is None:
+            if wb is not None:
+                patches.append(Patch(exid, list(path), DeleteMap(key)))
+            continue
+        conflict = sum(o.visible_at(after) for o in run) > 1
+        if wb is None or wb.id != wa.id:
+            patches.append(
+                Patch(exid, list(path), PutMap(key, _render(doc, wa, after), conflict))
+            )
+        elif wa.is_counter:
+            delta = wa.counter_value_at(after) - wb.counter_value_at(before)
+            if delta:
+                patches.append(Patch(exid, list(path), IncrementPatch(key, delta)))
+        elif conflict and sum(o.visible_at(before) for o in run) <= 1:
+            patches.append(Patch(exid, list(path), FlagConflict(key)))
+        if is_make_action(wa.action) and wb is not None and wb.id == wa.id:
+            _diff_obj(doc, wa.id, before, after, patches, path + [(exid, key)])
+
+
+def _diff_list(doc, obj_id, exid, data, before, after, patches, path):
+    idx = 0
+    pending_ins = None  # (index, [values])
+    for el in data.elements():
+        wb = el.winner(before)
+        wa = el.winner(after)
+        if wa is None and wb is None:
+            continue
+        if wa is not None and wb is None:
+            if pending_ins is None:
+                pending_ins = (idx, [])
+            pending_ins[1].append(_render(doc, wa, after))
+            idx += 1
+            continue
+        if pending_ins is not None:
+            patches.append(Patch(exid, list(path), Insert(*pending_ins)))
+            pending_ins = None
+        if wa is None:
+            # element disappeared: coalesce with a preceding delete
+            last = patches[-1] if patches else None
+            if (
+                last is not None
+                and last.obj == exid
+                and isinstance(last.action, DeleteSeq)
+                and last.action.index == idx
+            ):
+                last.action.length += 1
+            else:
+                patches.append(Patch(exid, list(path), DeleteSeq(idx)))
+            continue
+        conflict = len(el.visible_ops(after)) > 1
+        if wb.id != wa.id:
+            patches.append(
+                Patch(
+                    exid,
+                    list(path),
+                    PutSeq(idx, _render(doc, wa, after), conflict),
+                )
+            )
+        elif wa.is_counter:
+            delta = wa.counter_value_at(after) - wb.counter_value_at(before)
+            if delta:
+                patches.append(Patch(exid, list(path), IncrementPatch(idx, delta)))
+        elif conflict and len(el.visible_ops(before)) <= 1:
+            patches.append(Patch(exid, list(path), FlagConflict(idx)))
+        if is_make_action(wa.action) and wb.id == wa.id:
+            _diff_obj(doc, wa.id, before, after, patches, path + [(exid, idx)])
+        idx += 1
+    if pending_ins is not None:
+        patches.append(Patch(exid, list(path), Insert(*pending_ins)))
+
+
+def _diff_text(doc, obj_id, exid, data, before, after, patches, path):
+    idx = 0
+    pending = None  # (index, str) for inserts
+    for el in data.elements():
+        wb = el.winner(before)
+        wa = el.winner(after)
+        if wa is None and wb is None:
+            continue
+        sa = _char(wa) if wa is not None else None
+        sb = _char(wb) if wb is not None else None
+        if wa is not None and wb is None:
+            if pending is None:
+                pending = [idx, ""]
+            pending[1] += sa
+            idx += len(sa)
+            continue
+        if pending is not None:
+            patches.append(Patch(exid, list(path), SpliceText(pending[0], pending[1])))
+            pending = None
+        if wa is None:
+            last = patches[-1] if patches else None
+            if (
+                last is not None
+                and last.obj == exid
+                and isinstance(last.action, DeleteSeq)
+                and last.action.index == idx
+            ):
+                last.action.length += len(sb)
+            else:
+                patches.append(Patch(exid, list(path), DeleteSeq(idx, len(sb))))
+            continue
+        if wb.id != wa.id and (sa != sb):
+            patches.append(Patch(exid, list(path), DeleteSeq(idx, len(sb))))
+            patches.append(Patch(exid, list(path), SpliceText(idx, sa)))
+        idx += len(sa)
+    if pending is not None:
+        patches.append(Patch(exid, list(path), SpliceText(pending[0], pending[1])))
+
+
+def _char(op: Op) -> str:
+    return op.value.value if op.value.tag == "str" else "￼"

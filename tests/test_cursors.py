@@ -1,0 +1,91 @@
+"""Cursors: stable position references across edits, history and peers.
+
+Reference: rust/automerge/src/cursor.rs, automerge-wasm test/cursors.
+"""
+
+import pytest
+
+from automerge_tpu.api import AutoDoc
+from automerge_tpu.core.document import AutomergeError
+from automerge_tpu.types import ActorId, ObjType
+
+
+def actor(i):
+    return ActorId(bytes([i]) * 16)
+
+
+def test_cursor_tracks_through_edits():
+    d = AutoDoc(actor=actor(1))
+    t = d.put_object("_root", "t", ObjType.TEXT)
+    d.splice_text(t, 0, 0, "hello world")
+    d.commit()
+    cur = d.get_cursor(t, 6)  # "w"
+    d.splice_text(t, 0, 0, ">>> ")
+    d.commit()
+    assert d.get_cursor_position(t, cur) == 10
+    d.splice_text(t, 0, 4, "")
+    d.commit()
+    assert d.get_cursor_position(t, cur) == 6
+
+
+def test_cursor_on_deleted_element_degrades_gracefully():
+    d = AutoDoc(actor=actor(1))
+    t = d.put_object("_root", "t", ObjType.TEXT)
+    d.splice_text(t, 0, 0, "abc")
+    d.commit()
+    cur = d.get_cursor(t, 1)  # "b"
+    d.splice_text(t, 1, 1, "")
+    d.commit()
+    assert d.get_cursor_position(t, cur) == 1  # where it would be
+
+
+def test_cursor_across_merge():
+    d = AutoDoc(actor=actor(1))
+    t = d.put_object("_root", "t", ObjType.TEXT)
+    d.splice_text(t, 0, 0, "shared")
+    d.commit()
+    cur = d.get_cursor(t, 3)
+    f = d.fork(actor=actor(2))
+    f.splice_text(t, 0, 0, "ab ")
+    f.commit()
+    d.merge(f)
+    assert d.get_cursor_position(t, cur) == 6
+    # the other peer resolves the same cursor identically
+    assert f.get_cursor_position(t, cur) == 6
+
+
+def test_cursor_historical():
+    d = AutoDoc(actor=actor(1))
+    t = d.put_object("_root", "t", ObjType.TEXT)
+    d.splice_text(t, 0, 0, "abcdef")
+    d.commit()
+    h1 = d.get_heads()
+    d.splice_text(t, 0, 3, "")
+    d.commit()
+    cur = d.get_cursor(t, 0, heads=h1)  # "a" at h1
+    assert d.get_cursor_position(t, cur, heads=h1) == 0
+    assert d.get_cursor_position(t, cur) == 0  # deleted; degrades to 0
+
+
+def test_cursor_in_list():
+    d = AutoDoc(actor=actor(1))
+    lst = d.put_object("_root", "l", ObjType.LIST)
+    for i in range(5):
+        d.insert(lst, i, i)
+    d.commit()
+    cur = d.get_cursor(lst, 3)
+    d.insert(lst, 0, "x")
+    d.delete(lst, 1)
+    d.commit()
+    assert d.get_cursor_position(lst, cur) == 3
+
+
+def test_cursor_errors():
+    d = AutoDoc(actor=actor(1))
+    t = d.put_object("_root", "t", ObjType.TEXT)
+    d.splice_text(t, 0, 0, "ab")
+    d.commit()
+    with pytest.raises(AutomergeError):
+        d.get_cursor(t, 99)
+    with pytest.raises(AutomergeError):
+        d.get_cursor("_root", 0)

@@ -1,0 +1,184 @@
+"""Marks: span resolution, expand policies, merge/sync/save-load transport.
+
+Mirrors the reference's mark tests (reference:
+rust/automerge/tests/test_mark_patches.rs, automerge-wasm test/marks).
+"""
+
+import pytest
+
+from automerge_tpu.api import AutoDoc
+from automerge_tpu.core.marks import Mark
+from automerge_tpu.types import ActorId, ObjType
+
+
+def actor(i):
+    return ActorId(bytes([i]) * 16)
+
+
+def make_text(content="the quick fox", a=1):
+    d = AutoDoc(actor=actor(a))
+    t = d.put_object("_root", "t", ObjType.TEXT)
+    d.splice_text(t, 0, 0, content)
+    d.commit()
+    return d, t
+
+
+def test_basic_mark_span():
+    d, t = make_text("hello world")
+    d.mark(t, 0, 5, "bold", True)
+    d.commit()
+    assert d.marks(t) == [Mark(0, 5, "bold", True)]
+
+
+def test_mark_value_and_multiple_names():
+    d, t = make_text("abcdef")
+    d.mark(t, 0, 4, "bold", True)
+    d.mark(t, 2, 6, "link", "https://x")
+    d.commit()
+    assert d.marks(t) == [
+        Mark(0, 4, "bold", True),
+        Mark(2, 6, "link", "https://x"),
+    ]
+
+
+def test_unmark_removes_span():
+    d, t = make_text("abcdef")
+    d.mark(t, 0, 6, "bold", True)
+    d.commit()
+    d.unmark(t, 1, 3, "bold")
+    d.commit()
+    assert d.marks(t) == [Mark(0, 1, "bold", True), Mark(3, 6, "bold", True)]
+
+
+def test_overlapping_same_name_later_wins():
+    d, t = make_text("abcdef")
+    d.mark(t, 0, 6, "size", 10)
+    d.commit()
+    d.mark(t, 2, 4, "size", 20)
+    d.commit()
+    assert d.marks(t) == [
+        Mark(0, 2, "size", 10),
+        Mark(2, 4, "size", 20),
+        Mark(4, 6, "size", 10),
+    ]
+
+
+def test_expand_after_grows_with_typing():
+    d, t = make_text("ab")
+    d.mark(t, 0, 2, "bold", True, expand="after")
+    d.commit()
+    d.splice_text(t, 2, 0, "XY")  # typed at the end boundary
+    d.commit()
+    assert d.text(t) == "abXY"
+    assert d.marks(t) == [Mark(0, 4, "bold", True)]
+
+
+def test_expand_none_does_not_grow():
+    d, t = make_text("ab")
+    d.mark(t, 0, 2, "bold", True, expand="none")
+    d.commit()
+    d.splice_text(t, 2, 0, "XY")
+    d.splice_text(t, 0, 0, "Z")
+    d.commit()
+    assert d.text(t) == "ZabXY"
+    assert d.marks(t) == [Mark(1, 3, "bold", True)]
+
+
+def test_expand_before():
+    d, t = make_text("ab")
+    d.mark(t, 0, 2, "bold", True, expand="before")
+    d.commit()
+    d.splice_text(t, 0, 0, "Z")
+    d.splice_text(t, 3, 0, "Y")
+    d.commit()
+    assert d.text(t) == "ZabY"
+    assert d.marks(t) == [Mark(0, 3, "bold", True)]
+
+
+def test_expand_both():
+    d, t = make_text("ab")
+    d.mark(t, 0, 2, "bold", True, expand="both")
+    d.commit()
+    d.splice_text(t, 0, 0, "Z")
+    d.splice_text(t, 3, 0, "Y")
+    d.commit()
+    assert d.marks(t) == [Mark(0, 4, "bold", True)]
+
+
+def test_mark_survives_save_load():
+    d, t = make_text("persistent")
+    d.mark(t, 0, 6, "em", True, expand="none")
+    d.commit()
+    d2 = AutoDoc.load(d.save())
+    assert d2.marks(t) == [Mark(0, 6, "em", True)]
+
+
+def test_mark_travels_through_merge():
+    d, t = make_text("shared text")
+    f = d.fork(actor=actor(2))
+    f.mark(t, 0, 6, "bold", True)
+    f.commit()
+    d.merge(f)
+    assert d.marks(t) == [Mark(0, 6, "bold", True)]
+
+
+def test_mark_travels_through_sync():
+    from automerge_tpu.sync import sync
+
+    d, t = make_text("over the wire")
+    d.mark(t, 5, 8, "link", "u")
+    d.commit()
+    b = AutoDoc(actor=actor(2))
+    d.commit()
+    b.commit()
+    sync(d.doc, b.doc)
+    assert b.marks(t) == [Mark(5, 8, "link", "u")]
+
+
+def test_concurrent_edit_inside_marked_span():
+    d, t = make_text("bold text here")
+    d.mark(t, 0, 9, "bold", True)
+    d.commit()
+    f = d.fork(actor=actor(2))
+    f.splice_text(t, 4, 0, "er")  # insert inside the span
+    f.commit()
+    d.merge(f)
+    assert d.text(t) == "bolder text here"
+    assert d.marks(t) == [Mark(0, 11, "bold", True)]
+
+
+def test_deleted_span_chars_shrink_mark():
+    d, t = make_text("abcdef")
+    d.mark(t, 1, 5, "bold", True)
+    d.commit()
+    d.splice_text(t, 2, 2, "")  # delete two marked chars
+    d.commit()
+    assert d.text(t) == "abef"
+    assert d.marks(t) == [Mark(1, 3, "bold", True)]
+
+
+def test_marks_at_historical_heads():
+    d, t = make_text("history")
+    h1 = d.get_heads()
+    d.mark(t, 0, 4, "bold", True)
+    d.commit()
+    h2 = d.get_heads()
+    assert d.marks(t, heads=h1) == []
+    assert d.marks(t, heads=h2) == [Mark(0, 4, "bold", True)]
+
+
+def test_marks_do_not_break_device_merge():
+    from automerge_tpu.ops import DeviceDoc
+
+    d, t = make_text("kernel safe")
+    d.mark(t, 0, 6, "bold", True)
+    d.commit()
+    f = d.fork(actor=actor(2))
+    f.splice_text(t, 11, 0, "!")
+    f.commit()
+    dev = DeviceDoc.merge([d, f])
+    host = AutoDoc(actor=actor(9))
+    host.merge(d)
+    host.merge(f)
+    assert dev.text(t) == host.text(t) == "kernel safe!"
+    assert dev.length(t) == host.length(t)

@@ -1,0 +1,219 @@
+"""Diff/patches: apply_patches(hydrate(before), diff(before, after)) must
+equal hydrate(after) for arbitrary histories.
+
+This is the same invariant the reference holds between log_diff and
+hydrate::Value::apply_patches (reference: rust/automerge/src/automerge/
+diff.rs, hydrate.rs).
+"""
+
+import random
+
+import pytest
+
+from automerge_tpu.api import AutoDoc
+from automerge_tpu.patches import (
+    DeleteMap,
+    IncrementPatch,
+    Insert,
+    Patch,
+    PutMap,
+    SpliceText,
+    apply_patches,
+    diff,
+)
+from automerge_tpu.types import ActorId, ObjType, ScalarValue
+
+
+def actor(i):
+    return ActorId(bytes([i]) * 16)
+
+
+def check_roundtrip(doc, before, after):
+    patches = doc.diff(before, after)
+    materialized = apply_patches(doc.hydrate(heads=before), patches)
+    assert materialized == doc.hydrate(heads=after), patches
+    return patches
+
+
+def test_map_put_delete_update():
+    d = AutoDoc(actor=actor(1))
+    d.put("_root", "a", 1)
+    d.put("_root", "b", "x")
+    d.commit()
+    h1 = d.get_heads()
+    d.put("_root", "a", 2)
+    d.delete("_root", "b")
+    d.put("_root", "c", True)
+    d.commit()
+    h2 = d.get_heads()
+    patches = check_roundtrip(d, h1, h2)
+    kinds = {type(p.action) for p in patches}
+    assert kinds == {PutMap, DeleteMap}
+
+
+def test_counter_increment_patch():
+    d = AutoDoc(actor=actor(1))
+    d.put("_root", "c", ScalarValue("counter", 10))
+    d.commit()
+    h1 = d.get_heads()
+    d.increment("_root", "c", 5)
+    d.increment("_root", "c", -2)
+    d.commit()
+    h2 = d.get_heads()
+    patches = check_roundtrip(d, h1, h2)
+    assert patches == [Patch("_root", [], IncrementPatch("c", 3))]
+
+
+def test_text_splice_patches():
+    d = AutoDoc(actor=actor(1))
+    t = d.put_object("_root", "t", ObjType.TEXT)
+    d.splice_text(t, 0, 0, "hello world")
+    d.commit()
+    h1 = d.get_heads()
+    d.splice_text(t, 5, 0, " there,")
+    d.splice_text(t, 0, 5, "goodbye")
+    d.commit()
+    h2 = d.get_heads()
+    check_roundtrip(d, h1, h2)
+
+
+def test_empty_before_materializes_everything():
+    d = AutoDoc(actor=actor(1))
+    m = d.put_object("_root", "m", ObjType.MAP)
+    d.put(m, "x", 1)
+    lst = d.put_object(m, "l", ObjType.LIST)
+    d.insert(lst, 0, "a")
+    d.commit()
+    h = d.get_heads()
+    patches = d.diff([], h)
+    materialized = apply_patches({}, patches)
+    assert materialized == d.hydrate()
+
+
+def test_list_insert_delete_put():
+    d = AutoDoc(actor=actor(1))
+    lst = d.put_object("_root", "l", ObjType.LIST)
+    for i in range(5):
+        d.insert(lst, i, i)
+    d.commit()
+    h1 = d.get_heads()
+    d.delete(lst, 0)
+    d.insert(lst, 2, "mid")
+    d.put(lst, 0, "replaced")
+    d.commit()
+    h2 = d.get_heads()
+    check_roundtrip(d, h1, h2)
+
+
+def test_nested_object_changes():
+    d = AutoDoc(actor=actor(1))
+    m = d.put_object("_root", "cfg", ObjType.MAP)
+    d.put(m, "x", 1)
+    d.commit()
+    h1 = d.get_heads()
+    d.put(m, "x", 2)
+    inner = d.put_object(m, "inner", ObjType.MAP)
+    d.put(inner, "deep", "v")
+    d.commit()
+    h2 = d.get_heads()
+    patches = check_roundtrip(d, h1, h2)
+    # nested object path points through the parent
+    assert any(p.path and p.path[0][1] == "cfg" for p in patches)
+
+
+def test_merge_diff():
+    """Diff across a merge shows the remote edits."""
+    a = AutoDoc(actor=actor(1))
+    t = a.put_object("_root", "t", ObjType.TEXT)
+    a.splice_text(t, 0, 0, "shared")
+    a.commit()
+    b = a.fork(actor=actor(2))
+    b.splice_text(t, 6, 0, " +remote")
+    b.commit()
+    h1 = a.get_heads()
+    a.merge(b)
+    h2 = a.get_heads()
+    check_roundtrip(a, h1, h2)
+
+
+def test_diff_reverse_direction():
+    """Diff works backwards in time too (after < before)."""
+    d = AutoDoc(actor=actor(1))
+    d.put("_root", "k", 1)
+    d.commit()
+    h1 = d.get_heads()
+    d.put("_root", "k", 2)
+    d.put("_root", "extra", True)
+    d.commit()
+    h2 = d.get_heads()
+    patches = d.diff(h2, h1)
+    materialized = apply_patches(d.hydrate(heads=h2), patches)
+    assert materialized == d.hydrate(heads=h1) == {"k": 1}
+
+
+def test_diff_incremental_cursor():
+    d = AutoDoc(actor=actor(1))
+    d.put("_root", "a", 1)
+    d.commit()
+    first = d.diff_incremental()
+    materialized = apply_patches({}, first)
+    assert materialized == {"a": 1}
+    d.put("_root", "b", 2)
+    d.commit()
+    second = d.diff_incremental()
+    materialized = apply_patches(materialized, second)
+    assert materialized == {"a": 1, "b": 2}
+    assert d.diff_incremental() == []
+
+
+def test_conflict_put_carries_flag():
+    base = AutoDoc(actor=actor(1))
+    base.put("_root", "k", "base")
+    base.commit()
+    b = base.fork(actor=actor(2))
+    base.put("_root", "k", "a-side")
+    base.commit()
+    b.put("_root", "k", "b-side")
+    b.commit()
+    h1 = base.get_heads()
+    base.merge(b)
+    h2 = base.get_heads()
+    patches = check_roundtrip(base, h1, h2)
+    puts = [p for p in patches if isinstance(p.action, PutMap)]
+    assert puts and puts[0].action.conflict
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_random_history_roundtrip(seed):
+    rng = random.Random(seed)
+    d = AutoDoc(actor=actor(1))
+    t = d.put_object("_root", "t", ObjType.TEXT)
+    lst = d.put_object("_root", "l", ObjType.LIST)
+    d.put("_root", "c", ScalarValue("counter", 0))
+    d.commit()
+    heads = [d.get_heads()]
+    for _ in range(6):
+        for _ in range(5):
+            r = rng.random()
+            if r < 0.35:
+                ln = d.length(t)
+                if rng.random() < 0.7 or ln == 0:
+                    d.splice_text(t, rng.randrange(ln + 1), 0, rng.choice("abcdef"))
+                else:
+                    d.splice_text(t, rng.randrange(ln), 1, "")
+            elif r < 0.6:
+                ln = d.length(lst)
+                if rng.random() < 0.6 or ln == 0:
+                    d.insert(lst, rng.randrange(ln + 1), rng.randrange(100))
+                else:
+                    d.delete(lst, rng.randrange(ln))
+            elif r < 0.8:
+                d.put("_root", rng.choice("xyz"), rng.randrange(100))
+            else:
+                d.increment("_root", "c", rng.randrange(1, 5))
+        d.commit()
+        heads.append(d.get_heads())
+    # every pair of snapshots roundtrips, both directions
+    for i in range(len(heads)):
+        for j in range(len(heads)):
+            check_roundtrip(d, heads[i], heads[j])
