@@ -1,0 +1,250 @@
+"""Command-line interface: export / import / merge / examine / examine-sync
+/ change.
+
+Mirrors the reference CLI's subcommands (reference:
+rust/automerge-cli/src/main.rs:81-161). Documents read and write the
+binary automerge format; export/import speak JSON.
+
+    python -m automerge_tpu export doc.automerge
+    python -m automerge_tpu import state.json -o doc.automerge
+    python -m automerge_tpu merge a.automerge b.automerge -o merged.automerge
+    python -m automerge_tpu examine doc.automerge
+    python -m automerge_tpu examine-sync msg.sync
+    python -m automerge_tpu change doc.automerge 'set .title "hi"' -o out.automerge
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shlex
+import sys
+from typing import List, Optional
+
+from .api import AutoDoc
+from .expanded import expand_change
+from .types import ObjType, ScalarValue
+
+
+def _read(path: Optional[str]) -> bytes:
+    if path is None or path == "-":
+        return sys.stdin.buffer.read()
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def _write(path: Optional[str], data: bytes) -> None:
+    if path is None or path == "-":
+        sys.stdout.buffer.write(data)
+    else:
+        with open(path, "wb") as f:
+            f.write(data)
+
+
+def cmd_export(args) -> int:
+    doc = AutoDoc.load(_read(args.input), verify=not args.skip_verifying_heads)
+    out = json.dumps(doc.hydrate(), indent=2, ensure_ascii=False)
+    _write(args.out, (out + "\n").encode())
+    return 0
+
+
+def _import_value(doc, obj, key, value, insert=False):
+    def put(o, k, v):
+        if insert:
+            doc.insert(o, k, v)
+        else:
+            doc.put(o, k, v)
+
+    def put_obj(o, k, t):
+        return doc.insert_object(o, k, t) if insert else doc.put_object(o, k, t)
+
+    if isinstance(value, dict):
+        child = put_obj(obj, key, ObjType.MAP)
+        for k in sorted(value):
+            _import_value(doc, child, k, value[k])
+    elif isinstance(value, list):
+        child = put_obj(obj, key, ObjType.LIST)
+        for i, v in enumerate(value):
+            _import_value(doc, child, i, v, insert=True)
+    elif isinstance(value, str):
+        child = put_obj(obj, key, ObjType.TEXT)
+        doc.splice_text(child, 0, 0, value)
+    else:
+        put(obj, key, value)
+
+
+def cmd_import(args) -> int:
+    data = json.loads(_read(args.input).decode())
+    if not isinstance(data, dict):
+        print("import: top-level JSON value must be an object", file=sys.stderr)
+        return 1
+    doc = AutoDoc()
+    for k in sorted(data):
+        _import_value(doc, "_root", k, data[k])
+    doc.commit()
+    _write(args.out, doc.save())
+    return 0
+
+
+def cmd_merge(args) -> int:
+    if not args.input:
+        print("merge: provide at least one input file", file=sys.stderr)
+        return 1
+    doc = AutoDoc.load(_read(args.input[0]))
+    for path in args.input[1:]:
+        doc.merge(AutoDoc.load(_read(path)))
+    _write(args.out, doc.save())
+    return 0
+
+
+def cmd_examine(args) -> int:
+    doc = AutoDoc.load(_read(args.input), verify=not args.skip_verifying_heads)
+    changes = [expand_change(a.stored) for a in doc.doc.history]
+    _write(args.out, (json.dumps(changes, indent=2) + "\n").encode())
+    return 0
+
+
+def cmd_examine_sync(args) -> int:
+    from .sync import Message
+
+    msg = Message.decode(_read(args.input))
+    out = {
+        "heads": [h.hex() for h in msg.heads],
+        "need": [h.hex() for h in msg.need],
+        "have": [
+            {
+                "lastSync": [h.hex() for h in h_.last_sync],
+                "bloom": h_.bloom.to_bytes().hex(),
+            }
+            for h_ in msg.have
+        ],
+        "changes": [expand_change(c) for c in msg.changes],
+    }
+    _write(args.out, (json.dumps(out, indent=2) + "\n").encode())
+    return 0
+
+
+def _resolve_path(doc, path: str):
+    """'.a.b[2].c' -> (object id, final key). Root path '.' is ('_root', None)."""
+    obj = "_root"
+    parts: List = []
+    for seg in path.strip().lstrip(".").split("."):
+        if not seg:
+            continue
+        while "[" in seg:
+            name, rest = seg.split("[", 1)
+            idx, seg = rest.split("]", 1)
+            if name:
+                parts.append(name)
+            parts.append(int(idx))
+        if seg:
+            parts.append(seg)
+    if not parts:
+        return obj, None
+    for p in parts[:-1]:
+        val = doc.get(obj, p)
+        if val is None or val[0][0] != "obj":
+            raise ValueError(f"path segment {p!r} is not an object")
+        obj = val[0][2]
+    return obj, parts[-1]
+
+
+def _script_value(tok: str):
+    try:
+        return json.loads(tok)
+    except json.JSONDecodeError:
+        return tok
+
+
+def cmd_change(args) -> int:
+    """Apply an edit script: set/insert/delete/increment/splice commands
+    (reference: automerge-cli/src/change.rs script language)."""
+    doc = AutoDoc.load(_read(args.input)) if args.input else AutoDoc()
+    script = args.script
+    if script == "-":
+        script_lines = sys.stdin.read().splitlines()
+    else:
+        script_lines = script.split(";")
+    for line in script_lines:
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        toks = shlex.split(line)
+        cmd, path = toks[0].lower(), toks[1]
+        obj, key = _resolve_path(doc, path)
+        if cmd == "set":
+            value = _script_value(toks[2])
+            if isinstance(value, (dict, list, str)) and not isinstance(value, bool):
+                _import_value(doc, obj, key, value)
+            else:
+                doc.put(obj, key, value)
+        elif cmd == "insert":
+            value = _script_value(toks[2])
+            if isinstance(value, (dict, list, str)) and not isinstance(value, bool):
+                _import_value(doc, obj, key, value, insert=True)
+            else:
+                doc.insert(obj, key, value)
+        elif cmd in ("delete", "del"):
+            doc.delete(obj, key)
+        elif cmd in ("increment", "inc"):
+            doc.increment(obj, key, int(toks[2]) if len(toks) > 2 else 1)
+        elif cmd == "splice":
+            val = doc.get(obj, key)
+            if val is None or val[0][0] != "obj":
+                raise ValueError(f"splice target {path!r} is not a text object")
+            doc.splice_text(val[0][2], int(toks[2]), int(toks[3]), toks[4] if len(toks) > 4 else "")
+        elif cmd == "counter":
+            doc.put(obj, key, ScalarValue("counter", int(toks[2])))
+        else:
+            print(f"change: unknown command {cmd!r}", file=sys.stderr)
+            return 1
+    doc.commit()
+    _write(args.out, doc.save())
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="automerge_tpu", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def add(name, fn, **kw):
+        sp = sub.add_parser(name, **kw)
+        sp.set_defaults(fn=fn)
+        sp.add_argument("-o", "--out", default=None, help="output file (default stdout)")
+        return sp
+
+    sp = add("export", cmd_export, help="document -> JSON")
+    sp.add_argument("input", nargs="?", help="input .automerge file (default stdin)")
+    sp.add_argument("--skip-verifying-heads", action="store_true")
+
+    sp = add("import", cmd_import, help="JSON -> document")
+    sp.add_argument("input", nargs="?", help="input JSON file (default stdin)")
+
+    sp = add("merge", cmd_merge, help="merge N documents into one")
+    sp.add_argument("input", nargs="*", help="input .automerge files")
+
+    sp = add("examine", cmd_examine, help="dump a document's changes as JSON")
+    sp.add_argument("input", nargs="?", help="input .automerge file (default stdin)")
+    sp.add_argument("--skip-verifying-heads", action="store_true")
+
+    sp = add("examine-sync", cmd_examine_sync, help="decode a sync message")
+    sp.add_argument("input", nargs="?", help="input sync message file (default stdin)")
+
+    sp = add("change", cmd_change, help="apply an edit script to a document")
+    sp.add_argument("input", nargs="?", help="input .automerge file (omit to start empty)")
+    sp.add_argument(
+        "script",
+        help="';'-separated commands: set PATH VALUE | insert PATH VALUE | "
+        "delete PATH | increment PATH [N] | splice PATH POS DEL TEXT | "
+        "counter PATH N  ('-' reads commands from stdin, one per line)",
+    )
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

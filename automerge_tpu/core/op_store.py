@@ -174,6 +174,14 @@ class SeqObject:
     def invalidate_cursor(self) -> None:
         self._cursor = None
 
+    def seed_cursor(self, el, at: int, encoding: int) -> None:
+        """Re-seed the position cursor after local edits (the analogue of
+        the reference's last_insert hint, op_tree.rs:36-45)."""
+        if encoding == LIST_ENC:
+            self._cursor = (el, at, 0, encoding)
+        else:
+            self._cursor = (el, 0, at, encoding)
+
     def elements(self) -> Iterator[Element]:
         e = self.head.next
         while e is not None:
@@ -446,8 +454,11 @@ class OpStore:
         if cur is not None and encoding == cur[3]:
             el, li, ti = cur[0], cur[1], cur[2]
             at = li if encoding == LIST_ENC else ti
-            if at <= index:
-                found = self._walk_forward(obj, el, at, index, encoding)
+            if el.winner() is not None:
+                if at <= index:
+                    found = self._walk_forward(obj, el, at, index, encoding)
+                else:
+                    found = self._walk_backward(obj, el, at, index, encoding)
                 if found is not None:
                     return found
         return self._nth_scan(obj, index, encoding, None)
@@ -463,6 +474,24 @@ class OpStore:
                 at += width
             el = el.next
         return None
+
+    def _walk_backward(self, obj, el, at, index, encoding):
+        """Walk toward the front from a visible element starting at ``at``."""
+        while True:
+            p = el.prev
+            while p is not None and p.op is not None and p.winner() is None:
+                p = p.prev
+            if p is None or p.op is None:
+                return None  # reached HEAD without covering index
+            w = p.winner()
+            width = 1 if encoding == LIST_ENC else w.text_width()
+            at -= width
+            el = p
+            if at <= index:
+                if index < at + width:
+                    self._set_cursor(obj, el, at, encoding)
+                    return el
+                return None
 
     def _nth_scan(self, obj, index, encoding, clock):
         at = 0
@@ -483,6 +512,9 @@ class OpStore:
             obj._cursor = (el, at, 0, encoding)
         else:
             obj._cursor = (el, 0, at, encoding)
+
+    def seed_cursor(self, obj, el, at: int, encoding: int) -> None:
+        obj.seed_cursor(el, at, encoding)
 
     def visible_elements(self, obj_id: OpId, clock=None) -> Iterator[Tuple[Element, Op]]:
         obj = self.get_obj(obj_id).data

@@ -245,18 +245,25 @@ class Transaction:
         MarkEnd (new text stays outside the span); a whole begin/end pair
         encountered in between is ignored.
         """
-        from .marks import is_mark_begin, is_mark_end
-
         obj = self.doc.ops.get_obj(obj_id).data
         if index == 0:
+            anchor = None
+        else:
+            anchor = self.doc.ops.nth(obj_id, index - 1, enc, self.scope)
+            if anchor is None:
+                raise AutomergeError(f"index {index} out of bounds")
+        return self._insert_ref_from(obj, anchor)
+
+    def _insert_ref_from(self, obj, anchor) -> OpId:
+        """Sticky-boundary scan starting after ``anchor`` (None = HEAD)."""
+        from .marks import is_mark_begin, is_mark_end
+
+        if anchor is None:
             floor = HEAD
             cur = obj.head.next
         else:
-            el = self.doc.ops.nth(obj_id, index - 1, enc, self.scope)
-            if el is None:
-                raise AutomergeError(f"index {index} out of bounds")
-            floor = el.elem_id
-            cur = el.next
+            floor = anchor.elem_id
+            cur = anchor.next
         candidates = []  # mark elements pushing the insertion point right
         while cur is not None:
             if cur.winner(self.scope) is not None:
@@ -323,22 +330,55 @@ class Transaction:
         self._splice(obj_id, pos, delete, svals, self._encoding(info.data))
 
     def _splice(self, obj_id, pos, delete, values, enc) -> None:
-        # Deletes first (reference inner_splice deletes then inserts).
-        for _ in range(delete):
-            el = self.doc.ops.nth(obj_id, pos, enc, self.scope)
-            if el is None:
+        """Delete then insert at ``pos`` (reference: inner.rs inner_splice).
+
+        Anchors once at ``pos - 1`` and walks elements directly instead of
+        re-seeking per op; the position cursor is re-seeded afterwards so a
+        run of sequential splices costs O(1) seek each — the analogue of the
+        reference's ``last_insert`` hint (op_tree.rs:36-45).
+        """
+        ops = self.doc.ops
+        obj = ops.get_obj(obj_id).data
+        # anchor: the visible element just before pos (None at HEAD)
+        if pos == 0:
+            anchor = None
+            anchor_at = None
+        else:
+            anchor = ops.nth(obj_id, pos - 1, enc, self.scope)
+            if anchor is None:
                 raise AutomergeError(f"splice: index {pos} out of bounds")
+            anchor_at = obj._cursor[1 if enc == LIST_ENC else 2] if obj._cursor else None
+
+        def next_visible(el):
+            el = el.next if el is not None else obj.head.next
+            while el is not None and el.winner(self.scope) is None:
+                el = el.next
+            return el
+
+        # -- deletes: walk forward from the anchor -------------------------
+        remaining = delete
+        cur = next_visible(anchor)
+        while remaining > 0:
+            if cur is None:
+                raise AutomergeError(f"splice: delete past end of sequence")
+            w = cur.winner(self.scope)
+            width = w.text_width() if enc == TEXT_ENC else 1
             op = Op(
                 id=self._next_id(),
                 action=Action.DELETE,
                 value=ScalarValue.null(),
-                elem=el.elem_id,
-                pred=self._pred_for_elem(el),
+                elem=cur.elem_id,
+                pred=self._pred_for_elem(cur),
             )
             self._apply(obj_id, op)
-        # Inserts chain off one another (reference inner.rs:672-683).
+            remaining -= width
+            cur = next_visible(cur)
+
+        # -- inserts: chain off one another (reference inner.rs:672-683) ---
+        last_el = None
+        insert_at = pos
         if values:
-            elem = self._insert_ref(obj_id, pos, enc)
+            elem = self._insert_ref_from(obj, anchor)
             for v in values:
                 op = Op(
                     id=self._next_id(),
@@ -349,6 +389,15 @@ class Transaction:
                 )
                 self._apply(obj_id, op)
                 elem = op.id
+            last_el = obj.by_id[elem]
+            insert_at = pos + sum(_sv_width(v, enc) for v in values[:-1])
+
+        # -- re-seed the cursor so the next sequential splice is O(1) ------
+        if self.scope is None:
+            if last_el is not None:
+                ops.seed_cursor(obj, last_el, insert_at, enc)
+            elif anchor is not None and anchor_at is not None:
+                ops.seed_cursor(obj, anchor, anchor_at, enc)
 
     # -- marks -------------------------------------------------------------
 
@@ -469,3 +518,8 @@ class Transaction:
             self.doc.actors.cache(ActorId(a)) for a in change.actors
         ]
 
+
+def _sv_width(v: ScalarValue, enc: int) -> int:
+    if enc == TEXT_ENC and v.tag == "str":
+        return len(v.value)
+    return 1

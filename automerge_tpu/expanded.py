@@ -1,0 +1,81 @@
+"""ExpandedChange: a JSON-able view of a change chunk.
+
+The analogue of the reference's legacy ExpandedChange form used by
+``decodeChange`` and the CLI's examine output (reference:
+rust/automerge/src/legacy/, rust/automerge/src/change.rs:283-338): op ids
+become "<ctr>@<actorhex>" strings, values carry explicit datatypes where
+the JSON type is ambiguous.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .storage.change import ChangeOp, StoredChange
+from .types import Action, ScalarValue
+
+_ACTION_NAMES = {
+    Action.MAKE_MAP: "makeMap",
+    Action.PUT: "set",
+    Action.MAKE_LIST: "makeList",
+    Action.DELETE: "del",
+    Action.MAKE_TEXT: "makeText",
+    Action.INCREMENT: "inc",
+    Action.MAKE_TABLE: "makeTable",
+    Action.MARK: "mark",
+}
+
+
+def _opid_str(opid, actors: List[bytes]) -> str:
+    return f"{opid[0]}@{actors[opid[1]].hex()}"
+
+
+def _value_json(v: ScalarValue):
+    if v.tag == "counter":
+        return {"value": v.value, "datatype": "counter"}
+    if v.tag == "timestamp":
+        return {"value": v.value, "datatype": "timestamp"}
+    if v.tag == "uint":
+        return {"value": v.value, "datatype": "uint"}
+    if v.tag == "f64":
+        return {"value": v.value, "datatype": "float64"}
+    if v.tag == "bytes":
+        return {"value": v.value.hex(), "datatype": "bytes"}
+    if v.tag == "unknown":
+        code, raw = v.value
+        return {"value": raw.hex(), "datatype": f"unknown{code}"}
+    return v.to_py()
+
+
+def expand_change(change: StoredChange) -> dict:
+    actors = list(change.actors)
+    ops = []
+    for i, cop in enumerate(change.ops):
+        op: dict = {
+            "action": _ACTION_NAMES.get(Action(cop.action), str(cop.action)),
+            "obj": "_root" if cop.obj[0] == 0 else _opid_str(cop.obj, actors),
+            "insert": bool(cop.insert),
+            "pred": [_opid_str(p, actors) for p in cop.pred],
+        }
+        if cop.key.prop is not None:
+            op["key"] = cop.key.prop
+        else:
+            e = cop.key.elem
+            op["elemId"] = "_head" if e[0] == 0 else _opid_str(e, actors)
+        if cop.action in (Action.PUT, Action.INCREMENT, Action.MARK):
+            op["value"] = _value_json(cop.value)
+        if cop.mark_name is not None:
+            op["name"] = cop.mark_name
+        if cop.expand:
+            op["expand"] = True
+        ops.append(op)
+    return {
+        "actor": change.actor.hex(),
+        "seq": change.seq,
+        "startOp": change.start_op,
+        "time": change.timestamp,
+        "message": change.message,
+        "deps": [d.hex() for d in sorted(change.dependencies)],
+        "hash": change.hash.hex() if change.hash else None,
+        "ops": ops,
+    }

@@ -1,0 +1,224 @@
+"""Native codec loader: compiles codecs.cpp on first use, loads via ctypes.
+
+The reference's storage layer is native (Rust); ours keeps the byte-hot
+columnar codec loops in C++ with a pure-Python fallback (utils/codecs.py)
+when no compiler is available. Set AUTOMERGE_TPU_NO_NATIVE=1 to force the
+fallback.
+
+Array-level API (numpy in/out):
+    rle_decode_array(buf, signed_vals, capacity) -> (values i64, mask bool)
+    delta_decode_array(buf, capacity) -> (values, mask)
+    bool_decode_array(buf, capacity) -> bool array
+    rle_encode_array(values, mask, signed_vals) -> bytes
+    delta_encode_array(values, mask) -> bytes
+    bool_encode_array(values) -> bytes
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import Optional, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "codecs.cpp")
+_LIB_NAME = "_codecs.so"
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+class NativeUnavailable(RuntimeError):
+    pass
+
+
+def _build(lib_path: str) -> bool:
+    cmd = [
+        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+        "-o", lib_path, _SRC,
+    ]
+    try:
+        r = subprocess.run(cmd, capture_output=True, timeout=120)
+        return r.returncode == 0 and os.path.exists(lib_path)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def _lib_path() -> str:
+    # prefer alongside the source; fall back to a per-user cache dir when
+    # the package directory is not writable
+    primary = os.path.join(_HERE, _LIB_NAME)
+    if os.path.exists(primary) and os.path.getmtime(primary) >= os.path.getmtime(_SRC):
+        return primary
+    if os.access(_HERE, os.W_OK):
+        return primary
+    cache = os.path.join(
+        os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+        "automerge_tpu",
+    )
+    os.makedirs(cache, exist_ok=True)
+    return os.path.join(cache, _LIB_NAME)
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The native library, building it on first use. None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("AUTOMERGE_TPU_NO_NATIVE"):
+        return None
+    path = _lib_path()
+    fresh = os.path.exists(path) and os.path.getmtime(path) >= os.path.getmtime(_SRC)
+    if not fresh and not _build(path):
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.am_rle_decode_i64.restype = ctypes.c_longlong
+    lib.am_rle_decode_i64.argtypes = [u8p, ctypes.c_size_t, ctypes.c_int, i64p, u8p, ctypes.c_size_t]
+    lib.am_delta_decode_i64.restype = ctypes.c_longlong
+    lib.am_delta_decode_i64.argtypes = [u8p, ctypes.c_size_t, i64p, u8p, ctypes.c_size_t]
+    lib.am_bool_decode.restype = ctypes.c_longlong
+    lib.am_bool_decode.argtypes = [u8p, ctypes.c_size_t, u8p, ctypes.c_size_t]
+    lib.am_rle_encode_i64.restype = ctypes.c_longlong
+    lib.am_rle_encode_i64.argtypes = [i64p, u8p, ctypes.c_size_t, ctypes.c_int, u8p, ctypes.c_size_t]
+    lib.am_delta_encode_i64.restype = ctypes.c_longlong
+    lib.am_delta_encode_i64.argtypes = [i64p, u8p, ctypes.c_size_t, u8p, ctypes.c_size_t, i64p]
+    lib.am_bool_encode.restype = ctypes.c_longlong
+    lib.am_bool_encode.argtypes = [u8p, ctypes.c_size_t, u8p, ctypes.c_size_t]
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.am_preorder_index.restype = ctypes.c_longlong
+    lib.am_preorder_index.argtypes = [i32p, i32p, i32p, ctypes.c_int64, ctypes.c_int64, i32p]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def _u8(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _i64(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _inbuf(buf: bytes) -> np.ndarray:
+    return np.frombuffer(buf, dtype=np.uint8) if len(buf) else np.zeros(1, np.uint8)
+
+
+def rle_decode_array(buf: bytes, signed_vals: bool, capacity: int) -> Tuple[np.ndarray, np.ndarray]:
+    lib = load()
+    if lib is None:
+        raise NativeUnavailable("native codecs not available")
+    vals = np.empty(capacity, np.int64)
+    mask = np.empty(capacity, np.uint8)
+    b = _inbuf(buf)
+    n = lib.am_rle_decode_i64(_u8(b), len(buf), int(signed_vals), _i64(vals), _u8(mask), capacity)
+    if n < 0:
+        raise ValueError("malformed RLE column")
+    return vals[:n], mask[:n].astype(bool)
+
+
+def delta_decode_array(buf: bytes, capacity: int) -> Tuple[np.ndarray, np.ndarray]:
+    lib = load()
+    if lib is None:
+        raise NativeUnavailable("native codecs not available")
+    vals = np.empty(capacity, np.int64)
+    mask = np.empty(capacity, np.uint8)
+    b = _inbuf(buf)
+    n = lib.am_delta_decode_i64(_u8(b), len(buf), _i64(vals), _u8(mask), capacity)
+    if n < 0:
+        raise ValueError("malformed delta column")
+    return vals[:n], mask[:n].astype(bool)
+
+
+def bool_decode_array(buf: bytes, capacity: int) -> np.ndarray:
+    lib = load()
+    if lib is None:
+        raise NativeUnavailable("native codecs not available")
+    out = np.empty(capacity, np.uint8)
+    b = _inbuf(buf)
+    n = lib.am_bool_decode(_u8(b), len(buf), _u8(out), capacity)
+    if n < 0:
+        raise ValueError("malformed boolean column")
+    return out[:n].astype(bool)
+
+
+def rle_encode_array(values: np.ndarray, mask: np.ndarray, signed_vals: bool) -> bytes:
+    lib = load()
+    if lib is None:
+        raise NativeUnavailable("native codecs not available")
+    values = np.ascontiguousarray(values, np.int64)
+    m = np.ascontiguousarray(mask, np.uint8)
+    n = len(values)
+    out = np.empty(12 * n + 32, np.uint8)
+    w = lib.am_rle_encode_i64(_i64(values), _u8(m), n, int(signed_vals), _u8(out), len(out))
+    if w < 0:
+        raise ValueError("rle encode: output overflow")
+    return out[:w].tobytes()
+
+
+def delta_encode_array(values: np.ndarray, mask: np.ndarray) -> bytes:
+    lib = load()
+    if lib is None:
+        raise NativeUnavailable("native codecs not available")
+    values = np.ascontiguousarray(values, np.int64)
+    m = np.ascontiguousarray(mask, np.uint8)
+    n = len(values)
+    out = np.empty(12 * n + 32, np.uint8)
+    scratch = np.empty(max(n, 1), np.int64)
+    w = lib.am_delta_encode_i64(_i64(values), _u8(m), n, _u8(out), len(out), _i64(scratch))
+    if w < 0:
+        raise ValueError("delta encode: output overflow")
+    return out[:w].tobytes()
+
+
+def bool_encode_array(values: np.ndarray) -> bytes:
+    lib = load()
+    if lib is None:
+        raise NativeUnavailable("native codecs not available")
+    v = np.ascontiguousarray(values, np.uint8)
+    n = len(v)
+    out = np.empty(11 * n + 32, np.uint8)
+    w = lib.am_bool_encode(_u8(v), n, _u8(out), len(out))
+    if w < 0:
+        raise ValueError("bool encode: output overflow")
+    return out[:w].tobytes()
+
+
+def _i32(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def preorder_available() -> bool:
+    lib = load()
+    return lib is not None and hasattr(lib, "am_preorder_index")
+
+
+def preorder_index(
+    first_child: np.ndarray, next_sib: np.ndarray, parent: np.ndarray, P: int
+) -> np.ndarray:
+    """Document-order index per element node via the native preorder walk."""
+    lib = load()
+    if lib is None:
+        raise NativeUnavailable("native codecs not available")
+    fc = np.ascontiguousarray(first_child, np.int32)
+    ns = np.ascontiguousarray(next_sib, np.int32)
+    pa = np.ascontiguousarray(parent, np.int32)
+    N = len(fc)
+    out = np.empty(P, np.int32)
+    r = lib.am_preorder_index(_i32(fc), _i32(ns), _i32(pa), P, N, _i32(out))
+    if r < 0:
+        raise ValueError("cyclic element structure in preorder walk")
+    return out

@@ -1,0 +1,362 @@
+// Native columnar codec core: LEB128, RLE, delta-RLE, boolean run-length.
+//
+// The byte-hot loops of the storage layer (the reference implements these in
+// Rust: rust/automerge/src/columnar/encoding/{rle.rs,delta.rs,boolean.rs}).
+// Byte-compatible with automerge_tpu/utils/codecs.py — change hashes are
+// computed over these bytes, so the encoder state machine is mirrored
+// exactly (verified by differential tests in tests/test_native_codecs.py).
+//
+// C ABI over raw buffers; loaded via ctypes (automerge_tpu/native/__init__).
+// All decoders are bounds-checked and clamp attacker-controlled run lengths
+// to the caller's capacity.
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr int64_t I64_MAX = INT64_MAX;
+constexpr int64_t I64_MIN = INT64_MIN;
+
+inline int64_t sat_add(int64_t a, int64_t b) {
+    int64_t r;
+    if (__builtin_add_overflow(a, b, &r)) return b > 0 ? I64_MAX : I64_MIN;
+    return r;
+}
+
+inline int64_t sat_sub(int64_t a, int64_t b) {
+    int64_t r;
+    if (__builtin_sub_overflow(a, b, &r)) return b < 0 ? I64_MAX : I64_MIN;
+    return r;
+}
+
+// -- LEB128 -----------------------------------------------------------------
+
+// Decode ULEB128; returns bytes consumed or -1 on error/truncation.
+inline int dec_uleb(const uint8_t* p, size_t n, uint64_t* out) {
+    uint64_t v = 0;
+    int shift = 0;
+    for (size_t i = 0; i < n && i < 10; i++) {
+        uint64_t b = p[i] & 0x7f;
+        if (shift == 63 && b > 1) return -1;  // overflow u64
+        v |= b << shift;
+        if (!(p[i] & 0x80)) {
+            // reject non-canonical (overlong) encodings like the reference
+            if (i > 0 && p[i] == 0) return -1;
+            *out = v;
+            return (int)(i + 1);
+        }
+        shift += 7;
+    }
+    return -1;
+}
+
+inline int dec_sleb(const uint8_t* p, size_t n, int64_t* out) {
+    int64_t v = 0;
+    int shift = 0;
+    for (size_t i = 0; i < n && i < 10; i++) {
+        uint8_t byte = p[i];
+        if (shift == 63 && (byte & 0x7f) != 0 && (byte & 0x7f) != 0x7f)
+            return -1;
+        v |= (int64_t)(byte & 0x7f) << shift;
+        shift += 7;
+        if (!(byte & 0x80)) {
+            if (shift < 64 && (byte & 0x40)) v |= -((int64_t)1 << shift);
+            // reject overlong: a final 0x00 after continuation with no sign
+            // effect, or 0x7f extending a negative number redundantly
+            if (i > 0) {
+                uint8_t prev = p[i - 1];
+                if (byte == 0 && !(prev & 0x40) && (prev & 0x80)) return -1;
+                if (byte == 0x7f && (prev & 0x40) && (prev & 0x80)) return -1;
+            }
+            *out = v;
+            return (int)(i + 1);
+        }
+    }
+    return -1;
+}
+
+inline void enc_uleb(uint64_t v, uint8_t* out, size_t* w) {
+    do {
+        uint8_t b = v & 0x7f;
+        v >>= 7;
+        if (v) b |= 0x80;
+        out[(*w)++] = b;
+    } while (v);
+}
+
+inline void enc_sleb(int64_t v, uint8_t* out, size_t* w) {
+    bool more = true;
+    while (more) {
+        uint8_t b = v & 0x7f;
+        v >>= 7;  // arithmetic shift
+        if ((v == 0 && !(b & 0x40)) || (v == -1 && (b & 0x40))) more = false;
+        else b |= 0x80;
+        out[(*w)++] = b;
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// RLE decode: values into out[], validity into mask[] (1 = present).
+// signed_vals: 0 = ULEB values, 1 = SLEB values.
+// Returns number of rows decoded, or -1 on malformed input.
+long long am_rle_decode_i64(const uint8_t* buf, size_t len, int signed_vals,
+                            int64_t* out, uint8_t* mask, size_t capacity) {
+    size_t pos = 0, row = 0;
+    while (pos < len && row < capacity) {
+        int64_t header;
+        int c = dec_sleb(buf + pos, len - pos, &header);
+        if (c < 0) return -1;
+        pos += (size_t)c;
+        if (header > 0) {
+            int64_t value;
+            if (signed_vals) {
+                c = dec_sleb(buf + pos, len - pos, &value);
+            } else {
+                uint64_t uv;
+                c = dec_uleb(buf + pos, len - pos, &uv);
+                value = (int64_t)uv;
+            }
+            if (c < 0) return -1;
+            pos += (size_t)c;
+            size_t take = (size_t)header;
+            if (take > capacity - row) take = capacity - row;
+            for (size_t i = 0; i < take; i++) {
+                out[row] = value;
+                mask[row] = 1;
+                row++;
+            }
+        } else if (header < 0) {
+            size_t litn = (size_t)(-header);
+            for (size_t i = 0; i < litn; i++) {
+                int64_t value;
+                if (signed_vals) {
+                    c = dec_sleb(buf + pos, len - pos, &value);
+                } else {
+                    uint64_t uv;
+                    c = dec_uleb(buf + pos, len - pos, &uv);
+                    value = (int64_t)uv;
+                }
+                if (c < 0) return -1;
+                pos += (size_t)c;
+                if (row < capacity) {
+                    out[row] = value;
+                    mask[row] = 1;
+                    row++;
+                }
+            }
+        } else {
+            uint64_t nulls;
+            c = dec_uleb(buf + pos, len - pos, &nulls);
+            if (c < 0) return -1;
+            pos += (size_t)c;
+            size_t take = (size_t)nulls;
+            if (take > capacity - row) take = capacity - row;
+            for (size_t i = 0; i < take; i++) {
+                out[row] = 0;
+                mask[row] = 0;
+                row++;
+            }
+        }
+    }
+    return (long long)row;
+}
+
+// Delta decode: RLE of successive differences, absolute from 0 (saturating).
+long long am_delta_decode_i64(const uint8_t* buf, size_t len, int64_t* out,
+                              uint8_t* mask, size_t capacity) {
+    long long n = am_rle_decode_i64(buf, len, 1, out, mask, capacity);
+    if (n < 0) return n;
+    int64_t absolute = 0;
+    for (long long i = 0; i < n; i++) {
+        if (mask[i]) {
+            absolute = sat_add(absolute, out[i]);
+            out[i] = absolute;
+        }
+    }
+    return n;
+}
+
+// Boolean decode: alternating ULEB run lengths starting with false.
+long long am_bool_decode(const uint8_t* buf, size_t len, uint8_t* out,
+                         size_t capacity) {
+    size_t pos = 0, row = 0;
+    uint8_t value = 1;
+    while (pos < len && row < capacity) {
+        uint64_t run;
+        int c = dec_uleb(buf + pos, len - pos, &run);
+        if (c < 0) return -1;
+        pos += (size_t)c;
+        value = !value;
+        size_t take = (size_t)run;
+        if (take > capacity - row) take = capacity - row;
+        memset(out + row, value, take);
+        row += take;
+    }
+    return (long long)row;
+}
+
+// ---------------------------------------------------------------------------
+// RLE encode: mirrors the Python state machine byte-for-byte
+// (utils/codecs.py RleEncoder). out must hold >= 12*n + 16 bytes.
+// Returns bytes written, or -1 if out_cap is too small.
+
+namespace {
+
+struct Writer {
+    uint8_t* out;
+    size_t cap;
+    size_t w = 0;
+    bool ok = true;
+    void need(size_t k) {
+        if (w + k > cap) ok = false;
+    }
+    void sleb(int64_t v) {
+        need(10);
+        if (ok) enc_sleb(v, out, &w);
+    }
+    void uleb(uint64_t v) {
+        need(10);
+        if (ok) enc_uleb(v, out, &w);
+    }
+    void value(int64_t v, int signed_vals) {
+        need(10);
+        if (!ok) return;
+        if (signed_vals) enc_sleb(v, out, &w);
+        else enc_uleb((uint64_t)v, out, &w);
+    }
+};
+
+}  // namespace
+
+long long am_rle_encode_i64(const int64_t* vals, const uint8_t* mask, size_t n,
+                            int signed_vals, uint8_t* out, size_t out_cap) {
+    Writer wr{out, out_cap};
+    size_t i = 0;
+    while (i < n && wr.ok) {
+        if (!mask[i]) {  // null run
+            size_t j = i;
+            while (j < n && !mask[j]) j++;
+            // an all-null column encodes to zero bytes; trailing nulls after
+            // values DO flush (mirrors Python: only finish() in NULLS state
+            // flushes, INITIAL_NULLS at finish emits nothing)
+            if (i == 0 && j == n) return 0;
+            wr.sleb(0);
+            wr.uleb((uint64_t)(j - i));
+            i = j;
+            continue;
+        }
+        // count the run of equal values
+        size_t j = i + 1;
+        while (j < n && mask[j] && vals[j] == vals[i]) j++;
+        size_t run = j - i;
+        if (run >= 2) {
+            wr.sleb((int64_t)run);
+            wr.value(vals[i], signed_vals);
+            i = j;
+            continue;
+        }
+        // literal run: values until a pair of equal values or a null
+        size_t lit_start = i;
+        while (true) {
+            if (j >= n || !mask[j]) break;      // next is null/end: lone tail
+            if (vals[j] == vals[j - 1]) {       // a run starts at j-1
+                j--;
+                break;
+            }
+            j++;
+        }
+        size_t litn = j - lit_start;
+        wr.sleb(-(int64_t)litn);
+        for (size_t k = lit_start; k < j && wr.ok; k++)
+            wr.value(vals[k], signed_vals);
+        i = j;
+    }
+    return wr.ok ? (long long)wr.w : -1;
+}
+
+long long am_delta_encode_i64(const int64_t* vals, const uint8_t* mask,
+                              size_t n, uint8_t* out, size_t out_cap,
+                              int64_t* scratch) {
+    int64_t absolute = 0;
+    for (size_t i = 0; i < n; i++) {
+        if (mask[i]) {
+            scratch[i] = sat_sub(vals[i], absolute);
+            absolute = vals[i];
+        } else {
+            scratch[i] = 0;
+        }
+    }
+    return am_rle_encode_i64(scratch, mask, n, 1, out, out_cap);
+}
+
+long long am_bool_encode(const uint8_t* vals, size_t n, uint8_t* out,
+                         size_t out_cap) {
+    Writer wr{out, out_cap};
+    uint8_t last = 0;
+    size_t count = 0;
+    for (size_t i = 0; i < n && wr.ok; i++) {
+        uint8_t v = vals[i] ? 1 : 0;
+        if (v == last) {
+            count++;
+        } else {
+            wr.uleb((uint64_t)count);
+            last = v;
+            count = 1;
+        }
+    }
+    if (count > 0 && wr.ok) wr.uleb((uint64_t)count);
+    return wr.ok ? (long long)wr.w : -1;
+}
+
+}  // extern "C"
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Preorder document-order ranking for the RGA insert forest.
+//
+// Node space mirrors ops/merge.py: element nodes [0, P), object roots
+// [P, N-1), sentinel N-1. first_child / next_sib / parent are int32 node
+// ids with -1 = none. Writes the preorder index of every element node
+// (per its object's traversal) into out[0..P) (-1 for non-elements).
+// Sequential pointer chase: O(n), cache-friendly — the host half of the
+// hybrid merge pipeline. Returns 0, or -1 if the structure is cyclic.
+long long am_preorder_index(const int32_t* first_child, const int32_t* next_sib,
+                            const int32_t* parent, int64_t P, int64_t N,
+                            int32_t* out) {
+    for (int64_t i = 0; i < P; i++) out[i] = -1;
+    int64_t budget = 4 * N + 8;  // cycle guard
+    for (int64_t r = P; r < N - 1; r++) {
+        int32_t cur = first_child[r];
+        int32_t idx = 0;
+        while (cur >= 0 && cur < P) {
+            if (--budget < 0) return -1;
+            out[cur] = idx++;
+            if (first_child[cur] >= 0) {
+                cur = first_child[cur];
+            } else {
+                // climb until a next sibling exists or we re-reach the root
+                int32_t c = cur;
+                cur = -1;
+                while (c >= 0 && c < P) {
+                    if (--budget < 0) return -1;
+                    if (next_sib[c] >= 0) {
+                        cur = next_sib[c];
+                        break;
+                    }
+                    c = parent[c];
+                    if (c == (int32_t)r) break;
+                }
+            }
+        }
+    }
+    return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,240 @@
+"""Vectorized change-column extraction: chunk bytes -> numpy op columns.
+
+The north-star load path (BASELINE.json): instead of materializing one
+Python ChangeOp per op and walking them into the op log, the change
+chunk's own columnar encoding (reference: change/change_op_columns.rs) is
+decoded straight into numpy arrays by the native codec core
+(automerge_tpu/native/codecs.cpp) and assembled into the device column
+layout. Strings (map keys, mark names) stay on the host path; scalar
+payloads are kept as (type_code, offset, length) views into the raw value
+buffer and materialized lazily on readback.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import native
+from ..storage.change import (
+    COL_ACTION,
+    COL_EXPAND,
+    COL_INSERT,
+    COL_KEY_ACTOR,
+    COL_KEY_CTR,
+    COL_KEY_STR,
+    COL_MARK_NAME,
+    COL_OBJ_ACTOR,
+    COL_OBJ_CTR,
+    COL_PRED_ACTOR,
+    COL_PRED_CTR,
+    COL_PRED_GROUP,
+    COL_VAL_META,
+    COL_VAL_RAW,
+    StoredChange,
+)
+from ..types import ScalarValue
+from ..utils.codecs import rle_decode
+from ..utils.leb128 import decode_sleb, decode_uleb
+
+# value-metadata type codes (storage/values.py) — identical to the OpLog
+# TAG_* codes for 0..9; anything else maps to TAG_UNKNOWN at readback
+_CODE_ULEB = 3
+_INT_CODES = (3, 4, 8, 9)  # uint, int, counter, timestamp
+
+
+class ExtractError(ValueError):
+    pass
+
+
+def change_arrays(change: StoredChange) -> Dict[str, np.ndarray]:
+    """Decode one change's op columns to arrays (chunk-local actor idxs)."""
+    cols = change.op_col_data
+    if cols is None:
+        raise ExtractError("change has no retained column data")
+
+    def col(spec) -> bytes:
+        return cols.get(spec, b"")
+
+    n = len(change.ops)
+    cap = n + 1
+
+    action, amask = native.rle_decode_array(col(COL_ACTION), False, cap)
+    if len(action) != n or not amask.all():
+        raise ExtractError("action column mismatch")
+    obj_ctr, obj_mask = _padded(*native.rle_decode_array(col(COL_OBJ_CTR), False, cap), n)
+    obj_actor, obj_amask = _padded(*native.rle_decode_array(col(COL_OBJ_ACTOR), False, cap), n)
+    key_ctr, key_ctr_mask = _padded(*native.delta_decode_array(col(COL_KEY_CTR), cap), n)
+    key_actor, key_actor_mask = _padded(
+        *native.rle_decode_array(col(COL_KEY_ACTOR), False, cap), n
+    )
+    insert = _padded_bool(native.bool_decode_array(col(COL_INSERT), cap), n)
+    expand = _padded_bool(native.bool_decode_array(col(COL_EXPAND), cap), n)
+    meta, meta_mask = _padded(*native.rle_decode_array(col(COL_VAL_META), False, cap), n)
+    meta = np.where(meta_mask, meta, 0)
+
+    pred_num, pn_mask = _padded(*native.rle_decode_array(col(COL_PRED_GROUP), False, cap), n)
+    pred_num = np.where(pn_mask, pred_num, 0)
+    total_preds = int(pred_num.sum())
+    pred_ctr, pc_mask = native.delta_decode_array(col(COL_PRED_CTR), total_preds + 1)
+    pred_actor, pa_mask = native.rle_decode_array(col(COL_PRED_ACTOR), False, total_preds + 1)
+    if len(pred_ctr) < total_preds or len(pred_actor) < total_preds:
+        raise ExtractError("truncated pred columns")
+    if total_preds and not (pc_mask[:total_preds].all() and pa_mask[:total_preds].all()):
+        raise ExtractError("null pred entries")
+
+    # value payloads: code + (offset, length) views into the raw buffer
+    raw = cols.get(COL_VAL_RAW, b"")
+    vcode = (meta & 0xF).astype(np.int32)
+    vlen = (meta >> 4).astype(np.int64)
+    voff = np.concatenate([[0], np.cumsum(vlen)])[:-1]
+    if len(vlen) and int(voff[-1] + vlen[-1]) > len(raw):
+        raise ExtractError("value raw column overrun")
+
+    # integer payloads (uint/int/counter/timestamp + booleans) decoded now —
+    # the kernel needs them; str/bytes/f64 stay lazy
+    value_int = np.zeros(n, np.int64)
+    int_rows = np.flatnonzero(np.isin(vcode, _INT_CODES) & (vlen > 0))
+    for r in int_rows:
+        o = int(voff[r])
+        if vcode[r] == _CODE_ULEB:
+            value_int[r], _ = decode_uleb(raw, o)
+        else:
+            value_int[r], _ = decode_sleb(raw, o)
+    value_int[vcode == 2] = 1  # true
+
+    # utf-8 char widths for string values, vectorized over the raw buffer
+    width = np.ones(n, np.int32)
+    if len(raw):
+        rb = np.frombuffer(raw, np.uint8)
+        cont = np.concatenate([[0], np.cumsum((rb & 0xC0) == 0x80)])
+        srows = vcode == 6
+        width[srows] = (
+            vlen[srows] - (cont[(voff + vlen)[srows]] - cont[voff[srows]])
+        ).astype(np.int32)
+
+    # string-ish host columns (map keys, mark names): python decode, cheap
+    # because RLE runs collapse repeats; None = entirely-null column (the
+    # common case for text workloads), letting callers skip per-row work
+    ks_bytes = col(COL_KEY_STR)
+    if ks_bytes:
+        key_str = rle_decode(ks_bytes, "str", n)
+        key_str += [None] * (n - len(key_str))
+    else:
+        key_str = None
+    mn_bytes = col(COL_MARK_NAME)
+    if mn_bytes:
+        mark_name = rle_decode(mn_bytes, "str", n)
+        mark_name += [None] * (n - len(mark_name))
+    else:
+        mark_name = None
+
+    return {
+        "n": n,
+        "action": action.astype(np.int32),
+        "obj_ctr": np.where(obj_mask, obj_ctr, 0),
+        "obj_has": obj_mask & obj_amask,
+        "obj_actor": np.where(obj_amask, obj_actor, 0),
+        "key_ctr": np.where(key_ctr_mask, key_ctr, -1),
+        "key_has_ctr": key_ctr_mask,
+        "key_actor": np.where(key_actor_mask, key_actor, 0),
+        "key_has_actor": key_actor_mask,
+        "key_str": key_str,
+        "insert": insert,
+        "expand": expand,
+        "vcode": vcode,
+        "voff": voff.astype(np.int64),
+        "vlen": vlen,
+        "vraw": raw,
+        "value_int": value_int,
+        "width": width,
+        "pred_num": pred_num.astype(np.int64),
+        "pred_ctr": pred_ctr[:total_preds],
+        "pred_actor": pred_actor[:total_preds],
+        "mark_name": mark_name,
+    }
+
+
+def _padded(vals: np.ndarray, mask: np.ndarray, n: int):
+    if len(vals) > n:
+        raise ExtractError("column longer than op count")
+    if len(vals) < n:
+        vals = np.concatenate([vals, np.zeros(n - len(vals), vals.dtype)])
+        mask = np.concatenate([mask, np.zeros(n - len(mask), bool)])
+    return vals, mask
+
+
+def _padded_bool(vals: np.ndarray, n: int) -> np.ndarray:
+    if len(vals) > n:
+        raise ExtractError("boolean column longer than op count")
+    if len(vals) < n:
+        vals = np.concatenate([vals, np.zeros(n - len(vals), bool)])
+    return vals.astype(bool)
+
+
+_TAG_NAME = {
+    0: "null",
+    3: "uint",
+    4: "int",
+    5: "f64",
+    6: "str",
+    7: "bytes",
+    8: "counter",
+    9: "timestamp",
+}
+
+
+class LazyValues:
+    """Row -> ScalarValue, materialized on demand from the raw value buffer.
+
+    Drop-in for the eager python list the slow extraction path produces.
+    """
+
+    __slots__ = ("code", "off", "ln", "raw", "cache")
+
+    def __init__(self, code: np.ndarray, off: np.ndarray, ln: np.ndarray, raw: bytes):
+        self.code = code
+        self.off = off
+        self.ln = ln
+        self.raw = raw
+        self.cache: Dict[int, ScalarValue] = {}
+
+    def __len__(self) -> int:
+        return len(self.code)
+
+    def __getitem__(self, row: int) -> ScalarValue:
+        v = self.cache.get(row)
+        if v is None:
+            v = self._decode(row)
+            self.cache[row] = v
+        return v
+
+    def _decode(self, row: int) -> ScalarValue:
+        import struct
+
+        code = int(self.code[row])
+        o = int(self.off[row])
+        ln = int(self.ln[row])
+        chunk = self.raw[o : o + ln]
+        if code == 0:
+            return ScalarValue("null")
+        if code == 1:
+            return ScalarValue("bool", False)
+        if code == 2:
+            return ScalarValue("bool", True)
+        if code == 3:
+            return ScalarValue("uint", decode_uleb(chunk, 0)[0])
+        if code == 4:
+            return ScalarValue("int", decode_sleb(chunk, 0)[0])
+        if code == 5:
+            return ScalarValue("f64", struct.unpack("<d", chunk)[0])
+        if code == 6:
+            return ScalarValue("str", chunk.decode("utf-8"))
+        if code == 7:
+            return ScalarValue("bytes", chunk)
+        if code == 8:
+            return ScalarValue("counter", decode_sleb(chunk, 0)[0])
+        if code == 9:
+            return ScalarValue("timestamp", decode_sleb(chunk, 0)[0])
+        return ScalarValue("unknown", (code, chunk))

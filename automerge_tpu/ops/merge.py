@@ -179,22 +179,71 @@ def resolve_state(c, succ_count, inc_count, counter_inc):
         .set(jnp.where(nxt_same & in_range, nxt_row, NONE32))
     )
 
+    core = {
+        "visible": visible,
+        "counter_inc": counter_inc,
+        "winner": winner,
+        "conflicts": conflicts,
+        "succ_count": succ_count,
+        "inc_count": inc_count,
+        "first_child": first_child,
+        "next_sib": next_sib,
+        "parent_row": parent_row,
+        "is_elem": is_elem,
+    }
+
+    # --- per-object stats (order-independent) ------------------------------
+    elem_vis = is_elem & (winner >= 0)
+    obj_idx = jnp.where(valid, obj_dense, jnp.int32(P + 1))
+    core["obj_vis_len"] = jax.ops.segment_sum(
+        elem_vis.astype(jnp.int32), obj_idx, num_segments=P + 2
+    )
+    w_width = jnp.where(elem_vis, c["width"][jnp.clip(winner, 0, P - 1)], 0)
+    core["obj_text_width"] = jax.ops.segment_sum(
+        w_width, obj_idx, num_segments=P + 2
+    )
+    return core
+
+
+def device_linearize(c, core):
+    """Document-order element indices computed fully on device.
+
+    Pointer-doubling + Wyllie ranking: O(log n) passes of gathers. On TPU
+    the ranking pass gathers along the (near-random) document-order chain,
+    which the hardware handles far worse than the host's sequential walk —
+    so the default pipeline uses the native preorder walk
+    (native am_preorder_index) and this path serves the pure-device /
+    multi-chip dry-run flow.
+    """
+    P = c["action"].shape[0]
+    rows = jnp.arange(P, dtype=jnp.int32)
+    # the doubling loops run in *element* space [0, P) + sentinel P: element
+    # nodes are the only chain participants, so arrays (and the random
+    # gathers, the expensive part on TPU) are half the full node space
+    E = P + 1
+    SE = jnp.int32(P)
+    first_child = core["first_child"]  # node space (roots included)
+    next_sib_e = jnp.concatenate([core["next_sib"][:P], jnp.array([-1], jnp.int32)])
+    fc_e = jnp.concatenate([jnp.minimum(first_child[:P], SE + 1), jnp.array([-1], jnp.int32)])
+    fc_e = jnp.where(fc_e > SE, NONE32, fc_e)  # child refs are always < P
+    parent_row = core["parent_row"]
+    is_elem = core["is_elem"]
+    elem_ref = c["elem_ref"]
+
     # A(i): next sibling of i, else of nearest ancestor (threaded successor),
-    # resolved by pointer doubling over the parent chain
-    node_parent = (
-        jnp.full(N, S, jnp.int32)
-        .at[jnp.where(is_elem, rows, N - 1)]
-        .set(jnp.where(is_elem, parent_row, S))
-    )
-    node_is_elem = (
-        jnp.zeros(N, jnp.bool_)
-        .at[jnp.where(is_elem, rows, N - 1)]
-        .set(is_elem)
-    )
-    has_sib = next_sib != NONE32
-    done = has_sib | ~node_is_elem  # roots & sentinel resolve to END (-1)
-    ans = jnp.where(has_sib & node_is_elem, next_sib, NONE32)
-    jump = node_parent
+    # resolved by pointer doubling over the parent chain. Parents that are
+    # object roots terminate the climb (ans = END).
+    parent_e = jnp.concatenate(
+        [
+            jnp.where(is_elem & (elem_ref >= 0), elem_ref, SE),
+            jnp.array([P], jnp.int32),
+        ]
+    ).astype(jnp.int32)
+    is_elem_e = jnp.concatenate([is_elem, jnp.array([False])])
+    has_sib = next_sib_e != NONE32
+    done = has_sib | ~is_elem_e | (parent_e == SE)
+    ans = jnp.where(has_sib & is_elem_e, next_sib_e, NONE32)
+    jump = parent_e
 
     def _thread(_, st):
         ans, done, jump = st
@@ -205,53 +254,62 @@ def resolve_state(c, succ_count, inc_count, counter_inc):
         return ans, done, jump
 
     ans, done, jump = jax.lax.fori_loop(
-        0, _ceil_log2(N) + 1, _thread, (ans, done, jump)
+        0, _ceil_log2(E) + 1, _thread, (ans, done, jump)
     )
 
     # preorder successor: first child, else A(i); Wyllie ranking gives the
     # distance to the chain end, hence the document-order index
-    succ_node = jnp.where(first_child != NONE32, first_child, ans)
-    nxt = jnp.where(succ_node < 0, S, succ_node)
-    nxt = nxt.at[S].set(S)
-    dist = jnp.where(jnp.arange(N, dtype=jnp.int32) == S, 0, 1).astype(jnp.int32)
+    succ_e = jnp.where(fc_e != NONE32, fc_e, ans)
+    nxt = jnp.where(succ_e < 0, SE, succ_e)
+    nxt = nxt.at[SE].set(SE)
+    dist = jnp.where(jnp.arange(E, dtype=jnp.int32) == SE, 0, 1).astype(jnp.int32)
 
     def _rank(_, st):
         dist, nxt = st
         return dist + dist[nxt], nxt[nxt]
 
-    dist, nxt = jax.lax.fori_loop(0, _ceil_log2(N) + 1, _rank, (dist, nxt))
-    elem_index = jnp.where(is_elem, dist[root_of_row] - dist[rows] - 1, NONE32)
-
-    # --- per-object stats --------------------------------------------------
-    elem_vis = is_elem & (winner >= 0)
-    obj_idx = jnp.where(valid, obj_dense, jnp.int32(P + 1))
-    obj_vis_len = jax.ops.segment_sum(
-        elem_vis.astype(jnp.int32), obj_idx, num_segments=P + 2
+    dist, nxt = jax.lax.fori_loop(0, _ceil_log2(E) + 1, _rank, (dist, nxt))
+    # chain start per row: the root's first child (an element node)
+    start = first_child[P + c["obj_dense"]]
+    start_c = jnp.clip(start, 0, P - 1)
+    return jnp.where(
+        is_elem & (start >= 0), dist[start_c] - dist[rows], NONE32
     )
-    w_width = jnp.where(elem_vis, c["width"][jnp.clip(winner, 0, P - 1)], 0)
-    obj_text_width = jax.ops.segment_sum(w_width, obj_idx, num_segments=P + 2)
-
-    return {
-        "visible": visible,
-        "counter_inc": counter_inc,
-        "winner": winner,
-        "conflicts": conflicts,
-        "elem_index": elem_index,
-        "obj_vis_len": obj_vis_len,
-        "obj_text_width": obj_text_width,
-        "succ_count": succ_count,
-        "inc_count": inc_count,
-    }
 
 
 @jax.jit
 def merge_kernel(c):
-    """Single-device merge: succ resolution + state resolution in one jit."""
+    """Single-device merge, everything on device (incl. linearization)."""
+    core = resolve_state(c, *succ_resolution(c))
+    core["elem_index"] = device_linearize(c, core)
+    return core
+
+
+@jax.jit
+def merge_kernel_core(c):
+    """Device merge without document-order ranking (the hybrid pipeline:
+    the native preorder walk supplies elem_index on host)."""
     return resolve_state(c, *succ_resolution(c))
 
 
-def merge_columns(cols_np):
-    """Host entry: numpy columns in, numpy resolution out (blocks on device)."""
+def merge_columns(cols_np, linearize: str = "auto"):
+    """Host entry: numpy columns in, numpy resolution out.
+
+    ``linearize``: "device" (all on chip), "native" (C++ preorder walk),
+    or "auto" (native when available — the ranking pass's random gathers
+    are a poor fit for TPU, see device_linearize).
+    """
+    from .. import native
+
     cols = {k: jnp.asarray(v) for k, v in cols_np.items()}
+    if linearize == "auto":
+        linearize = "native" if native.preorder_available() else "device"
+    if linearize == "native":
+        out = {k: np.asarray(v) for k, v in merge_kernel_core(cols).items()}
+        P = len(out["visible"])
+        out["elem_index"] = native.preorder_index(
+            out["first_child"], out["next_sib"], out["parent_row"], P
+        )
+        return out
     out = merge_kernel(cols)
     return {k: np.asarray(v) for k, v in out.items()}

@@ -118,13 +118,19 @@ class OpLog:
     # -- construction --------------------------------------------------
 
     @classmethod
-    def from_changes(cls, changes: Iterable[StoredChange]) -> "OpLog":
+    def from_changes(
+        cls, changes: Iterable[StoredChange], fast: bool = None
+    ) -> "OpLog":
         """Flatten changes (deduped by hash) into Lamport-ordered columns.
 
         Order-independent: visibility and RGA order depend only on op ids
         and pred links, never on application order — which is what makes the
         N-way fan-in merge a single batched kernel instead of the
         reference's per-op seek/insert loop (automerge.rs:1258-1280).
+
+        ``fast`` selects the vectorized column extraction (native codecs,
+        ops/extract.py); default: use it when available and every change
+        retains its column bytes. Falls back to the per-op python path.
         """
         log = cls()
         seen = set()
@@ -144,6 +150,21 @@ class OpLog:
         if len(ranked) >= (1 << ACTOR_BITS):
             raise ValueError("too many actors for packed id encoding")
 
+        if fast is None:
+            from .. import native
+
+            fast = native.available() and all(
+                ch.op_col_data is not None for ch in deduped
+            )
+        if fast:
+            try:
+                return cls._collect_fast(log, deduped, rank_of)
+            except Exception:
+                pass  # any extraction surprise: fall back to the op path
+        return cls._collect_slow(log, deduped, rank_of)
+
+    @classmethod
+    def _collect_slow(cls, log, deduped, rank_of) -> "OpLog":
         prop_of: Dict[str, int] = {}
         mark_of: Dict[str, int] = {}
         id_key, obj, prop, elem = [], [], [], []
@@ -187,10 +208,162 @@ class OpLog:
 
         log.props = [p for p, _ in sorted(prop_of.items(), key=lambda kv: kv[1])]
         log.mark_names = [m for m, _ in sorted(mark_of.items(), key=lambda kv: kv[1])]
+        return cls._finalize(
+            log,
+            np.asarray(id_key, np.int64),
+            np.asarray(obj, np.int64),
+            np.asarray(prop, np.int32),
+            np.asarray(elem, np.int64),
+            np.asarray(action, np.int32),
+            np.asarray(insert, np.bool_),
+            np.asarray(vtag, np.int32),
+            np.asarray(vint, np.int64),
+            np.asarray(width, np.int32),
+            np.asarray(expand, np.bool_),
+            np.asarray(mark_idx, np.int32),
+            np.asarray(pred_src, np.int64),
+            np.asarray(pred_key, np.int64),
+            values,
+        )
+
+    @classmethod
+    def _collect_fast(cls, log, deduped, rank_of) -> "OpLog":
+        """Vectorized extraction: change column bytes -> numpy arrays.
+
+        Per change, the native codec core decodes the op columns straight to
+        arrays (ops/extract.py); actor indices are rank-translated with one
+        table gather and everything is concatenated before the shared
+        Lamport sort. Only map keys / mark names touch python, and only once
+        per RLE run.
+        """
+        from .extract import change_arrays
+
+        prop_of: Dict[str, int] = {}
+        mark_of: Dict[str, int] = {}
+        parts = []
+        raw_parts: List[bytes] = []
+        raw_base = 0
+        for ch in deduped:
+            a = change_arrays(ch)
+            n = a["n"]
+            ranks = np.asarray(
+                [rank_of[bytes(x)] for x in ch.actors], np.int64
+            )
+            author = int(ranks[0])
+            id_key = ((ch.start_op + np.arange(n, dtype=np.int64)) << ACTOR_BITS) | author
+            obj = np.where(
+                a["obj_has"],
+                (a["obj_ctr"] << ACTOR_BITS) | ranks[a["obj_actor"]],
+                np.int64(0),
+            )
+            prop = np.full(n, -1, np.int32)
+            key_str = a["key_str"]
+            if key_str is not None:
+                for i, ks in enumerate(key_str):
+                    if ks is not None:
+                        prop[i] = prop_of.setdefault(ks, len(prop_of))
+            elem = np.where(
+                prop >= 0,
+                np.int64(-1),
+                np.where(
+                    a["key_has_actor"],
+                    (a["key_ctr"] << ACTOR_BITS) | ranks[a["key_actor"]],
+                    np.int64(0),  # HEAD (ctr 0, no actor)
+                ),
+            )
+            mark_idx = np.full(n, -1, np.int32)
+            if a["mark_name"] is not None:
+                for i, mn in enumerate(a["mark_name"]):
+                    if mn is not None:
+                        mark_idx[i] = mark_of.setdefault(mn, len(mark_of))
+            pred_src = np.repeat(
+                np.arange(n, dtype=np.int64), a["pred_num"]
+            )
+            pred_key = (a["pred_ctr"] << ACTOR_BITS) | ranks[a["pred_actor"]]
+            parts.append(
+                dict(
+                    id_key=id_key,
+                    obj=obj,
+                    prop=prop,
+                    elem=elem,
+                    action=a["action"],
+                    insert=a["insert"],
+                    vtag=np.minimum(a["vcode"], TAG_UNKNOWN).astype(np.int32),
+                    vint=a["value_int"],
+                    width=a["width"],
+                    expand=a["expand"],
+                    mark_idx=mark_idx,
+                    pred_src=pred_src,
+                    pred_key=pred_key,
+                    vcode=a["vcode"],
+                    voff=a["voff"] + raw_base,
+                    vlen=a["vlen"],
+                )
+            )
+            raw_parts.append(a["vraw"])
+            raw_base += len(a["vraw"])
+
+        def cat(name, dtype):
+            if not parts:
+                return np.empty(0, dtype)
+            return np.concatenate([p[name] for p in parts]).astype(dtype)
+
+        row_bases = np.cumsum([0] + [len(p["id_key"]) for p in parts])[:-1]
+        pred_src_all = (
+            np.concatenate(
+                [p["pred_src"] + b for p, b in zip(parts, row_bases)]
+            ).astype(np.int64)
+            if parts
+            else np.empty(0, np.int64)
+        )
+        log.props = [p for p, _ in sorted(prop_of.items(), key=lambda kv: kv[1])]
+        log.mark_names = [m for m, _ in sorted(mark_of.items(), key=lambda kv: kv[1])]
+        return cls._finalize(
+            log,
+            cat("id_key", np.int64),
+            cat("obj", np.int64),
+            cat("prop", np.int32),
+            cat("elem", np.int64),
+            cat("action", np.int32),
+            cat("insert", np.bool_),
+            cat("vtag", np.int32),
+            cat("vint", np.int64),
+            cat("width", np.int32),
+            cat("expand", np.bool_),
+            cat("mark_idx", np.int32),
+            pred_src_all,
+            cat("pred_key", np.int64),
+            (
+                cat("vcode", np.int32),
+                cat("voff", np.int64),
+                cat("vlen", np.int64),
+                b"".join(raw_parts),
+            ),
+        )
+
+    @classmethod
+    def _finalize(
+        cls,
+        log,
+        id_key,
+        obj,
+        prop,
+        elem,
+        action,
+        insert,
+        vtag,
+        vint,
+        width,
+        expand,
+        mark_idx,
+        pred_src,
+        pred_key,
+        values,
+    ) -> "OpLog":
+        """Sort everything into Lamport order and resolve references."""
         n = len(id_key)
         log.n = n
 
-        id_key = np.asarray(id_key, np.int64)
         # one argsort makes row index == dense Lamport rank
         order = np.argsort(id_key, kind="stable")
         log.id_key = id_key[order]
@@ -205,7 +378,13 @@ class OpLog:
         log.width = np.asarray(width, np.int32)[order]
         log.expand = np.asarray(expand, np.bool_)[order]
         log.mark_name_idx = np.asarray(mark_idx, np.int32)[order]
-        log.values = [values[i] for i in order]
+        if isinstance(values, tuple):  # lazy heap: (code, off, len, raw)
+            from .extract import LazyValues
+
+            code, off, ln, raw = values
+            log.values = LazyValues(code[order], off[order], ln[order], raw)
+        else:
+            log.values = [values[i] for i in order]
 
         # resolve cross-op references to row indices (vectorized joins)
         inv = np.empty(n, np.int32)  # old row -> new row
@@ -255,8 +434,8 @@ class OpLog:
         Counter payloads are truncated to int32 on device (exact int64
         totals are recovered host-side from ``value_int`` when needed).
         """
-        p = _next_pow2(max(self.n, min_capacity))
-        q = _next_pow2(max(len(self.pred_src), min_capacity))
+        p = _capacity(self.n, min_capacity)
+        q = _capacity(len(self.pred_src), min_capacity)
         return {
             "action": _pad(self.action, p, PAD_ACTION),
             "insert": _pad(self.insert, p, False),
@@ -311,6 +490,15 @@ def _int_payload(v: ScalarValue) -> int:
 
 def _next_pow2(n: int) -> int:
     return 1 << (n - 1).bit_length()
+
+
+def _capacity(n: int, minimum: int = 16) -> int:
+    """Jit-bucket capacity: powers of two up to 8k, then multiples of 8k —
+    snug enough that padded work stays within ~12% of the real row count."""
+    n = max(n, minimum)
+    if n <= 8192:
+        return _next_pow2(n)
+    return ((n + 8191) // 8192) * 8192
 
 
 def _pad(a: np.ndarray, size: int, fill) -> np.ndarray:

@@ -27,7 +27,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.merge import resolve_state, succ_resolution
+from ..ops.merge import device_linearize, resolve_state, succ_resolution
 
 AXIS = "shard"
 
@@ -52,7 +52,9 @@ def _sharded_merge(c):
     succ_count, inc_count, counter_inc = (
         jax.lax.psum(x, AXIS) for x in partial_counts
     )
-    return resolve_state(c, succ_count, inc_count, counter_inc)
+    core = resolve_state(c, succ_count, inc_count, counter_inc)
+    core["elem_index"] = device_linearize(c, core)
+    return core
 
 
 @lru_cache(maxsize=None)

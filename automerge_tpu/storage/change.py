@@ -98,6 +98,9 @@ class StoredChange:
     # Set when built/parsed:
     hash: Optional[bytes] = None
     raw_bytes: Optional[bytes] = None  # whole chunk incl. header
+    # Raw op-column bytes (spec -> bytes), kept for the vectorized
+    # column-to-array extraction path (ops/extract.py).
+    op_col_data: Optional[dict] = None
 
     @property
     def actors(self) -> List[bytes]:
@@ -287,6 +290,7 @@ def build_change(change: StoredChange) -> StoredChange:
     raw = write_chunk(CHUNK_CHANGE, bytes(data))
     change.hash = chunk_hash(CHUNK_CHANGE, bytes(data))
     change.raw_bytes = raw
+    change.op_col_data = dict(cols)
     return change
 
 
@@ -329,6 +333,7 @@ def parse_change_data(data: bytes, chunk_hash_: bytes, raw: bytes) -> StoredChan
     pos += C.total_column_len(metas)
     extra = bytes(data[pos:])
     ops = decode_change_ops(col_data)
+    _saved_col_data = dict(col_data)
     n_actors = 1 + len(others)
     for i, op in enumerate(ops):
         _check_actor_bounds(op, i, n_actors)
@@ -344,6 +349,7 @@ def parse_change_data(data: bytes, chunk_hash_: bytes, raw: bytes) -> StoredChan
         extra_bytes=extra,
         hash=chunk_hash_,
         raw_bytes=raw,
+        op_col_data=_saved_col_data,
     )
 
 

@@ -4,14 +4,21 @@
 The north-star workload (BASELINE.json): K divergent replicas of a text
 document built from the canonical edit trace (reference:
 rust/edit-trace/edits.json, 259,778 real editing operations) merged into
-one converged document. The device path resolves the whole merged op log
-in one batched kernel (automerge_tpu/ops/merge.py); the baseline is the
-host-side sequential apply loop (automerge_tpu/core), the same algorithm
-shape as the reference's ``apply_changes``.
+one converged document. The device path extracts columns with the native
+codec core and resolves the whole merged op log in one batched kernel
+(automerge_tpu/ops); the baseline is the host-side sequential apply loop
+(automerge_tpu/core), the same algorithm shape as the reference's
+``apply_changes``.
+
+K replicas are produced by replaying distinct trace slices on a few real
+forks, then amplifying each divergent change under fresh actor ids —
+structurally identical concurrent edits from many actors, the same shape
+the reference's fork/merge benchmark configs describe.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": ops/sec through the device merge,
-   "unit": "ops/s", "vs_baseline": speedup over host sequential merge}
+  {"metric": ..., "value": ops/sec through the device merge path
+   (extraction + kernel), "unit": "ops/s",
+   "vs_baseline": speedup over host sequential merge}
 """
 
 import json
@@ -23,9 +30,10 @@ import numpy as np
 
 TRACE = "/root/reference/rust/edit-trace/edits.json"
 
-BASE_EDITS = int(os.environ.get("BENCH_BASE_EDITS", "8000"))
-FORKS = int(os.environ.get("BENCH_FORKS", "64"))
-FORK_EDITS = int(os.environ.get("BENCH_FORK_EDITS", "150"))
+BASE_EDITS = int(os.environ.get("BENCH_BASE_EDITS", "20000"))
+REAL_FORKS = int(os.environ.get("BENCH_REAL_FORKS", "8"))
+AMPLIFY = int(os.environ.get("BENCH_AMPLIFY", "16"))  # replicas = 8*16 = 128
+FORK_EDITS = int(os.environ.get("BENCH_FORK_EDITS", "400"))
 REPS = int(os.environ.get("BENCH_REPS", "3"))
 
 
@@ -36,7 +44,7 @@ def load_trace():
     # synthetic fallback: same shape as the trace, deterministic
     rng = np.random.default_rng(0)
     edits, length = [], 0
-    for _ in range(BASE_EDITS + FORKS * FORK_EDITS + 1000):
+    for _ in range(BASE_EDITS + REAL_FORKS * FORK_EDITS + 1000):
         if length == 0 or rng.random() < 0.85:
             pos = int(rng.integers(0, length + 1))
             edits.append([pos, 0, "x"])
@@ -56,10 +64,35 @@ def apply_edits(doc, text_obj, edits):
         doc.splice_text(text_obj, pos, ndel, "".join(e[2:]))
 
 
+def amplify_change(stored, new_actor: bytes):
+    """Re-author a divergent change under a fresh actor id.
+
+    The ops are position-identical concurrent edits by another actor —
+    exactly what K users typing the same places produces. Chunk-local op
+    encodings reference the author as actor 0, so only the actor table
+    changes; build_change recomputes bytes and hash.
+    """
+    from automerge_tpu.storage.change import StoredChange, build_change
+
+    return build_change(
+        StoredChange(
+            dependencies=list(stored.dependencies),
+            actor=new_actor,
+            other_actors=list(stored.other_actors),
+            seq=stored.seq,
+            start_op=stored.start_op,
+            timestamp=stored.timestamp,
+            message=stored.message,
+            ops=list(stored.ops),
+        )
+    )
+
+
 def main():
     from automerge_tpu.api import AutoDoc
+    from automerge_tpu.core.document import Document
     from automerge_tpu.ops import DeviceDoc, OpLog
-    from automerge_tpu.ops.merge import merge_kernel
+    from automerge_tpu.ops.merge import merge_columns, merge_kernel
     from automerge_tpu.types import ActorId, ObjType
 
     trace = load_trace()
@@ -70,45 +103,61 @@ def main():
     base.commit()
     t_base = time.perf_counter() - t0
 
-    forks = []
+    # real forks: distinct trace slices replayed on top of the base
     t0 = time.perf_counter()
-    for i in range(FORKS):
+    divergent = []
+    for i in range(REAL_FORKS):
         f = base.fork(actor=ActorId(bytes([2]) * 15 + bytes([i])))
         lo = BASE_EDITS + i * FORK_EDITS
         apply_edits(f, text, trace[lo : lo + FORK_EDITS])
         f.commit()
-        forks.append(f)
+        divergent.append(f.doc.history[-1].stored)
+    # amplification: the same divergence re-authored by more actors
+    changes = [a.stored for a in base.doc.history]
+    for k in range(AMPLIFY):
+        for i, d in enumerate(divergent):
+            if k == 0:
+                changes.append(d)
+            else:
+                changes.append(
+                    amplify_change(d, bytes([3]) * 14 + bytes([k, i]))
+                )
     t_forks = time.perf_counter() - t0
+    n_replicas = REAL_FORKS * AMPLIFY
 
-    # --- device path -------------------------------------------------------
+    # --- device path: columnar extraction + batched merge kernel -----------
     import jax
     import jax.numpy as jnp
 
     t0 = time.perf_counter()
-    log = OpLog.from_documents(forks)
+    log = OpLog.from_changes(changes)
     t_extract = time.perf_counter() - t0
-    cols = {k: jnp.asarray(v) for k, v in log.padded_columns().items()}
+    padded = log.padded_columns()
+    # device-resident timing: columns stay on chip, outputs are blocked on
+    # but not transferred (transfer costs are environment-specific; readback
+    # uses the hybrid native-walk path via merge_columns below)
+    cols = {k: jnp.asarray(v) for k, v in padded.items()}
     jax.block_until_ready(cols)
-    # warmup / compile
-    jax.block_until_ready(merge_kernel(cols))
+    jax.block_until_ready(merge_kernel(cols))  # warmup / compile
     t_kernel = min(
         _timed(lambda: jax.block_until_ready(merge_kernel(cols)))
         for _ in range(REPS)
     )
+    t_device = t_extract + t_kernel
+    res = merge_columns(padded)
 
-    # --- host baseline: sequential merge of the same replicas --------------
+    # --- host baseline: sequential apply of the same changes ---------------
     t0 = time.perf_counter()
-    host = AutoDoc(actor=ActorId(bytes([3]) * 16))
-    for f in forks:
-        host.merge(f)
+    host = Document(ActorId(bytes([9]) * 16))
+    host.apply_changes(changes)
     t_host = time.perf_counter() - t0
 
     # sanity: converged state must match
-    dev = DeviceDoc(log, {k: np.asarray(v) for k, v in merge_kernel(cols).items()})
+    dev = DeviceDoc(log, res)
     assert dev.text(text) == host.text(text), "device/host merge divergence"
 
     ops = log.n
-    dev_rate = ops / t_kernel
+    dev_rate = ops / t_device
     host_rate = ops / t_host
     result = {
         "metric": "edit_trace_fanin_merge_ops_per_sec",
@@ -122,14 +171,15 @@ def main():
             json.dumps(
                 {
                     "ops_merged": ops,
-                    "forks": FORKS,
-                    "capacity": int(cols["action"].shape[0]),
+                    "replicas": n_replicas,
+                    "capacity": int(len(padded["action"])),
+                    "t_extract_s": round(t_extract, 4),
                     "t_kernel_s": round(t_kernel, 4),
                     "t_host_merge_s": round(t_host, 3),
-                    "t_extract_s": round(t_extract, 3),
                     "t_base_build_s": round(t_base, 3),
                     "t_fork_build_s": round(t_forks, 3),
                     "host_ops_per_sec": round(host_rate, 1),
+                    "kernel_only_ops_per_sec": round(ops / t_kernel, 1),
                     "device": str(jax.devices()[0]),
                 },
             ),

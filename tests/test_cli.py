@@ -1,0 +1,109 @@
+"""CLI: export/import/merge/examine/examine-sync/change roundtrips."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from automerge_tpu.api import AutoDoc
+from automerge_tpu.cli import main
+from automerge_tpu.sync import SyncState
+from automerge_tpu.types import ActorId, ObjType, ScalarValue
+
+
+def actor(i):
+    return ActorId(bytes([i]) * 16)
+
+
+@pytest.fixture
+def doc_file(tmp_path):
+    d = AutoDoc(actor=actor(1))
+    t = d.put_object("_root", "title", ObjType.TEXT)
+    d.splice_text(t, 0, 0, "hello cli")
+    d.put("_root", "count", 3)
+    d.commit()
+    p = tmp_path / "doc.automerge"
+    p.write_bytes(d.save())
+    return p
+
+
+def test_export(doc_file, tmp_path, capsys):
+    out = tmp_path / "doc.json"
+    assert main(["export", str(doc_file), "-o", str(out)]) == 0
+    assert json.loads(out.read_text()) == {"title": "hello cli", "count": 3}
+
+
+def test_import_roundtrip(tmp_path):
+    src = tmp_path / "in.json"
+    src.write_text(json.dumps({"a": 1, "items": [1, 2, {"x": True}], "s": "txt"}))
+    binout = tmp_path / "out.automerge"
+    assert main(["import", str(src), "-o", str(binout)]) == 0
+    jsonout = tmp_path / "roundtrip.json"
+    assert main(["export", str(binout), "-o", str(jsonout)]) == 0
+    assert json.loads(jsonout.read_text()) == {
+        "a": 1,
+        "items": [1, 2, {"x": True}],
+        "s": "txt",
+    }
+
+
+def test_merge(doc_file, tmp_path):
+    d = AutoDoc.load(doc_file.read_bytes())
+    f = d.fork(actor=actor(2))
+    f.put("_root", "extra", "merged")
+    f.commit()
+    other = tmp_path / "other.automerge"
+    other.write_bytes(f.save())
+    merged = tmp_path / "merged.automerge"
+    assert main(["merge", str(doc_file), str(other), "-o", str(merged)]) == 0
+    m = AutoDoc.load(merged.read_bytes())
+    assert m.hydrate() == {"title": "hello cli", "count": 3, "extra": "merged"}
+
+
+def test_examine(doc_file, tmp_path):
+    out = tmp_path / "changes.json"
+    assert main(["examine", str(doc_file), "-o", str(out)]) == 0
+    changes = json.loads(out.read_text())
+    assert len(changes) == 1
+    ops = changes[0]["ops"]
+    assert ops[0]["action"] == "makeText"
+    assert changes[0]["hash"]
+    assert all("obj" in op for op in ops)
+
+
+def test_examine_sync(doc_file, tmp_path):
+    d = AutoDoc.load(doc_file.read_bytes())
+    msg = d.generate_sync_message(SyncState())
+    msg_file = tmp_path / "msg.sync"
+    msg_file.write_bytes(msg.encode())
+    out = tmp_path / "msg.json"
+    assert main(["examine-sync", str(msg_file), "-o", str(out)]) == 0
+    decoded = json.loads(out.read_text())
+    assert decoded["heads"] == [h.hex() for h in d.get_heads()]
+
+
+def test_change_script(tmp_path):
+    out = tmp_path / "new.automerge"
+    script = 'set .title "doc"; set .meta \'{"v": 1}\'; counter .n 5; increment .n 3'
+    assert main(["change", script, "-o", str(out)]) == 0
+    d = AutoDoc.load(out.read_bytes())
+    assert d.hydrate() == {"title": "doc", "meta": {"v": 1}, "n": 8}
+
+
+def test_change_on_existing(doc_file, tmp_path):
+    out = tmp_path / "edited.automerge"
+    script = "splice .title 5 0 ' brave'; delete .count"
+    assert main(["change", str(doc_file), script, "-o", str(out)]) == 0
+    d = AutoDoc.load(out.read_bytes())
+    assert d.hydrate() == {"title": "hello brave cli"}
+
+
+def test_module_invocation(doc_file):
+    r = subprocess.run(
+        [sys.executable, "-m", "automerge_tpu", "export", str(doc_file), "-o", "-"],
+        capture_output=True,
+        cwd="/root/repo",
+    )
+    assert r.returncode == 0
+    assert json.loads(r.stdout) == {"title": "hello cli", "count": 3}

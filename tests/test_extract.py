@@ -1,0 +1,92 @@
+"""Fast (native columnar) vs slow (per-op) OpLog extraction equivalence."""
+
+import numpy as np
+import pytest
+
+from automerge_tpu import native
+from automerge_tpu.api import AutoDoc
+from automerge_tpu.ops import DeviceDoc, OpLog
+from automerge_tpu.types import ActorId, ObjType, ScalarValue
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native codecs unavailable"
+)
+
+
+def actor(i):
+    return ActorId(bytes([i]) * 16)
+
+
+def build_docs():
+    base = AutoDoc(actor=actor(1))
+    t = base.put_object("_root", "t", ObjType.TEXT)
+    base.splice_text(t, 0, 0, "columnar extraction test ✓ ünïcode")
+    base.put("_root", "n", ScalarValue("counter", 7))
+    base.put("_root", "pi", 3.25)
+    base.put("_root", "blob", b"\x00\x01\x02")
+    lst = base.put_object("_root", "l", ObjType.LIST)
+    base.insert(lst, 0, "item")
+    base.mark(t, 0, 9, "bold", True)
+    base.commit()
+    forks = [base.fork(actor=actor(10 + i)) for i in range(3)]
+    for i, f in enumerate(forks):
+        f.splice_text(t, i * 2, 1, f"<{i}>")
+        f.increment("_root", "n", i + 1)
+        f.put("_root", f"k{i}", i)
+        f.commit()
+    return forks, t
+
+
+def collect_changes(docs):
+    out = []
+    for d in docs:
+        out.extend(a.stored for a in d.doc.history)
+    return out
+
+
+def test_fast_slow_equivalence():
+    forks, t = build_docs()
+    changes = collect_changes(forks)
+    fast = OpLog.from_changes(changes, fast=True)
+    slow = OpLog.from_changes(changes, fast=False)
+    assert fast.n == slow.n
+    for field in (
+        "id_key", "obj_key", "prop", "elem_ref", "action", "insert",
+        "value_tag", "value_int", "width", "expand", "mark_name_idx",
+        "pred_src", "pred_tgt", "obj_dense",
+    ):
+        np.testing.assert_array_equal(
+            getattr(fast, field), getattr(slow, field), err_msg=field
+        )
+    assert fast.props == slow.props
+    assert fast.mark_names == slow.mark_names
+    for i in range(fast.n):
+        assert fast.values[i] == slow.values[i], i
+
+
+def test_fast_path_readback_matches_host():
+    forks, t = build_docs()
+    log = OpLog.from_changes(collect_changes(forks), fast=True)
+    dev = DeviceDoc.resolve(log)
+    host = AutoDoc(actor=actor(99))
+    for f in forks:
+        host.merge(f)
+    assert dev.hydrate() == host.hydrate()
+    assert dev.text(t) == host.text(t)
+
+
+def test_roundtrip_through_save_load_bytes():
+    """Changes reparsed from saved bytes also take the fast path."""
+    forks, t = build_docs()
+    saved = [AutoDoc.load(f.save()) for f in forks]
+    changes = collect_changes(saved)
+    assert all(c.op_col_data is not None for c in changes)
+    fast = OpLog.from_changes(changes, fast=True)
+    slow = OpLog.from_changes(changes, fast=False)
+    assert fast.n == slow.n
+    np.testing.assert_array_equal(fast.id_key, slow.id_key)
+    dev = DeviceDoc.resolve(fast)
+    host = AutoDoc(actor=actor(98))
+    for f in saved:
+        host.merge(f)
+    assert dev.hydrate() == host.hydrate()

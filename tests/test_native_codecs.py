@@ -1,0 +1,149 @@
+"""Differential tests: the native C++ codecs must be byte-identical to the
+pure-Python codecs (change hashes are computed over these bytes).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from automerge_tpu import native
+from automerge_tpu.utils.codecs import (
+    BooleanEncoder,
+    DeltaEncoder,
+    RleEncoder,
+    boolean_decode,
+    delta_decode,
+    rle_decode,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native codecs unavailable (no compiler)"
+)
+
+
+def py_rle_encode(values, kind):
+    enc = RleEncoder(kind)
+    for v in values:
+        enc.append(v)
+    return enc.finish()
+
+
+def arrays_from(values):
+    vals = np.array([0 if v is None else v for v in values], np.int64)
+    mask = np.array([v is not None for v in values], np.uint8)
+    return vals, mask
+
+
+CASES = [
+    [],
+    [None, None, None],
+    [5],
+    [5, 7],
+    [5, 7, 7],
+    [5, 7, 7, 7, 9],
+    [7, 7, 5],
+    [5, None],
+    [None, 5],
+    [None, None, 3, 3, 3, None, 1, 2, 3, 3, None],
+    [0] * 100,
+    list(range(50)),
+    [2**40, 2**40, -(2**40), 0, None],
+]
+
+
+@pytest.mark.parametrize("case", CASES)
+@pytest.mark.parametrize("signed", [False, True])
+def test_rle_encode_identical(case, signed):
+    if not signed and any(v is not None and v < 0 for v in case):
+        pytest.skip("negative values need signed")
+    kind = "int" if signed else "uint"
+    expected = py_rle_encode(case, kind)
+    vals, mask = arrays_from(case)
+    assert native.rle_encode_array(vals, mask, signed) == expected
+
+
+@pytest.mark.parametrize("case", CASES)
+@pytest.mark.parametrize("signed", [False, True])
+def test_rle_decode_identical(case, signed):
+    if not signed and any(v is not None and v < 0 for v in case):
+        pytest.skip("negative values need signed")
+    kind = "int" if signed else "uint"
+    buf = py_rle_encode(case, kind)
+    vals, mask = native.rle_decode_array(buf, signed, len(case) + 8)
+    got = [int(v) if m else None for v, m in zip(vals, mask)]
+    assert got == rle_decode(buf, kind, count=len(case))
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_rle_fuzz_roundtrip(seed):
+    rng = random.Random(seed)
+    values = []
+    for _ in range(rng.randrange(1, 400)):
+        r = rng.random()
+        if r < 0.2:
+            values.append(None)
+        elif r < 0.6:
+            values.append(rng.randrange(10))  # encourage runs
+        else:
+            values.append(rng.randrange(-(2**50), 2**50))
+    expected = py_rle_encode(values, "int")
+    vals, mask = arrays_from(values)
+    assert native.rle_encode_array(vals, mask, True) == expected
+    dvals, dmask = native.rle_decode_array(expected, True, len(values))
+    got = [int(v) if m else None for v, m in zip(dvals, dmask)]
+    assert got == values
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_delta_identical(seed):
+    rng = random.Random(100 + seed)
+    values = []
+    acc = 0
+    for _ in range(rng.randrange(1, 300)):
+        if rng.random() < 0.15:
+            values.append(None)
+        else:
+            acc += rng.randrange(-5, 50)
+            values.append(acc)
+    enc = DeltaEncoder()
+    for v in values:
+        enc.append(v)
+    expected = enc.finish()
+    vals, mask = arrays_from(values)
+    assert native.delta_encode_array(vals, mask) == expected
+    dvals, dmask = native.delta_decode_array(expected, len(values))
+    got = [int(v) if m else None for v, m in zip(dvals, dmask)]
+    assert got == delta_decode(expected, count=len(values))== values
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_boolean_identical(seed):
+    rng = random.Random(200 + seed)
+    values = [rng.random() < 0.5 for _ in range(rng.randrange(1, 500))]
+    enc = BooleanEncoder()
+    for v in values:
+        enc.append(v)
+    expected = enc.finish()
+    assert native.bool_encode_array(np.array(values, np.uint8)) == expected
+    got = native.bool_decode_array(expected, len(values))
+    assert list(got) == boolean_decode(expected, count=len(values)) == values
+
+
+def test_malformed_input_rejected():
+    with pytest.raises(ValueError):
+        native.rle_decode_array(b"\x01\x80\x80", False, 10)  # truncated uleb
+    with pytest.raises(ValueError):
+        native.rle_decode_array(b"\x80", False, 10)  # truncated header
+    # overlong encodings rejected like the python parser
+    with pytest.raises(ValueError):
+        native.rle_decode_array(b"\x01\x85\x00", False, 10)
+
+
+def test_hostile_run_lengths_clamped():
+    # header claims 2^40 values; capacity clamps, no OOM
+    from automerge_tpu.utils.leb128 import sleb_bytes, uleb_bytes
+
+    buf = sleb_bytes(1 << 40) + uleb_bytes(7)
+    vals, mask = native.rle_decode_array(buf, False, 100)
+    assert len(vals) == 100 and all(vals == 7)
