@@ -46,6 +46,22 @@ def default_mesh(n_devices: Optional[int] = None, devices: Optional[Sequence] = 
     return Mesh(np.array(devs), (AXIS,))
 
 
+# column -> partition spec: the pred stream splits along the mesh axis, op
+# columns are replicated (single source of truth for in_specs + device_put)
+COLUMN_SPECS = {
+    "action": P(),
+    "insert": P(),
+    "prop": P(),
+    "elem_ref": P(),
+    "obj_dense": P(),
+    "value_tag": P(),
+    "value_i32": P(),
+    "width": P(),
+    "pred_src": P(AXIS),
+    "pred_tgt": P(AXIS),
+}
+
+
 def _sharded_merge(c):
     """shard_map body: sharded pred scatter + psum, replicated resolution."""
     partial_counts = succ_resolution(c)
@@ -65,27 +81,12 @@ def make_sharded_merge(mesh: Mesh):
     is split along the mesh axis; op columns are replicated. Output arrays
     are replicated (identical on every chip).
     """
-    shard = P(AXIS)
-    rep = P()
-    in_specs = (
-        {
-            "action": rep,
-            "insert": rep,
-            "prop": rep,
-            "elem_ref": rep,
-            "obj_dense": rep,
-            "value_tag": rep,
-            "value_i32": rep,
-            "width": rep,
-            "pred_src": shard,
-            "pred_tgt": shard,
-        },
-    )
+    in_specs = (dict(COLUMN_SPECS),)
     fn = jax.shard_map(
         _sharded_merge,
         mesh=mesh,
         in_specs=in_specs,
-        out_specs=rep,
+        out_specs=P(),
     )
     return jax.jit(fn)
 
@@ -98,15 +99,22 @@ def _pad_to_multiple(a: np.ndarray, m: int, fill) -> np.ndarray:
 
 
 def sharded_merge_columns(cols_np, mesh: Optional[Mesh] = None):
-    """Host entry: numpy columns in, numpy resolution out, over ``mesh``."""
-    import jax.numpy as jnp
+    """Host entry: numpy columns in, numpy resolution out, over ``mesh``.
 
+    Arrays are placed with explicit per-column shardings on the mesh's own
+    devices — never the process-default backend, which may be a different
+    (or unusable) client than the mesh was built over.
+    """
     mesh = mesh or default_mesh()
     n = mesh.devices.size
     cols_np = dict(cols_np)
     # the pred stream must split evenly across the mesh axis
     cols_np["pred_src"] = _pad_to_multiple(cols_np["pred_src"], n, 0)
     cols_np["pred_tgt"] = _pad_to_multiple(cols_np["pred_tgt"], n, -1)
+    cols = {
+        k: jax.device_put(v, NamedSharding(mesh, COLUMN_SPECS[k]))
+        for k, v in cols_np.items()
+    }
     fn = make_sharded_merge(mesh)
-    out = fn({k: jnp.asarray(v) for k, v in cols_np.items()})
+    out = fn(cols)
     return {k: np.asarray(v) for k, v in out.items()}
