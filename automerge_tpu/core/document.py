@@ -340,7 +340,11 @@ class Document:
         else:
             if not isinstance(prop, int):
                 raise AutomergeError("sequence lookup requires an integer index")
-            el = self.ops.nth(obj_id, prop, LIST_ENC, clock)
+            # index by the object's own encoding: character position for
+            # TEXT (reference get_all_for passes obj.encoding,
+            # automerge.rs:1544-1556)
+            enc = TEXT_ENC if info.data.obj_type == ObjType.TEXT else LIST_ENC
+            el = self.ops.nth(obj_id, prop, enc, clock)
             if el is None:
                 return []
             vis = el.visible_ops(clock)

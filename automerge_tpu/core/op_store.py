@@ -449,7 +449,7 @@ class OpStore:
         if not isinstance(obj, SeqObject):
             raise OpStoreError("nth on map object")
         if clock is not None:
-            return self._nth_scan(obj, index, encoding, clock)
+            return self._nth_scan(obj, index, encoding, clock)[0]
         cur = obj._cursor
         if cur is not None and encoding == cur[3]:
             el, li, ti = cur[0], cur[1], cur[2]
@@ -461,7 +461,7 @@ class OpStore:
                     found = self._walk_backward(obj, el, at, index, encoding)
                 if found is not None:
                     return found
-        return self._nth_scan(obj, index, encoding, None)
+        return self._nth_scan(obj, index, encoding, None)[0]
 
     def _walk_forward(self, obj, el, at, index, encoding):
         while el is not None:
@@ -494,6 +494,7 @@ class OpStore:
                 return None
 
     def _nth_scan(self, obj, index, encoding, clock):
+        """(element, span start) of the visible element covering ``index``."""
         at = 0
         for el in obj.elements():
             w = el.winner(clock)
@@ -503,9 +504,9 @@ class OpStore:
             if at <= index < at + width:
                 if clock is None:
                     self._set_cursor(obj, el, at, encoding)
-                return el
+                return el, at
             at += width
-        return None
+        return None, -1
 
     def _set_cursor(self, obj, el, at, encoding):
         if encoding == LIST_ENC:
@@ -515,6 +516,27 @@ class OpStore:
 
     def seed_cursor(self, obj, el, at: int, encoding: int) -> None:
         obj.seed_cursor(el, at, encoding)
+
+    def nth_with_pos(
+        self, obj_id: OpId, index: int, encoding: int = LIST_ENC, clock=None
+    ):
+        """(element, start position) of the visible element covering ``index``.
+
+        The start position is where the element's span begins — strictly less
+        than ``index`` when a multi-width text element crosses it (the
+        reference's Nth query reports this as ``query.index()``,
+        transaction/inner.rs:631-637).
+        """
+        obj = self.get_obj(obj_id).data
+        if clock is not None:
+            return self._nth_scan(obj, index, encoding, clock)
+        el = self.nth(obj_id, index, encoding, None)
+        if el is None:
+            return None, -1
+        cur = obj._cursor
+        if cur is not None and cur[0] is el:
+            return el, cur[1] if encoding == LIST_ENC else cur[2]
+        return self._nth_scan(obj, index, encoding, None)
 
     def visible_elements(self, obj_id: OpId, clock=None) -> Iterator[Tuple[Element, Op]]:
         obj = self.get_obj(obj_id).data

@@ -339,6 +339,14 @@ class Transaction:
         """
         ops = self.doc.ops
         obj = ops.get_obj(obj_id).data
+        if delete > 0 and enc == TEXT_ENC:
+            target, t_start = ops.nth_with_pos(obj_id, pos, enc, self.scope)
+            if target is not None and t_start < pos:
+                # deletion begins mid-way through a multi-width element:
+                # rewind to the element start and expand the deleted span
+                # (reference inner_splice's adjusted_index, inner.rs:631-637)
+                delete += pos - t_start
+                pos = t_start
         # anchor: the visible element just before pos (None at HEAD)
         if pos == 0:
             anchor = None

@@ -17,16 +17,15 @@ Array-level API (numpy in/out):
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
-import tempfile
 from typing import Optional, Tuple
 
 import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "codecs.cpp")
-_LIB_NAME = "_codecs.so"
 
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
@@ -37,31 +36,53 @@ class NativeUnavailable(RuntimeError):
 
 
 def _build(lib_path: str) -> bool:
+    # compile to a temp path and rename into place: a killed/concurrent
+    # build must never leave a partial file at the final (content-hash) name,
+    # which would be trusted forever
+    tmp = f"{lib_path}.tmp{os.getpid()}"
     cmd = [
         "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-        "-o", lib_path, _SRC,
+        "-o", tmp, _SRC,
     ]
     try:
         r = subprocess.run(cmd, capture_output=True, timeout=120)
-        return r.returncode == 0 and os.path.exists(lib_path)
+        if r.returncode != 0 or not os.path.exists(tmp):
+            return False
+        os.replace(tmp, lib_path)
+        return True
     except (OSError, subprocess.TimeoutExpired):
         return False
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+def _lib_name() -> str:
+    # the source content hash is baked into the file name, so a stale build
+    # of an older codecs.cpp can never be loaded by mistake (these codecs
+    # produce the bytes change hashes are computed over — loading stale
+    # native code would silently corrupt hashing / the save format)
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    return f"_codecs-{digest}.so"
 
 
 def _lib_path() -> str:
     # prefer alongside the source; fall back to a per-user cache dir when
     # the package directory is not writable
-    primary = os.path.join(_HERE, _LIB_NAME)
-    if os.path.exists(primary) and os.path.getmtime(primary) >= os.path.getmtime(_SRC):
-        return primary
-    if os.access(_HERE, os.W_OK):
+    name = _lib_name()
+    primary = os.path.join(_HERE, name)
+    if os.path.exists(primary) or os.access(_HERE, os.W_OK):
         return primary
     cache = os.path.join(
         os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
         "automerge_tpu",
     )
     os.makedirs(cache, exist_ok=True)
-    return os.path.join(cache, _LIB_NAME)
+    return os.path.join(cache, name)
 
 
 def load() -> Optional[ctypes.CDLL]:
@@ -73,8 +94,7 @@ def load() -> Optional[ctypes.CDLL]:
     if os.environ.get("AUTOMERGE_TPU_NO_NATIVE"):
         return None
     path = _lib_path()
-    fresh = os.path.exists(path) and os.path.getmtime(path) >= os.path.getmtime(_SRC)
-    if not fresh and not _build(path):
+    if not os.path.exists(path) and not _build(path):
         return None
     try:
         lib = ctypes.CDLL(path)

@@ -175,9 +175,24 @@ class DeviceDoc:
             vis = [int(r) for r in rows if int(self.log.prop[r]) == p and self.visible[r]]
         else:
             elems = self._seq_elems(ok)
-            if not 0 <= prop < len(elems):
+            if prop < 0:
                 return []
-            er = elems[prop][0]
+            if t == ObjType.TEXT:
+                # integer index is a character position: accumulate winner
+                # widths, matching the host nth's width-aware semantics
+                er = None
+                at = 0
+                for r, w in elems:
+                    at += int(self.log.width[w])
+                    if prop < at:
+                        er = r
+                        break
+                if er is None:
+                    return []
+            else:
+                if not 0 <= prop < len(elems):
+                    return []
+                er = elems[prop][0]
             vis = [
                 int(r)
                 for r in rows

@@ -18,6 +18,8 @@ TPU, no device-side joins, comparisons are plain row-index comparisons.
 
 from __future__ import annotations
 
+import os
+import warnings
 from typing import Dict, Iterable, List, Sequence, Tuple
 
 import numpy as np
@@ -157,10 +159,20 @@ class OpLog:
                 ch.op_col_data is not None for ch in deduped
             )
         if fast:
+            from .. import native
+            from .extract import ExtractError
+
             try:
                 return cls._collect_fast(log, deduped, rank_of)
-            except Exception:
-                pass  # any extraction surprise: fall back to the op path
+            except (ExtractError, native.NativeUnavailable, ValueError) as e:
+                if os.environ.get("AUTOMERGE_TPU_DEBUG"):
+                    raise
+                warnings.warn(
+                    f"vectorized op extraction failed ({e!r}); "
+                    "falling back to the per-op path",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
         return cls._collect_slow(log, deduped, rank_of)
 
     @classmethod

@@ -236,3 +236,20 @@ def test_empty_doc():
     d = AutoDoc(actor=actor(1))
     dev = DeviceDoc.merge([d])
     assert dev.hydrate() == {}
+
+
+def test_device_get_all_width_aware_text():
+    """Integer indexing on TEXT is by character position (host nth parity)."""
+    doc = AutoDoc(ActorId(bytes([1]) * 16))
+    t = doc.put_object("_root", "t", ObjType.TEXT)
+    doc.splice_text(t, 0, 0, "ab")
+    doc.splice(t, 1, 0, ["XYZ"])  # "a XYZ b": widths 1,3,1
+    doc.commit()
+    dd = DeviceDoc.merge([doc])
+    assert dd.text(t) == "aXYZb"
+    for pos, want in [(0, "a"), (1, "XYZ"), (2, "XYZ"), (3, "XYZ"), (4, "b")]:
+        got = dd.get_all(t, pos)
+        host = doc.get_all(t, pos)
+        assert got and got[-1][0] == ("scalar", ("str", want)), (pos, got)
+        assert [v for v, _ in got] == [v for v, _ in host], pos
+    assert dd.get_all(t, 5) == []

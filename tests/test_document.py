@@ -363,3 +363,40 @@ class TestIsolation:
         vals = {v for v, _ in doc.get_all("_root", "k")}
         assert ("scalar", ("str", "isolated")) in vals
         assert ("scalar", ("str", "v2")) in vals
+
+
+class TestMidElementSplice:
+    """Deleting mid-way through a multi-width text element rewinds to the
+    element start and expands the span (reference inner_splice's
+    adjusted_index, transaction/inner.rs:631-637)."""
+
+    def test_delete_mid_element_rewinds(self):
+        doc = new_doc()
+        t = doc.put_object("_root", "t", ObjType.TEXT)
+        doc.splice(t, 0, 0, ["abc"])  # one element, width 3
+        doc.splice_text(t, 0, 0, "x")
+        doc.splice_text(t, 4, 0, "y")  # "x" + ["abc"] + "y"
+        assert doc.text(t) == "xabcy"
+        # delete 1 char at pos 2: mid-element -> whole "abc" element goes
+        doc.splice_text(t, 2, 1, "")
+        assert doc.text(t) == "xy"
+
+    def test_delete_at_element_start_unaffected(self):
+        doc = new_doc()
+        t = doc.put_object("_root", "t", ObjType.TEXT)
+        doc.splice_text(t, 0, 0, "ab")
+        doc.splice(t, 1, 0, ["XYZ"])
+        assert doc.text(t) == "aXYZb"
+        # deleting exactly at the element boundary keeps neighbours intact
+        doc.splice_text(t, 1, 3, "")
+        assert doc.text(t) == "ab"
+
+    def test_mid_element_delete_with_insert(self):
+        doc = new_doc()
+        t = doc.put_object("_root", "t", ObjType.TEXT)
+        doc.splice(t, 0, 0, ["abc", "def"])
+        assert doc.text(t) == "abcdef"
+        # replace from mid "abc" through mid "def": both elements deleted,
+        # replacement lands at the rewound position
+        doc.splice_text(t, 1, 4, "Z")
+        assert doc.text(t) == "Z"
