@@ -1,0 +1,393 @@
+"""Benchmark workload builders + the native sequential-apply baseline.
+
+Workloads mirror the reference's benchmark surface (BASELINE.md configs;
+reference harnesses: rust/edit-trace/src/main.rs, rust/automerge/benches/
+{map,sync}.rs) at real scale:
+
+  1. replay      — the full 259,778-op edit trace through the host
+                   transaction layer (edit-trace/src/main.rs:23-55)
+  2. fanin       — N genuinely divergent replicas of the trace document,
+                   merged (automerge.rs:460,917 fork/merge)
+  3. mapcounter  — many actors concurrently incrementing shared counters +
+                   conflicting map puts (pure commutative merge)
+  4. rga         — many actors interleaving insert/delete on one sequence
+  5. sync        — two replicas with a large divergence catching up over
+                   generate/receive_sync_message (sync.rs:25-68)
+
+Replica changes are synthesized directly at the change level — each replica
+gets a distinct actor, distinct anchor positions, and distinct payload
+drawn from its own trace slice, with deps = the base heads. This is the
+same byte format a real fork would commit (build_change recomputes columns
+and hashes), without paying a full per-replica document replay.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .api import AutoDoc
+from .storage.change import HEAD_STORED, ROOT_STORED, ChangeOp, Key, StoredChange, build_change
+from .types import ActorId, ObjType, ScalarValue
+
+TRACE_PATH = "/root/reference/rust/edit-trace/edits.json"
+
+_ACTION_PUT = 1
+_ACTION_DELETE = 3
+_ACTION_INCREMENT = 5
+
+
+def load_trace(limit: Optional[int] = None) -> list:
+    """The canonical editing trace (or a deterministic synthetic fallback)."""
+    if os.path.exists(TRACE_PATH):
+        with open(TRACE_PATH) as f:
+            edits = json.load(f)
+        return edits[:limit] if limit else edits
+    rng = np.random.default_rng(0)
+    n = limit or 260_000
+    edits, length = [], 0
+    for _ in range(n):
+        if length == 0 or rng.random() < 0.85:
+            edits.append([int(rng.integers(0, length + 1)), 0, "x"])
+            length += 1
+        else:
+            edits.append([int(rng.integers(0, length)), 1])
+            length -= 1
+    return edits
+
+
+def apply_edits(doc: AutoDoc, text_obj: str, edits: Iterable) -> int:
+    """Replay trace edits; returns the number of ops issued."""
+    n = 0
+    for e in edits:
+        ln = doc.length(text_obj)
+        pos = min(e[0], ln)
+        ndel = min(e[1], ln - pos)
+        text = "".join(e[2:])
+        doc.splice_text(text_obj, pos, ndel, text)
+        n += ndel + len(text)
+    return n
+
+
+class BaseInfo:
+    """Everything the synthesizers need to know about the base document."""
+
+    def __init__(self, doc: AutoDoc, text_exid: str):
+        d = doc.doc
+        self.doc = doc
+        self.text_exid = text_exid
+        self.heads = d.get_heads()
+        self.max_op = d.max_op
+        self.changes = [a.stored for a in d.history]
+        ctr_s, actor_hex = text_exid.split("@", 1)
+        self.text_obj: Tuple[int, bytes] = (int(ctr_s), bytes.fromhex(actor_hex))
+        # visible elements in document order as (counter, actor bytes)
+        info = d.ops.get_obj(d.import_obj(text_exid))
+        elems: List[Tuple[int, bytes]] = []
+        for el in info.data.elements():
+            if el.winner() is not None:
+                eid = el.elem_id
+                elems.append((eid[0], d.actors.get(eid[1]).bytes))
+        self.elems = elems
+
+
+def build_base(trace: Sequence, n_edits: int) -> BaseInfo:
+    base = AutoDoc(actor=ActorId(bytes([1]) * 16))
+    text = base.put_object("_root", "text", ObjType.TEXT)
+    apply_edits(base, text, trace[:n_edits])
+    base.commit()
+    return BaseInfo(base, text)
+
+
+def _replica_actor(i: int) -> bytes:
+    return b"\x03" + i.to_bytes(3, "big") + bytes(12)
+
+
+def synth_seq_change(
+    base: BaseInfo,
+    actor: bytes,
+    edits: Sequence,
+    seed: int,
+) -> StoredChange:
+    """One replica's divergent change against ``base``: trace-slice edits
+    re-anchored onto the base document's element ids.
+
+    Inserts chain off one another exactly as a replayed splice would
+    (transaction/inner.rs:672-683); deletes pred the element's insert op
+    (elements of a pure-splice doc are never overwritten). Anchors come
+    from the slice's own positions, so every replica diverges genuinely.
+    """
+    rng = np.random.default_rng(seed)
+    n_base = len(base.elems)
+    # chunk-local actor table: author first, then referenced others sorted
+    others = sorted(({a for _, a in base.elems} | {base.text_obj[1]}) - {actor})
+    local = {actor: 0, **{a: i + 1 for i, a in enumerate(others)}}
+    obj = (base.text_obj[0], local[base.text_obj[1]])
+
+    ops: List[ChangeOp] = []
+    ctr = base.max_op  # ids start at max_op + 1
+    deleted: set = set()
+    last_insert: Optional[Tuple[int, int]] = None
+    last_insert_pos = -2
+    for e in edits:
+        pos = min(int(e[0]), max(n_base - 1, 0))
+        text = "".join(e[2:])
+        if e[1] and n_base:
+            # delete a not-yet-deleted base element near the trace position
+            k = pos
+            for _ in range(8):
+                if k not in deleted and k < n_base:
+                    break
+                k = int(rng.integers(0, n_base))
+            if k in deleted or k >= n_base:
+                continue
+            deleted.add(k)
+            ec, ea = base.elems[k]
+            elem = (ec, local[ea])
+            ctr += 1
+            ops.append(
+                ChangeOp(
+                    obj=obj,
+                    key=Key.seq(elem),
+                    insert=False,
+                    action=_ACTION_DELETE,
+                    value=ScalarValue("null"),
+                    pred=[elem],
+                )
+            )
+        for ch in text:
+            if last_insert is not None and pos == last_insert_pos + 1:
+                elem = last_insert  # chain onto our own previous insert
+            elif pos == 0 or n_base == 0:
+                elem = HEAD_STORED
+            else:
+                ec, ea = base.elems[min(pos - 1, n_base - 1)]
+                elem = (ec, local[ea])
+            ctr += 1
+            ops.append(
+                ChangeOp(
+                    obj=obj,
+                    key=Key.seq(elem),
+                    insert=True,
+                    action=_ACTION_PUT,
+                    value=ScalarValue("str", ch),
+                )
+            )
+            last_insert = (ctr, 0)
+            last_insert_pos = pos
+            pos += 1
+    return build_change(
+        StoredChange(
+            dependencies=list(base.heads),
+            actor=actor,
+            other_actors=others,
+            seq=1,
+            start_op=base.max_op + 1,
+            timestamp=0,
+            message=None,
+            ops=ops,
+        )
+    )
+
+
+def synth_fanin(
+    base: BaseInfo, trace: Sequence, n_replicas: int, per_replica: int, offset: int
+) -> List[StoredChange]:
+    """Config 2: N divergent replicas, each replaying its own trace slice."""
+    out = []
+    for i in range(n_replicas):
+        lo = offset + (i * per_replica) % max(len(trace) - offset - per_replica, 1)
+        out.append(
+            synth_seq_change(
+                base, _replica_actor(i), trace[lo : lo + per_replica], seed=1000 + i
+            )
+        )
+    return out
+
+
+def synth_rga(
+    base: BaseInfo, n_actors: int, ops_per_actor: int
+) -> List[StoredChange]:
+    """Config 4: interleaved insert/delete storms on one shared sequence."""
+    out = []
+    n_base = len(base.elems)
+    for i in range(n_actors):
+        rng = np.random.default_rng(7000 + i)
+        edits = []
+        for j in range(ops_per_actor):
+            pos = int(rng.integers(0, max(n_base, 1)))
+            if j % 3 == 2:
+                edits.append([pos, 1])
+            else:
+                edits.append([pos, 0, chr(97 + (i + j) % 26)])
+        out.append(synth_seq_change(base, _replica_actor(i), edits, seed=7000 + i))
+    return out
+
+
+def build_counter_base(n_counters: int) -> Tuple[AutoDoc, List[str]]:
+    doc = AutoDoc(actor=ActorId(bytes([1]) * 16))
+    keys = [f"c{j}" for j in range(n_counters)]
+    for k in keys:
+        doc.put("_root", k, ScalarValue("counter", 0))
+    doc.commit()
+    return doc, keys
+
+
+def synth_mapcounter(
+    doc: AutoDoc, keys: List[str], n_actors: int, incs_per_actor: int
+) -> Tuple[List[StoredChange], Dict[str, int]]:
+    """Config 3: many actors increment shared counters + conflicting puts.
+
+    Increment preds name the counter put op (transaction.rs increment path);
+    every replica also puts a few shared map keys so the merge resolves real
+    conflicts, not just commutative adds. Returns (changes, expected
+    per-key counter totals) so callers can verify the merge exactly.
+    """
+    d = doc.doc
+    base_heads = d.get_heads()
+    base_max = d.max_op
+    base_actor = d.actor.bytes
+    # counter put op ids in commit order: root puts are ops 1..n by actor 1
+    put_id: Dict[str, Tuple[int, bytes]] = {}
+    info = d.ops.get_obj((0, 0))
+    for prop_idx, run in info.data.props.items():
+        name = d.props.get(prop_idx)
+        for op in run:
+            put_id[name] = (op.id[0], d.actors.get(op.id[1]).bytes)
+    out = []
+    expected: Dict[str, int] = {}
+    for i in range(n_actors):
+        actor = _replica_actor(i)
+        others = sorted({base_actor} - {actor})
+        local = {actor: 0, **{a: j + 1 for j, a in enumerate(others)}}
+        ops = []
+        ctr = base_max
+        rng = np.random.default_rng(3000 + i)
+        for j in range(incs_per_actor):
+            key = keys[int(rng.integers(0, len(keys)))]
+            expected[key] = expected.get(key, 0) + 1
+            pc, pa = put_id[key]
+            ctr += 1
+            ops.append(
+                ChangeOp(
+                    obj=ROOT_STORED,
+                    key=Key.map(key),
+                    insert=False,
+                    action=_ACTION_INCREMENT,
+                    value=ScalarValue("int", 1),
+                    pred=[(pc, local[pa])],
+                )
+            )
+        # a few conflicting shared-key puts
+        for j in range(4):
+            ctr += 1
+            ops.append(
+                ChangeOp(
+                    obj=ROOT_STORED,
+                    key=Key.map(f"w{j}"),
+                    insert=False,
+                    action=_ACTION_PUT,
+                    value=ScalarValue("int", i),
+                )
+            )
+        out.append(
+            build_change(
+                StoredChange(
+                    dependencies=list(base_heads),
+                    actor=actor,
+                    other_actors=others,
+                    seq=1,
+                    start_op=base_max + 1,
+                    timestamp=0,
+                    message=None,
+                    ops=ops,
+                )
+            )
+        )
+    return out, expected
+
+
+# -- the native sequential-apply baseline -----------------------------------
+
+
+def flatten_for_seq_apply(changes: Sequence[StoredChange]):
+    """Flatten changes (in order) into the arrays am_seq_apply consumes.
+
+    Ids are packed (counter << 20 | byte-sorted actor rank) so int64
+    comparison is lamport_cmp — same packing as ops/oplog.py.
+    """
+    from .ops.oplog import ACTOR_BITS
+
+    actor_bytes = sorted({bytes(a) for ch in changes for a in ch.actors})
+    rank_of = {a: i for i, a in enumerate(actor_bytes)}
+
+    op_id, obj, elem, prop, action, insert, is_counter = [], [], [], [], [], [], []
+    pred_off, pred_flat = [0], []
+    values: List[ScalarValue] = []
+    prop_of: Dict[str, int] = {}
+    for ch in changes:
+        ranks = [rank_of[bytes(a)] for a in ch.actors]
+        author = ranks[0]
+        for i, cop in enumerate(ch.ops):
+            op_id.append(((ch.start_op + i) << ACTOR_BITS) | author)
+            obj.append(
+                0 if cop.obj[0] == 0 else (cop.obj[0] << ACTOR_BITS) | ranks[cop.obj[1]]
+            )
+            if cop.key.prop is not None:
+                prop.append(prop_of.setdefault(cop.key.prop, len(prop_of)))
+                elem.append(0)
+            else:
+                prop.append(-1)
+                e = cop.key.elem
+                elem.append(0 if e[0] == 0 else (e[0] << ACTOR_BITS) | ranks[e[1]])
+            action.append(int(cop.action))
+            insert.append(1 if cop.insert else 0)
+            is_counter.append(1 if cop.value.tag == "counter" else 0)
+            values.append(cop.value)
+            for pc, pa in cop.pred:
+                pred_flat.append((pc << ACTOR_BITS) | ranks[pa])
+            pred_off.append(len(pred_flat))
+    return {
+        "op_id": np.asarray(op_id, np.int64),
+        "obj": np.asarray(obj, np.int64),
+        "elem": np.asarray(elem, np.int64),
+        "prop": np.asarray(prop, np.int32),
+        "action": np.asarray(action, np.int32),
+        "insert": np.asarray(insert, np.uint8),
+        "is_counter": np.asarray(is_counter, np.uint8),
+        "pred_off": np.asarray(pred_off, np.int64),
+        "pred_flat": np.asarray(pred_flat, np.int64),
+        "values": values,
+        "rank_of": rank_of,
+    }
+
+
+def seq_apply_baseline(changes: Sequence[StoredChange], query_obj: Tuple[int, bytes]):
+    """Run the native sequential apply over ``changes``; returns
+    (elapsed_seconds, merged text of query_obj).
+
+    The measured equivalent of the reference's sequential Rust
+    ``apply_changes`` loop on this host (see BASELINE.md for how this is
+    used as the honest baseline).
+    """
+    from . import native
+    from .ops.oplog import ACTOR_BITS
+
+    flat = flatten_for_seq_apply(changes)
+    qkey = (query_obj[0] << ACTOR_BITS) | flat["rank_of"][query_obj[1]]
+    t0 = time.perf_counter()
+    rows = native.seq_apply(
+        flat["op_id"], flat["obj"], flat["elem"], flat["prop"], flat["action"],
+        flat["insert"], flat["is_counter"], flat["pred_off"], flat["pred_flat"],
+        qkey,
+    )
+    dt = time.perf_counter() - t0
+    vals = flat["values"]
+    text = "".join(
+        vals[r].value if vals[r].tag == "str" else "￼" for r in rows
+    )
+    return dt, text

@@ -143,7 +143,22 @@ class Document:
 
     # -- change application ------------------------------------------------
 
+    # Large batches skip per-op python apply: the native integrate rebuilds
+    # the op store in bulk (core/bulk_load.py). Threshold balances the
+    # linear rebuild of the whole history against the per-op cost of the
+    # incremental path.
+    BULK_MIN_OPS = 8_000
+
     def apply_changes(self, changes: Iterable[StoredChange]) -> None:
+        changes = list(changes)
+        if self._bulk_eligible(changes):
+            try:
+                self._apply_changes_bulk(changes)
+                return
+            except ValueError:
+                # malformed batch for the native path: the incremental
+                # apply below reports the precise failure
+                pass
         for change in changes:
             if change.hash in self.history_index:
                 continue
@@ -158,6 +173,92 @@ class Document:
         self._drain_queue()
         # Changes still in the queue wait for their dependencies; the
         # reference likewise holds not-yet-ready changes without erroring.
+
+    def _bulk_eligible(self, changes: List[StoredChange]) -> bool:
+        from .. import native
+
+        new_ops = sum(
+            len(c.ops) for c in changes if c.hash not in self.history_index
+        )
+        if new_ops < self.BULK_MIN_OPS:
+            return False
+        existing = sum(len(a.stored.ops) for a in self.history)
+        if new_ops * 8 < existing:
+            return False  # small increment on a big doc: incremental wins
+        return native.available()
+
+    def _apply_changes_bulk(self, changes: List[StoredChange]) -> None:
+        """History bookkeeping per change, one native op-store rebuild.
+
+        Same causal-queue / dup-seq semantics as the incremental path; the
+        op store is rebuilt from the full history afterwards
+        (core/bulk_load.py), so per-op python apply never runs.
+        """
+        from .bulk_load import rebuild_op_store
+
+        ready: List[StoredChange] = []
+        pending: List[StoredChange] = []
+        seen_hashes = set()
+        seen_seqs = set()
+        for change in changes:
+            if change.hash in self.history_index or change.hash in seen_hashes:
+                continue
+            if self._is_duplicate_seq(change) or (change.actor, change.seq) in seen_seqs:
+                raise AutomergeError(
+                    f"duplicate seq {change.seq} for actor {change.actor.hex()}"
+                )
+            seen_hashes.add(change.hash)
+            seen_seqs.add((change.actor, change.seq))
+            pending.append(change)
+        known = set(self.history_index)
+        progress = True
+        while progress:
+            progress = False
+            still = []
+            for change in pending:
+                if all(d in known for d in change.dependencies):
+                    ready.append(change)
+                    known.add(change.hash)
+                    progress = True
+                else:
+                    still.append(change)
+            pending = still
+        # also pull anything already queued whose deps are now satisfied
+        queued_ready = True
+        while queued_ready:
+            queued_ready = False
+            remaining = []
+            for change in self.queue:
+                if change.hash in known:
+                    continue
+                if all(d in known for d in change.dependencies):
+                    ready.append(change)
+                    known.add(change.hash)
+                    queued_ready = True
+                else:
+                    remaining.append(change)
+            self.queue = remaining
+        self.queue.extend(pending)
+        if not ready:
+            return
+        for change in ready:
+            actor_map = [self.actors.cache(ActorId(a)) for a in change.actors]
+            self._update_history(AppliedChange(change, actor_map[0], actor_map))
+        try:
+            rebuild_op_store(self)
+        except Exception:
+            self._rebuild_slow()
+
+    def _rebuild_slow(self) -> None:
+        """Correctness fallback: replay the whole history through the
+        per-op apply path into a fresh store."""
+        from .op_store import OpStore
+
+        self.ops = OpStore(self.actors)
+        for applied in self.history:
+            actor_map = applied.actor_map
+            for obj_id, op in self._import_ops(applied.stored, actor_map):
+                self.ops.insert_op(obj_id, op)
 
     def _drain_queue(self) -> None:
         applied = True

@@ -25,7 +25,12 @@ from typing import Optional, Tuple
 import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_HERE, "codecs.cpp")
+_SRCS = [
+    os.path.join(_HERE, "codecs.cpp"),
+    os.path.join(_HERE, "apply.cpp"),
+    os.path.join(_HERE, "extract_batch.cpp"),
+]
+_SRC = _SRCS[0]
 
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
@@ -42,7 +47,7 @@ def _build(lib_path: str) -> bool:
     tmp = f"{lib_path}.tmp{os.getpid()}"
     cmd = [
         "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-        "-o", tmp, _SRC,
+        "-o", tmp, *_SRCS,
     ]
     try:
         r = subprocess.run(cmd, capture_output=True, timeout=120)
@@ -62,12 +67,14 @@ def _build(lib_path: str) -> bool:
 
 def _lib_name() -> str:
     # the source content hash is baked into the file name, so a stale build
-    # of an older codecs.cpp can never be loaded by mistake (these codecs
-    # produce the bytes change hashes are computed over — loading stale
-    # native code would silently corrupt hashing / the save format)
-    with open(_SRC, "rb") as f:
-        digest = hashlib.sha256(f.read()).hexdigest()[:16]
-    return f"_codecs-{digest}.so"
+    # of older sources can never be loaded by mistake (these codecs produce
+    # the bytes change hashes are computed over — loading stale native code
+    # would silently corrupt hashing / the save format)
+    h = hashlib.sha256()
+    for src in _SRCS:
+        with open(src, "rb") as f:
+            h.update(f.read())
+    return f"_codecs-{h.hexdigest()[:16]}.so"
 
 
 def _lib_path() -> str:
@@ -117,6 +124,26 @@ def load() -> Optional[ctypes.CDLL]:
     i32p = ctypes.POINTER(ctypes.c_int32)
     lib.am_preorder_index.restype = ctypes.c_longlong
     lib.am_preorder_index.argtypes = [i32p, i32p, i32p, ctypes.c_int64, ctypes.c_int64, i32p]
+    lib.am_seq_apply.restype = ctypes.c_longlong
+    lib.am_seq_apply.argtypes = [
+        i64p, i64p, i64p, i32p, i32p, u8p, u8p, i64p, i64p,
+        ctypes.c_int64, ctypes.c_int64, i32p, ctypes.c_int64,
+    ]
+    lib.am_seq_apply_export.restype = ctypes.c_longlong
+    lib.am_seq_apply_export.argtypes = [
+        i64p, i64p, i64p, i32p, i32p, u8p, u8p, i64p, i64p,
+        ctypes.c_int64, i64p, i64p, ctypes.c_int64, i32p, ctypes.c_int64,
+    ]
+    for name, argtypes in (
+        ("am_rle_decode_batch", [u8p, i64p, i64p, i64p, ctypes.c_int64, ctypes.c_int, i64p, u8p]),
+        ("am_delta_decode_batch", [u8p, i64p, i64p, i64p, ctypes.c_int64, i64p, u8p]),
+        ("am_bool_decode_batch", [u8p, i64p, i64p, i64p, ctypes.c_int64, u8p]),
+        ("am_rle_decode_batch_strtab", [u8p, i64p, i64p, i64p, ctypes.c_int64, i32p, i64p, i64p, ctypes.c_int64]),
+        ("am_leb_decode_rows", [u8p, ctypes.c_int64, i64p, i64p, i32p, ctypes.c_int64, i64p]),
+    ):
+        fn = getattr(lib, name)
+        fn.restype = ctypes.c_longlong
+        fn.argtypes = argtypes
     _lib = lib
     return _lib
 
@@ -219,6 +246,93 @@ def bool_encode_array(values: np.ndarray) -> bytes:
 
 def _i32(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def seq_apply(
+    op_id: np.ndarray,
+    obj: np.ndarray,
+    elem: np.ndarray,
+    prop: np.ndarray,
+    action: np.ndarray,
+    insert: np.ndarray,
+    is_counter: np.ndarray,
+    pred_off: np.ndarray,
+    pred_flat: np.ndarray,
+    query_obj: int,
+) -> np.ndarray:
+    """Sequential per-op apply (native); returns the queried sequence
+    object's visible winner rows in document order.
+
+    The measured stand-in for the reference's sequential ``apply_changes``
+    (automerge.rs:1258-1280) — the baseline the batched device merge is
+    compared against, and an independent oracle for its results.
+    """
+    lib = load()
+    if lib is None:
+        raise NativeUnavailable("native codecs not available")
+    n = len(op_id)
+    op_id = np.ascontiguousarray(op_id, np.int64)
+    obj = np.ascontiguousarray(obj, np.int64)
+    elem = np.ascontiguousarray(elem, np.int64)
+    prop = np.ascontiguousarray(prop, np.int32)
+    action = np.ascontiguousarray(action, np.int32)
+    insert = np.ascontiguousarray(insert, np.uint8)
+    is_counter = np.ascontiguousarray(is_counter, np.uint8)
+    pred_off = np.ascontiguousarray(pred_off, np.int64)
+    pred_flat = (
+        np.ascontiguousarray(pred_flat, np.int64)
+        if len(pred_flat)
+        else np.zeros(1, np.int64)
+    )
+    out = np.empty(max(n, 1), np.int32)
+    r = lib.am_seq_apply(
+        _i64(op_id), _i64(obj), _i64(elem), _i32(prop), _i32(action),
+        _u8(insert), _u8(is_counter), _i64(pred_off), _i64(pred_flat),
+        n, int(query_obj), _i32(out), len(out),
+    )
+    if r < 0:
+        raise ValueError(f"sequential apply failed (code {r})")
+    return out[:r]
+
+
+def seq_apply_export(
+    op_id, obj, elem, prop, action, insert, is_counter, pred_off, pred_flat
+):
+    """Sequential apply + full RGA element-order export.
+
+    Returns (obj_keys int64[k], obj_off int64[k+1], elem_rows int32[...]):
+    every sequence object's elements (insert-op rows) in document order,
+    tombstones included — the input the host op-store bulk loader needs.
+    """
+    lib = load()
+    if lib is None:
+        raise NativeUnavailable("native codecs not available")
+    n = len(op_id)
+    op_id = np.ascontiguousarray(op_id, np.int64)
+    obj = np.ascontiguousarray(obj, np.int64)
+    elem = np.ascontiguousarray(elem, np.int64)
+    prop = np.ascontiguousarray(prop, np.int32)
+    action = np.ascontiguousarray(action, np.int32)
+    insert = np.ascontiguousarray(insert, np.uint8)
+    is_counter = np.ascontiguousarray(is_counter, np.uint8)
+    pred_off = np.ascontiguousarray(pred_off, np.int64)
+    pred_flat = (
+        np.ascontiguousarray(pred_flat, np.int64)
+        if len(pred_flat)
+        else np.zeros(1, np.int64)
+    )
+    obj_cap = n + 2
+    obj_keys = np.empty(obj_cap, np.int64)
+    obj_off = np.empty(obj_cap + 1, np.int64)
+    elem_rows = np.empty(max(n, 1), np.int32)
+    k = lib.am_seq_apply_export(
+        _i64(op_id), _i64(obj), _i64(elem), _i32(prop), _i32(action),
+        _u8(insert), _u8(is_counter), _i64(pred_off), _i64(pred_flat),
+        n, _i64(obj_keys), _i64(obj_off), obj_cap, _i32(elem_rows), len(elem_rows),
+    )
+    if k < 0:
+        raise ValueError(f"sequential apply failed (code {k})")
+    return obj_keys[:k], obj_off[: k + 1], elem_rows[: int(obj_off[k])]
 
 
 def preorder_available() -> bool:

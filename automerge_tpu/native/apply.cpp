@@ -1,0 +1,284 @@
+// Sequential per-op apply engine: the native baseline the batched device
+// kernel is measured against, and the fast host apply path.
+//
+// This is the reference's apply_changes hot loop re-expressed in C++
+// (reference: rust/automerge/src/automerge.rs:1258-1280 insert_op =
+// seek -> add_succ -> insert; op_tree.rs:212-239 forward lamport scan;
+// op_set.rs:194-253). Ops arrive flattened in change-apply (causal) order
+// with ids packed as (counter << 20 | actor_rank) so int64 comparison ==
+// lamport_cmp (types.rs:517-521, actor ranks are byte-sorted).
+//
+// Data layout: per-sequence-object doubly-linked element pool (index-based,
+// cache-dense), a global id -> record hash for pred targeting, per-element
+// update chains and per-(object,prop) map runs kept in ascending lamport
+// order. Visibility: op visible iff no non-increment successor (counters)
+// / no successor at all (everything else) — types.rs:712-744.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <unordered_map>
+#include <utility>
+#include <vector>
+
+namespace {
+
+using i64 = long long;
+using i32 = int32_t;
+using u8 = uint8_t;
+
+constexpr i32 ACT_DELETE = 3;
+constexpr i32 ACT_INCREMENT = 5;
+constexpr i32 ACT_MARK_BEGIN = 7;
+constexpr i32 ACT_MARK_END = 8;  // storage expand bit distinguishes; unused here
+constexpr i32 NONE = -1;
+
+inline bool is_make(i32 action) {
+  return action == 0 || action == 2 || action == 4 || action == 6;
+}
+
+struct Rec {        // one stored op (element insert, map put, or seq update)
+  i64 id;
+  i32 row;          // index into the input arrays (value identity)
+  i32 succ = 0;     // non-increment successors
+  i32 inc = 0;      // increment successors
+  u8 is_counter;
+  u8 alive = 1;
+};
+
+struct Elem {       // a sequence element: its insert op + update chain
+  Rec op;
+  i32 prev = NONE, next = NONE;
+  i32 upd_head = NONE;  // first update (ascending id), chained via upd_next
+};
+
+struct Upd {
+  Rec op;
+  i32 next = NONE;
+};
+
+struct SeqObj {
+  i32 head = NONE;  // first element slot (no sentinel; head/prev==NONE)
+  i32 tail = NONE;
+};
+
+struct MapRun {     // ops at one (object, prop), ascending lamport
+  std::vector<i32> upds;  // indices into upd pool
+};
+
+struct Engine {
+  std::vector<Elem> elems;
+  std::vector<Upd> upds;
+  std::vector<SeqObj> seqs;
+  // object id -> (is_map << 31) | index into seqs / maps
+  std::unordered_map<i64, i64> objects;
+  std::vector<std::unordered_map<i64, MapRun>> maps;  // keyed by prop idx
+  // op id -> record location: (kind<<32)|slot, kind 0=elem 1=upd
+  std::unordered_map<i64, i64> by_id;
+
+  bool visible(const Rec& r) const {
+    return r.is_counter ? r.succ == 0 : (r.succ == 0 && r.inc == 0);
+  }
+};
+
+inline void mark_pred(Engine& e, i64 pred_id, bool inc) {
+  auto it = e.by_id.find(pred_id);
+  if (it == e.by_id.end()) return;  // pred outside this log (partial apply)
+  i64 loc = it->second;
+  Rec& r = (loc >> 32) ? e.upds[(i32)loc].op : e.elems[(i32)loc].op;
+  if (inc)
+    r.inc++;
+  else
+    r.succ++;
+}
+
+// Runs the sequential apply over all ops; returns 0 or a negative error.
+i64 engine_apply(Engine& e, const i64* id, const i64* obj, const i64* elem,
+                 const i32* prop, const i32* action, const u8* insert,
+                 const u8* is_counter, const i64* pred_off,
+                 const i64* pred_flat, i64 n_ops) {
+  e.elems.reserve((size_t)n_ops);
+  e.seqs.reserve(1024);
+  e.maps.emplace_back();  // root is a map
+  e.objects.emplace(0, (1LL << 31) | 0);
+  e.by_id.reserve((size_t)n_ops * 2);
+
+  for (i64 i = 0; i < n_ops; i++) {
+    i32 act = action[i];
+    if (is_make(act)) {
+      // register the object (map/table -> map store, list/text -> seq)
+      if (act == 0 || act == 6) {
+        e.objects.emplace(id[i], (1LL << 31) | (i64)e.maps.size());
+        e.maps.emplace_back();
+      } else {
+        e.objects.emplace(id[i], (i64)e.seqs.size());
+        e.seqs.emplace_back();
+      }
+    }
+    auto oit = e.objects.find(obj[i]);
+    if (oit == e.objects.end()) return -2;  // op on unknown object
+    bool obj_is_map = (oit->second >> 31) != 0;
+    i32 oslot = (i32)(oit->second & 0x7fffffff);
+    bool is_inc = act == ACT_INCREMENT;
+
+    // add_succ on every pred (op_set.rs:194-203, batched in the kernel)
+    for (i64 p = pred_off[i]; p < pred_off[i + 1]; p++)
+      mark_pred(e, pred_flat[p], is_inc);
+
+    if (obj_is_map) {
+      if (act == ACT_DELETE || is_inc) continue;  // never stored
+      Upd u;
+      u.op = Rec{id[i], (i32)i, 0, 0, is_counter[i], 1};
+      i32 slot = (i32)e.upds.size();
+      e.upds.push_back(u);
+      e.by_id.emplace(id[i], (1LL << 32) | slot);
+      auto& run = e.maps[oslot][prop[i]].upds;
+      // ascending lamport insert (runs are tiny: concurrent writers only)
+      size_t pos = run.size();
+      while (pos > 0 && id[i] < e.upds[run[pos - 1]].op.id) pos--;
+      run.insert(run.begin() + pos, slot);
+      continue;
+    }
+
+    SeqObj& so = e.seqs[oslot];
+    if (insert[i]) {
+      // seek: ref element, then skip siblings with greater lamport id
+      // (query/opid.rs SimpleOpIdSearch; op_tree.rs:212-239)
+      i32 after;
+      i32 prev;
+      if (elem[i] == 0) {  // HEAD
+        prev = NONE;
+        after = so.head;
+      } else {
+        auto rit = e.by_id.find(elem[i]);
+        if (rit == e.by_id.end() || (rit->second >> 32)) return -3;
+        prev = (i32)rit->second;
+        after = e.elems[prev].next;
+      }
+      while (after != NONE && id[i] < e.elems[after].op.id) {
+        prev = after;
+        after = e.elems[after].next;
+      }
+      Elem el;
+      el.op = Rec{id[i], (i32)i, 0, 0, is_counter[i], 1};
+      el.prev = prev;
+      el.next = after;
+      i32 slot = (i32)e.elems.size();
+      e.elems.push_back(el);
+      if (prev == NONE)
+        so.head = slot;
+      else
+        e.elems[prev].next = slot;
+      if (after == NONE)
+        so.tail = slot;
+      else
+        e.elems[after].prev = slot;
+      e.by_id.emplace(id[i], (i64)slot);
+    } else {
+      if (act == ACT_DELETE || is_inc) continue;  // preds already marked
+      if (act == ACT_MARK_BEGIN || act == ACT_MARK_END) continue;
+      auto rit = e.by_id.find(elem[i]);
+      if (rit == e.by_id.end() || (rit->second >> 32)) return -4;
+      i32 eslot = (i32)rit->second;
+      Upd u;
+      u.op = Rec{id[i], (i32)i, 0, 0, is_counter[i], 1};
+      i32 slot = (i32)e.upds.size();
+      e.upds.push_back(u);
+      e.by_id.emplace(id[i], (1LL << 32) | slot);
+      // ascending-id insert into the element's update chain
+      i32* link = &e.elems[eslot].upd_head;
+      while (*link != NONE && e.upds[*link].op.id < id[i])
+        link = &e.upds[*link].next;
+      e.upds[slot].next = *link;
+      *link = slot;
+    }
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Applies n_ops ops; returns the number of visible winner rows written for
+// query_obj (a sequence object), or a negative error code.
+//   ops columns (length n_ops, change-apply order):
+//     id, obj (0 = root), elem (0 = HEAD, only for seq ops), prop (-1 for
+//     seq ops), action, insert, is_counter
+//   preds as CSR: pred_off (n_ops + 1), pred_flat (pred_off[n_ops])
+//   out_rows: winner row per visible element of query_obj, document order
+i64 am_seq_apply(const i64* id, const i64* obj, const i64* elem,
+                 const i32* prop, const i32* action, const u8* insert,
+                 const u8* is_counter, const i64* pred_off,
+                 const i64* pred_flat, i64 n_ops, i64 query_obj,
+                 i32* out_rows, i64 out_cap) {
+  Engine e;
+  i64 rc = engine_apply(e, id, obj, elem, prop, action, insert, is_counter,
+                        pred_off, pred_flat, n_ops);
+  if (rc < 0) return rc;
+
+  // readback: visible winner rows of query_obj in document order
+  auto qit = e.objects.find(query_obj);
+  if (qit == e.objects.end() || (qit->second >> 31)) return -5;
+  SeqObj& so = e.seqs[(i32)(qit->second & 0x7fffffff)];
+  i64 n_out = 0;
+  for (i32 s = so.head; s != NONE; s = e.elems[s].next) {
+    const Rec* win = nullptr;
+    if (e.visible(e.elems[s].op)) win = &e.elems[s].op;
+    for (i32 u = e.elems[s].upd_head; u != NONE; u = e.upds[u].next)
+      if (e.visible(e.upds[u].op)) win = &e.upds[u].op;  // later id wins
+    if (win != nullptr) {
+      if (n_out < out_cap) out_rows[n_out] = win->row;
+      n_out++;
+    }
+  }
+  return n_out;
+}
+
+// Applies n_ops ops and exports the full RGA element order of EVERY
+// sequence object: the host op-store bulk loader rebuilds its linked
+// structures from this (everything else — succ lists, visibility, map
+// runs — is recomputed vectorized on the host; only element order needs
+// the sequential integrate).
+//   out_obj_key[k]            packed object id of the k-th seq object
+//   out_obj_off[k], [k+1]     its slice of out_elem_rows
+//   out_elem_rows             element insert-op rows, document order,
+//                             INCLUDING invisible (tombstoned) elements
+// Returns the number of sequence objects, or a negative error code.
+// elem_cap must be >= the number of insert ops; obj_cap >= seq obj count.
+i64 am_seq_apply_export(const i64* id, const i64* obj, const i64* elem,
+                        const i32* prop, const i32* action, const u8* insert,
+                        const u8* is_counter, const i64* pred_off,
+                        const i64* pred_flat, i64 n_ops, i64* out_obj_key,
+                        i64* out_obj_off, i64 obj_cap, i32* out_elem_rows,
+                        i64 elem_cap) {
+  Engine e;
+  i64 rc = engine_apply(e, id, obj, elem, prop, action, insert, is_counter,
+                        pred_off, pred_flat, n_ops);
+  if (rc < 0) return rc;
+
+  // objects in registration order (deterministic): walk the id map is
+  // unordered, so re-derive seq object keys by scanning make ops + root
+  std::vector<std::pair<i64, i32>> seq_objs;  // (packed key, seq slot)
+  seq_objs.reserve(e.seqs.size());
+  for (const auto& kv : e.objects)
+    if (!(kv.second >> 31))
+      seq_objs.emplace_back(kv.first, (i32)(kv.second & 0x7fffffff));
+  std::sort(seq_objs.begin(), seq_objs.end());
+  if ((i64)seq_objs.size() > obj_cap) return -6;
+
+  i64 k = 0, w = 0;
+  for (auto& [key, slot] : seq_objs) {
+    out_obj_key[k] = key;
+    out_obj_off[k] = w;
+    for (i32 s = e.seqs[slot].head; s != NONE; s = e.elems[s].next) {
+      if (w >= elem_cap) return -7;
+      out_elem_rows[w++] = e.elems[s].op.row;
+    }
+    k++;
+  }
+  out_obj_off[k] = w;
+  return k;
+}
+
+}  // extern "C"

@@ -1,0 +1,278 @@
+// Batch column extraction: decode MANY changes' op columns in one native
+// call per column kind, writing straight into unified output arrays.
+//
+// This is the load half of the north-star pipeline (BASELINE.json): the
+// change chunk's columnar encoding (reference:
+// rust/automerge/src/storage/change/change_op_columns.rs) goes to numpy
+// arrays without a per-change Python/FFI round trip — the per-change
+// overhead of the one-change-at-a-time path dominated extraction time.
+//
+// Layout contract (shared by all batch entry points):
+//   buf       — all changes' bytes for this column, concatenated
+//   off/len   — per-change slice of buf (len 0 = column absent)
+//   row_off   — per-change output row offset; row_off[n_changes] = total
+// Per change, exactly row_off[c+1]-row_off[c] rows are produced: a short
+// column is padded with nulls, a long one is an error. Error return is
+// -(c+1) for the first malformed change.
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+using i64 = long long;
+using i32 = int32_t;
+using u8 = uint8_t;
+
+// Decoders mirrored from codecs.cpp (kept static-local to this TU).
+inline int dec_uleb(const u8* p, size_t n, uint64_t* out) {
+  uint64_t v = 0;
+  int shift = 0;
+  for (size_t i = 0; i < n && i < 10; i++) {
+    uint64_t b = p[i] & 0x7f;
+    if (shift == 63 && b > 1) return -1;
+    v |= b << shift;
+    if (!(p[i] & 0x80)) {
+      if (i > 0 && p[i] == 0) return -1;
+      *out = v;
+      return (int)(i + 1);
+    }
+    shift += 7;
+  }
+  return -1;
+}
+
+inline int dec_sleb(const u8* p, size_t n, int64_t* out) {
+  int64_t v = 0;
+  int shift = 0;
+  for (size_t i = 0; i < n && i < 10; i++) {
+    u8 byte = p[i];
+    if (shift == 63 && (byte & 0x7f) != 0 && (byte & 0x7f) != 0x7f) return -1;
+    v |= (int64_t)(byte & 0x7f) << shift;
+    shift += 7;
+    if (!(byte & 0x80)) {
+      if (shift < 64 && (byte & 0x40)) v |= -((int64_t)1 << shift);
+      if (i > 0) {
+        u8 prev = p[i - 1];
+        if (byte == 0 && !(prev & 0x40) && (prev & 0x80)) return -1;
+        if (byte == 0x7f && (prev & 0x40) && (prev & 0x80)) return -1;
+      }
+      *out = v;
+      return (int)(i + 1);
+    }
+  }
+  return -1;
+}
+
+// One change's RLE column into out[0..cap); returns rows decoded or -1;
+// sets *overrun if the input holds more rows than cap.
+i64 rle_one(const u8* buf, size_t len, int signed_vals, i64* out, u8* mask,
+            size_t cap, bool* overrun) {
+  size_t pos = 0, row = 0;
+  *overrun = false;
+  while (pos < len) {
+    int64_t header;
+    int c = dec_sleb(buf + pos, len - pos, &header);
+    if (c < 0) return -1;
+    pos += (size_t)c;
+    if (header > 0) {
+      int64_t value;
+      if (signed_vals) {
+        c = dec_sleb(buf + pos, len - pos, &value);
+      } else {
+        uint64_t uv;
+        c = dec_uleb(buf + pos, len - pos, &uv);
+        value = (int64_t)uv;
+      }
+      if (c < 0) return -1;
+      pos += (size_t)c;
+      for (int64_t i = 0; i < header; i++) {
+        if (row >= cap) { *overrun = true; return (i64)row; }
+        out[row] = value;
+        mask[row] = 1;
+        row++;
+      }
+    } else if (header < 0) {
+      for (int64_t i = 0; i < -header; i++) {
+        int64_t value;
+        if (signed_vals) {
+          c = dec_sleb(buf + pos, len - pos, &value);
+        } else {
+          uint64_t uv;
+          c = dec_uleb(buf + pos, len - pos, &uv);
+          value = (int64_t)uv;
+        }
+        if (c < 0) return -1;
+        pos += (size_t)c;
+        if (row >= cap) { *overrun = true; return (i64)row; }
+        out[row] = value;
+        mask[row] = 1;
+        row++;
+      }
+    } else {
+      uint64_t nulls;
+      c = dec_uleb(buf + pos, len - pos, &nulls);
+      if (c < 0) return -1;
+      pos += (size_t)c;
+      for (uint64_t i = 0; i < nulls; i++) {
+        if (row >= cap) { *overrun = true; return (i64)row; }
+        out[row] = 0;
+        mask[row] = 0;
+        row++;
+      }
+    }
+  }
+  return (i64)row;
+}
+
+}  // namespace
+
+extern "C" {
+
+i64 am_rle_decode_batch(const u8* buf, const i64* off, const i64* len,
+                        const i64* row_off, i64 n_changes, int signed_vals,
+                        i64* out, u8* mask) {
+  for (i64 c = 0; c < n_changes; c++) {
+    i64 lo = row_off[c], hi = row_off[c + 1];
+    bool overrun;
+    i64 n = rle_one(buf + off[c], (size_t)len[c], signed_vals, out + lo,
+                    mask + lo, (size_t)(hi - lo), &overrun);
+    if (n < 0 || overrun) return -(c + 1);
+    for (i64 r = lo + n; r < hi; r++) {  // pad short columns with nulls
+      out[r] = 0;
+      mask[r] = 0;
+    }
+  }
+  return 0;
+}
+
+// Delta: RLE of differences with the running absolute reset per change.
+i64 am_delta_decode_batch(const u8* buf, const i64* off, const i64* len,
+                          const i64* row_off, i64 n_changes, i64* out,
+                          u8* mask) {
+  i64 rc = am_rle_decode_batch(buf, off, len, row_off, n_changes, 1, out, mask);
+  if (rc != 0) return rc;
+  for (i64 c = 0; c < n_changes; c++) {
+    int64_t absolute = 0;
+    for (i64 r = row_off[c]; r < row_off[c + 1]; r++) {
+      if (mask[r]) {
+        absolute += out[r];
+        out[r] = absolute;
+      }
+    }
+  }
+  return 0;
+}
+
+i64 am_bool_decode_batch(const u8* buf, const i64* off, const i64* len,
+                         const i64* row_off, i64 n_changes, u8* out) {
+  for (i64 c = 0; c < n_changes; c++) {
+    i64 lo = row_off[c], hi = row_off[c + 1];
+    size_t pos = 0, row = 0, cap = (size_t)(hi - lo);
+    const u8* p = buf + off[c];
+    size_t n = (size_t)len[c];
+    u8 value = 1;
+    while (pos < n) {
+      uint64_t run;
+      int k = dec_uleb(p + pos, n - pos, &run);
+      if (k < 0) return -(c + 1);
+      pos += (size_t)k;
+      value = !value;
+      if (run > cap - row) return -(c + 1);  // longer than op count
+      memset(out + lo + row, value, (size_t)run);
+      row += (size_t)run;
+    }
+    memset(out + lo + row, 0, cap - row);
+  }
+  return 0;
+}
+
+// String-RLE columns (map keys, mark names) decoded + content-interned in
+// one pass. Per row: the interned string id (or -1 for null). The table is
+// returned as (tab_off, tab_len) slices of `buf` in first-seen order.
+// Returns the table size, or -(c+1) on error, or -1000000000 - needed if
+// the table overflows max_tab.
+i64 am_rle_decode_batch_strtab(const u8* buf, const i64* off, const i64* len,
+                               const i64* row_off, i64 n_changes,
+                               i32* out_ids, i64* tab_off, i64* tab_len,
+                               i64 max_tab) {
+  std::unordered_map<std::string, i32> intern;
+  i64 tab_n = 0;
+  for (i64 c = 0; c < n_changes; c++) {
+    i64 lo = row_off[c], hi = row_off[c + 1];
+    size_t cap = (size_t)(hi - lo), row = 0, pos = 0;
+    const u8* p = buf + off[c];
+    size_t n = (size_t)len[c];
+    while (pos < n) {
+      int64_t header;
+      int k = dec_sleb(p + pos, n - pos, &header);
+      if (k < 0) return -(c + 1);
+      pos += (size_t)k;
+      if (header == 0) {
+        uint64_t nulls;
+        k = dec_uleb(p + pos, n - pos, &nulls);
+        if (k < 0) return -(c + 1);
+        pos += (size_t)k;
+        if (nulls > cap - row) return -(c + 1);
+        for (uint64_t i = 0; i < nulls; i++) out_ids[lo + row++] = -1;
+        continue;
+      }
+      i64 count = header > 0 ? header : -header;
+      for (i64 rep = 0; rep < (header > 0 ? 1 : count); rep++) {
+        uint64_t slen;
+        k = dec_uleb(p + pos, n - pos, &slen);
+        if (k < 0) return -(c + 1);
+        pos += (size_t)k;
+        if (slen > n - pos) return -(c + 1);
+        std::string s((const char*)(p + pos), (size_t)slen);
+        auto it = intern.find(s);
+        i32 id;
+        if (it == intern.end()) {
+          if (tab_n >= max_tab) return -1000000000 - (tab_n + 1);
+          id = (i32)tab_n;
+          tab_off[tab_n] = (i64)(off[c] + (i64)pos);
+          tab_len[tab_n] = (i64)slen;
+          tab_n++;
+          intern.emplace(std::move(s), id);
+        } else {
+          id = it->second;
+        }
+        pos += (size_t)slen;
+        i64 reps = header > 0 ? count : 1;
+        if ((i64)row + reps > (i64)cap) return -(c + 1);
+        for (i64 i = 0; i < reps; i++) out_ids[lo + row++] = id;
+      }
+    }
+    for (; row < cap; row++) out_ids[lo + row] = -1;
+  }
+  return tab_n;
+}
+
+// Integer value payloads: decode LEB at (voff, vlen) for rows whose code is
+// an integer kind (3 = uleb uint; 4/8/9 = sleb int/counter/timestamp).
+i64 am_leb_decode_rows(const u8* raw, i64 raw_len, const i64* voff,
+                       const i64* vlen, const i32* vcode, i64 n, i64* out) {
+  for (i64 r = 0; r < n; r++) {
+    i32 code = vcode[r];
+    out[r] = code == 2 ? 1 : 0;  // boolean true is payload-free
+    if (vlen[r] <= 0) continue;
+    if (code != 3 && code != 4 && code != 8 && code != 9) continue;
+    if (voff[r] < 0 || voff[r] + vlen[r] > raw_len) return -(r + 1);
+    const u8* p = raw + voff[r];
+    if (code == 3) {
+      uint64_t v;
+      if (dec_uleb(p, (size_t)vlen[r], &v) < 0) return -(r + 1);
+      out[r] = (i64)v;
+    } else {
+      int64_t v;
+      if (dec_sleb(p, (size_t)vlen[r], &v) < 0) return -(r + 1);
+      out[r] = v;
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

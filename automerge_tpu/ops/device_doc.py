@@ -58,9 +58,20 @@ class DeviceDoc:
         """N-way fan-in merge of documents (AutoDoc or Document)."""
         return cls.resolve(OpLog.from_documents(docs))
 
+    # the outputs the read API consumes; everything else stays on device
+    READ_FETCH = (
+        "visible", "winner", "conflicts", "elem_index",
+        "obj_vis_len", "obj_text_width",
+    )
+
     @classmethod
     def resolve(cls, log: OpLog) -> "DeviceDoc":
-        return cls(log, merge_columns(log.padded_columns()))
+        return cls(
+            log,
+            merge_columns(
+                log.padded_columns(), fetch=cls.READ_FETCH, n_objs=log.n_objs
+            ),
+        )
 
     # -- row selection ------------------------------------------------------
 

@@ -156,6 +156,220 @@ def change_arrays(change: StoredChange) -> Dict[str, np.ndarray]:
     }
 
 
+def _col_batch(changes, spec):
+    """(concatenated bytes, per-change offsets, per-change lengths)."""
+    parts = []
+    off = np.empty(len(changes), np.int64)
+    ln = np.empty(len(changes), np.int64)
+    pos = 0
+    for i, ch in enumerate(changes):
+        b = ch.op_col_data.get(spec, b"")
+        off[i] = pos
+        ln[i] = len(b)
+        pos += len(b)
+        parts.append(b)
+    return b"".join(parts), off, ln
+
+
+def _np_u8(buf: bytes) -> np.ndarray:
+    return np.frombuffer(buf, np.uint8) if len(buf) else np.zeros(1, np.uint8)
+
+
+def batch_arrays(changes) -> Dict[str, object]:
+    """Decode ALL changes' op columns in one native pass per column kind.
+
+    Output rows are change-concatenated (same order the one-change-at-a-time
+    path produced); actor columns still carry chunk-local indices — the
+    caller translates them with one table gather (ops/oplog.py).
+    """
+    import ctypes
+
+    lib = native.load()
+    if lib is None:
+        raise native.NativeUnavailable("native codecs not available")
+    nc = len(changes)
+    n_ops = np.asarray([len(ch.ops) for ch in changes], np.int64)
+    for ch in changes:
+        if ch.op_col_data is None:
+            raise ExtractError("change has no retained column data")
+    row_off = np.concatenate([[0], np.cumsum(n_ops)]).astype(np.int64)
+    N = int(row_off[-1])
+
+    def rle(spec, signed=False):
+        buf, off, ln = _col_batch(changes, spec)
+        out = np.empty(max(N, 1), np.int64)
+        mask = np.empty(max(N, 1), np.uint8)
+        rc = lib.am_rle_decode_batch(
+            native._u8(_np_u8(buf)), native._i64(off), native._i64(ln),
+            native._i64(row_off), nc, int(signed), native._i64(out),
+            native._u8(mask),
+        )
+        if rc != 0:
+            raise ExtractError(f"malformed column {spec} in change {-rc - 1}")
+        return out[:N], mask[:N].astype(bool)
+
+    def delta(spec):
+        buf, off, ln = _col_batch(changes, spec)
+        out = np.empty(max(N, 1), np.int64)
+        mask = np.empty(max(N, 1), np.uint8)
+        rc = lib.am_delta_decode_batch(
+            native._u8(_np_u8(buf)), native._i64(off), native._i64(ln),
+            native._i64(row_off), nc, native._i64(out), native._u8(mask),
+        )
+        if rc != 0:
+            raise ExtractError(f"malformed column {spec} in change {-rc - 1}")
+        return out[:N], mask[:N].astype(bool)
+
+    def boolean(spec):
+        buf, off, ln = _col_batch(changes, spec)
+        out = np.empty(max(N, 1), np.uint8)
+        rc = lib.am_bool_decode_batch(
+            native._u8(_np_u8(buf)), native._i64(off), native._i64(ln),
+            native._i64(row_off), nc, native._u8(out),
+        )
+        if rc != 0:
+            raise ExtractError(f"malformed column {spec} in change {-rc - 1}")
+        return out[:N].astype(bool)
+
+    def strtab(spec):
+        buf, off, ln = _col_batch(changes, spec)
+        if not len(buf):
+            return None, []
+        ids = np.empty(max(N, 1), np.int32)
+        max_tab = 1 << 20
+        tab_off = np.empty(max_tab, np.int64)
+        tab_len = np.empty(max_tab, np.int64)
+        bufa = _np_u8(buf)
+        tn = lib.am_rle_decode_batch_strtab(
+            native._u8(bufa), native._i64(off), native._i64(ln),
+            native._i64(row_off), nc, native._i32(ids), native._i64(tab_off),
+            native._i64(tab_len), max_tab,
+        )
+        if tn < 0:
+            raise ExtractError(f"malformed string column {spec} ({tn})")
+        table = [
+            buf[int(tab_off[i]) : int(tab_off[i]) + int(tab_len[i])].decode("utf-8")
+            for i in range(tn)
+        ]
+        return ids[:N], table
+
+    action, amask = rle(COL_ACTION)
+    if not amask.all():
+        raise ExtractError("action column mismatch")
+    obj_ctr, obj_mask = rle(COL_OBJ_CTR)
+    obj_actor, obj_amask = rle(COL_OBJ_ACTOR)
+    key_ctr, key_ctr_mask = delta(COL_KEY_CTR)
+    key_actor, key_actor_mask = rle(COL_KEY_ACTOR)
+    insert = boolean(COL_INSERT)
+    expand = boolean(COL_EXPAND)
+    meta, meta_mask = rle(COL_VAL_META)
+    meta = np.where(meta_mask, meta, 0)
+    key_ids, key_table = strtab(COL_KEY_STR)
+    mark_ids, mark_table = strtab(COL_MARK_NAME)
+
+    # preds: group counts give each change's pred row range
+    pred_num, pn_mask = rle(COL_PRED_GROUP)
+    pred_num = np.where(pn_mask, pred_num, 0)
+    pn_cum = np.concatenate([[0], np.cumsum(pred_num)]).astype(np.int64)
+    per_change_preds = pn_cum[row_off[1:]] - pn_cum[row_off[:-1]]
+    pred_row_off = np.concatenate([[0], np.cumsum(per_change_preds)]).astype(np.int64)
+    Q = int(pred_row_off[-1])
+
+    def pred_col(spec, is_delta):
+        buf, off, ln = _col_batch(changes, spec)
+        out = np.empty(max(Q, 1), np.int64)
+        mask = np.empty(max(Q, 1), np.uint8)
+        fn = lib.am_delta_decode_batch if is_delta else None
+        if is_delta:
+            rc = lib.am_delta_decode_batch(
+                native._u8(_np_u8(buf)), native._i64(off), native._i64(ln),
+                native._i64(pred_row_off), nc, native._i64(out), native._u8(mask),
+            )
+        else:
+            rc = lib.am_rle_decode_batch(
+                native._u8(_np_u8(buf)), native._i64(off), native._i64(ln),
+                native._i64(pred_row_off), nc, 0, native._i64(out),
+                native._u8(mask),
+            )
+        if rc != 0:
+            raise ExtractError(f"malformed pred column {spec} in change {-rc - 1}")
+        if Q and not mask[:Q].all():
+            raise ExtractError("null pred entries")
+        return out[:Q]
+
+    pred_ctr = pred_col(COL_PRED_CTR, True)
+    pred_actor = pred_col(COL_PRED_ACTOR, False)
+
+    # value payloads: per-change raw buffers concatenated; offsets rebased
+    raw, raw_off, raw_ln = _col_batch(changes, COL_VAL_RAW)
+    vcode = (meta & 0xF).astype(np.int32)
+    vlen = (meta >> 4).astype(np.int64)
+    change_of_row = np.repeat(np.arange(nc), n_ops)
+    vend = np.cumsum(vlen)
+    voff = vend - vlen
+    # rebase per change: local offset + that change's slice start in `raw`
+    base = np.zeros(nc, np.int64)
+    if N:
+        base_local = voff[row_off[:-1].clip(max=max(N - 1, 0))]
+        base_local[n_ops == 0] = 0
+        base = base_local
+    voff = voff - base[change_of_row] + raw_off[change_of_row]
+    limit = (raw_off + raw_ln)[change_of_row]
+    if N and np.any(voff + vlen > limit):
+        raise ExtractError("value raw column overrun")
+
+    # integer payloads (the kernel needs them eagerly)
+    value_int = np.empty(max(N, 1), np.int64)
+    rawa = _np_u8(raw)
+    rc = lib.am_leb_decode_rows(
+        native._u8(rawa), len(raw), native._i64(voff), native._i64(vlen),
+        native._i32(vcode), N, native._i64(value_int),
+    )
+    if rc != 0:
+        raise ExtractError(f"bad integer value payload at row {-rc - 1}")
+    value_int = value_int[:N]
+
+    # utf-8 char widths for string values
+    width = np.ones(N, np.int32)
+    if len(raw):
+        rb = np.frombuffer(raw, np.uint8)
+        cont = np.concatenate([[0], np.cumsum((rb & 0xC0) == 0x80)])
+        srows = vcode == 6
+        width[srows] = (
+            vlen[srows] - (cont[(voff + vlen)[srows]] - cont[voff[srows]])
+        ).astype(np.int32)
+
+    return {
+        "n": N,
+        "n_ops": n_ops,
+        "row_off": row_off,
+        "change_of_row": change_of_row,
+        "action": action.astype(np.int32),
+        "obj_ctr": np.where(obj_mask, obj_ctr, 0),
+        "obj_has": obj_mask & obj_amask,
+        "obj_actor": np.where(obj_amask, obj_actor, 0),
+        "key_ctr": np.where(key_ctr_mask, key_ctr, -1),
+        "key_actor": np.where(key_actor_mask, key_actor, 0),
+        "key_has_actor": key_actor_mask,
+        "key_ids": key_ids,
+        "key_table": key_table,
+        "mark_ids": mark_ids,
+        "mark_table": mark_table,
+        "insert": insert,
+        "expand": expand,
+        "vcode": vcode,
+        "voff": voff,
+        "vlen": vlen,
+        "vraw": raw,
+        "value_int": value_int,
+        "width": width,
+        "pred_num": pred_num.astype(np.int64),
+        "pred_ctr": pred_ctr,
+        "pred_actor": pred_actor,
+        "pred_row_off": pred_row_off,
+    }
+
+
 def _padded(vals: np.ndarray, mask: np.ndarray, n: int):
     if len(vals) > n:
         raise ExtractError("column longer than op count")

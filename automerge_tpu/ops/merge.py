@@ -54,7 +54,8 @@ def succ_resolution(c):
     """Phase 1: pred scatter -> per-op succ/inc counters (batched add_succ).
 
     The bandwidth-heavy phase; parallel/sharding.py shards the pred stream
-    across a device mesh and psums these partial counters.
+    across a device mesh and psums these partial counters. One fused
+    scatter-add carries all three accumulators.
     """
     P = c["action"].shape[0]
     action = c["action"]
@@ -64,16 +65,16 @@ def succ_resolution(c):
     src_is_inc = action[src] == _INCREMENT
     tgt_c = jnp.where(hit, tgt, 0)
     one = jnp.ones_like(tgt_c)
-    succ_count = jnp.zeros(P, jnp.int32).at[tgt_c].add(
-        jnp.where(hit & ~src_is_inc, one, 0)
+    payload = jnp.stack(
+        [
+            jnp.where(hit & ~src_is_inc, one, 0),
+            jnp.where(hit & src_is_inc, one, 0),
+            jnp.where(hit & src_is_inc, c["value_i32"][src], 0),
+        ],
+        axis=1,
     )
-    inc_count = jnp.zeros(P, jnp.int32).at[tgt_c].add(
-        jnp.where(hit & src_is_inc, one, 0)
-    )
-    counter_inc = jnp.zeros(P, jnp.int32).at[tgt_c].add(
-        jnp.where(hit & src_is_inc, c["value_i32"][src], 0)
-    )
-    return succ_count, inc_count, counter_inc
+    acc = jnp.zeros((P, 3), jnp.int32).at[tgt_c].add(payload)
+    return acc[:, 0], acc[:, 1], acc[:, 2]
 
 
 def resolve_state(c, succ_count, inc_count, counter_inc):
@@ -117,10 +118,10 @@ def resolve_state(c, succ_count, inc_count, counter_inc):
     g_obj = jnp.where(valid, obj_dense, jnp.int32(P))
     g_kind = is_map.astype(jnp.int32)
     g_key = jnp.where(is_map, c["prop"], run_key)
-    sort_idx = jnp.lexsort((rows, g_key, g_kind, g_obj)).astype(jnp.int32)
-    g_obj_s = g_obj[sort_idx]
-    g_kind_s = g_kind[sort_idx]
-    g_key_s = g_key[sort_idx]
+    # one multi-key sort pass (lexsort would run one full sort per key)
+    g_obj_s, g_kind_s, g_key_s, sort_idx = jax.lax.sort(
+        (g_obj, g_kind, g_key, rows), num_keys=3, is_stable=True
+    )
     newseg = jnp.concatenate(
         [
             jnp.array([True]),
@@ -155,8 +156,8 @@ def resolve_state(c, succ_count, inc_count, counter_inc):
     # sibling sort: children of one parent contiguous, descending Lamport
     # (= descending row, query/insert.rs lamport tie-breaking)
     sib_parent = jnp.where(is_elem, parent_row, jnp.int32(N))
-    sib_idx = jnp.lexsort((-rows, sib_parent)).astype(jnp.int32)
-    sp_s = sib_parent[sib_idx]
+    sp_s, neg_rows = jax.lax.sort((sib_parent, -rows), num_keys=2, is_stable=True)
+    sib_idx = -neg_rows
     elem_cnt = jnp.sum(is_elem.astype(jnp.int32))
     pos32 = jnp.arange(P, dtype=jnp.int32)
     in_range = pos32 < elem_cnt
@@ -292,24 +293,52 @@ def merge_kernel_core(c):
     return resolve_state(c, *succ_resolution(c))
 
 
-def merge_columns(cols_np, linearize: str = "auto"):
+ALL_OUTPUTS = (
+    "visible", "counter_inc", "winner", "conflicts", "succ_count",
+    "inc_count", "first_child", "next_sib", "parent_row", "is_elem",
+    "obj_vis_len", "obj_text_width", "elem_index",
+)
+
+
+def merge_columns(cols_np, linearize: str = "auto", fetch=None, n_objs=None):
     """Host entry: numpy columns in, numpy resolution out.
 
     ``linearize``: "device" (all on chip), "native" (C++ preorder walk),
     or "auto" (native when available — the ranking pass's random gathers
     are a poor fit for TPU, see device_linearize).
+
+    ``fetch`` selects which output arrays are brought back to the host
+    (default: all). Device->host transfer is the dominant cost on remote
+    accelerators, so read paths should request only what they consume.
+    ``n_objs`` (when given) truncates the per-object stats to the live
+    object count before transfer.
     """
     from .. import native
 
     cols = {k: jnp.asarray(v) for k, v in cols_np.items()}
     if linearize == "auto":
         linearize = "native" if native.preorder_available() else "device"
+    need = set(fetch) if fetch is not None else set(ALL_OUTPUTS)
+
+    def pull(out, keys):
+        host = {}
+        for k in keys:
+            v = out[k]
+            if k in ("obj_vis_len", "obj_text_width") and n_objs is not None:
+                v = v[: n_objs + 2]
+            host[k] = np.asarray(v)
+        return host
+
     if linearize == "native":
-        out = {k: np.asarray(v) for k, v in merge_kernel_core(cols).items()}
-        P = len(out["visible"])
-        out["elem_index"] = native.preorder_index(
-            out["first_child"], out["next_sib"], out["parent_row"], P
-        )
-        return out
+        out = merge_kernel_core(cols)
+        walk_keys = {"first_child", "next_sib", "parent_row"}
+        host = pull(out, (need - {"elem_index"}) | walk_keys)
+        if "elem_index" in need:
+            # node space is [0,P) elements + [P,2P+2) roots + sentinel
+            P = (len(host["first_child"]) - 3) // 2
+            host["elem_index"] = native.preorder_index(
+                host["first_child"], host["next_sib"], host["parent_row"], P
+            )
+        return {k: v for k, v in host.items() if k in need or k in walk_keys}
     out = merge_kernel(cols)
-    return {k: np.asarray(v) for k, v in out.items()}
+    return pull(out, need)

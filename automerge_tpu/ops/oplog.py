@@ -240,117 +240,90 @@ class OpLog:
 
     @classmethod
     def _collect_fast(cls, log, deduped, rank_of) -> "OpLog":
-        """Vectorized extraction: change column bytes -> numpy arrays.
+        """Batch-vectorized extraction: change column bytes -> numpy arrays.
 
-        Per change, the native codec core decodes the op columns straight to
-        arrays (ops/extract.py); actor indices are rank-translated with one
-        table gather and everything is concatenated before the shared
-        Lamport sort. Only map keys / mark names touch python, and only once
-        per RLE run.
+        The native core decodes every change's op columns in one pass per
+        column kind (native/extract_batch.cpp) — including string interning
+        for map keys / mark names — then actor indices are rank-translated
+        with a single table gather before the shared Lamport sort. No
+        per-change Python or FFI work at all.
         """
-        from .extract import change_arrays
+        from .extract import batch_arrays
 
-        prop_of: Dict[str, int] = {}
-        mark_of: Dict[str, int] = {}
-        parts = []
-        raw_parts: List[bytes] = []
-        raw_base = 0
-        for ch in deduped:
-            a = change_arrays(ch)
-            n = a["n"]
-            ranks = np.asarray(
-                [rank_of[bytes(x)] for x in ch.actors], np.int64
-            )
-            author = int(ranks[0])
-            id_key = ((ch.start_op + np.arange(n, dtype=np.int64)) << ACTOR_BITS) | author
-            obj = np.where(
-                a["obj_has"],
-                (a["obj_ctr"] << ACTOR_BITS) | ranks[a["obj_actor"]],
-                np.int64(0),
-            )
-            prop = np.full(n, -1, np.int32)
-            key_str = a["key_str"]
-            if key_str is not None:
-                for i, ks in enumerate(key_str):
-                    if ks is not None:
-                        prop[i] = prop_of.setdefault(ks, len(prop_of))
-            elem = np.where(
-                prop >= 0,
-                np.int64(-1),
-                np.where(
-                    a["key_has_actor"],
-                    (a["key_ctr"] << ACTOR_BITS) | ranks[a["key_actor"]],
-                    np.int64(0),  # HEAD (ctr 0, no actor)
-                ),
-            )
-            mark_idx = np.full(n, -1, np.int32)
-            if a["mark_name"] is not None:
-                for i, mn in enumerate(a["mark_name"]):
-                    if mn is not None:
-                        mark_idx[i] = mark_of.setdefault(mn, len(mark_of))
-            pred_src = np.repeat(
-                np.arange(n, dtype=np.int64), a["pred_num"]
-            )
-            pred_key = (a["pred_ctr"] << ACTOR_BITS) | ranks[a["pred_actor"]]
-            parts.append(
-                dict(
-                    id_key=id_key,
-                    obj=obj,
-                    prop=prop,
-                    elem=elem,
-                    action=a["action"],
-                    insert=a["insert"],
-                    vtag=np.minimum(a["vcode"], TAG_UNKNOWN).astype(np.int32),
-                    vint=a["value_int"],
-                    width=a["width"],
-                    expand=a["expand"],
-                    mark_idx=mark_idx,
-                    pred_src=pred_src,
-                    pred_key=pred_key,
-                    vcode=a["vcode"],
-                    voff=a["voff"] + raw_base,
-                    vlen=a["vlen"],
-                )
-            )
-            raw_parts.append(a["vraw"])
-            raw_base += len(a["vraw"])
+        a = batch_arrays(deduped)
+        N = a["n"]
+        nc = len(deduped)
+        cor = a["change_of_row"]
 
-        def cat(name, dtype):
-            if not parts:
-                return np.empty(0, dtype)
-            return np.concatenate([p[name] for p in parts]).astype(dtype)
-
-        row_bases = np.cumsum([0] + [len(p["id_key"]) for p in parts])[:-1]
-        pred_src_all = (
-            np.concatenate(
-                [p["pred_src"] + b for p, b in zip(parts, row_bases)]
-            ).astype(np.int64)
-            if parts
-            else np.empty(0, np.int64)
+        # concatenated chunk-local -> global rank table, one gather per column
+        tab = np.asarray(
+            [rank_of[bytes(x)] for ch in deduped for x in ch.actors], np.int64
         )
-        log.props = [p for p, _ in sorted(prop_of.items(), key=lambda kv: kv[1])]
-        log.mark_names = [m for m, _ in sorted(mark_of.items(), key=lambda kv: kv[1])]
+        tab_off = np.concatenate(
+            [[0], np.cumsum([len(ch.actors) for ch in deduped])]
+        )[:-1].astype(np.int64)
+        row_tab = tab_off[cor]
+        author = tab[tab_off] if nc else np.empty(0, np.int64)
+        start_op = np.asarray([ch.start_op for ch in deduped], np.int64)
+
+        from .extract import ExtractError
+
+        tab_size = np.asarray([len(ch.actors) for ch in deduped], np.int64)
+        if N and (
+            np.any(a["obj_actor"][a["obj_has"]] >= tab_size[cor][a["obj_has"]])
+            or np.any(
+                a["key_actor"][a["key_has_actor"]]
+                >= tab_size[cor][a["key_has_actor"]]
+            )
+        ):
+            raise ExtractError("actor index out of chunk-local table range")
+
+        within = np.arange(N, dtype=np.int64) - a["row_off"][:-1][cor]
+        id_key = ((start_op[cor] + within) << ACTOR_BITS) | author[cor]
+        obj = np.where(
+            a["obj_has"],
+            (a["obj_ctr"] << ACTOR_BITS) | tab[(row_tab + a["obj_actor"]).clip(max=max(len(tab) - 1, 0))],
+            np.int64(0),
+        )
+        prop = a["key_ids"] if a["key_ids"] is not None else np.full(N, -1, np.int32)
+        elem = np.where(
+            prop >= 0,
+            np.int64(-1),
+            np.where(
+                a["key_has_actor"],
+                (a["key_ctr"] << ACTOR_BITS) | tab[(row_tab + a["key_actor"]).clip(max=max(len(tab) - 1, 0))],
+                np.int64(0),  # HEAD (ctr 0, no actor)
+            ),
+        )
+        mark_idx = (
+            a["mark_ids"] if a["mark_ids"] is not None else np.full(N, -1, np.int32)
+        )
+        pred_src = np.repeat(np.arange(N, dtype=np.int64), a["pred_num"])
+        per_change_preds = np.diff(a["pred_row_off"])
+        cop = np.repeat(np.arange(nc), per_change_preds)
+        if len(cop) and np.any(a["pred_actor"] >= tab_size[cop]):
+            raise ExtractError("pred actor index out of chunk-local table range")
+        pred_key = (a["pred_ctr"] << ACTOR_BITS) | tab[
+            (tab_off[cop] + a["pred_actor"]).clip(max=max(len(tab) - 1, 0))
+        ]
+        log.props = list(a["key_table"])
+        log.mark_names = list(a["mark_table"])
         return cls._finalize(
             log,
-            cat("id_key", np.int64),
-            cat("obj", np.int64),
-            cat("prop", np.int32),
-            cat("elem", np.int64),
-            cat("action", np.int32),
-            cat("insert", np.bool_),
-            cat("vtag", np.int32),
-            cat("vint", np.int64),
-            cat("width", np.int32),
-            cat("expand", np.bool_),
-            cat("mark_idx", np.int32),
-            pred_src_all,
-            cat("pred_key", np.int64),
-            (
-                cat("vcode", np.int32),
-                cat("voff", np.int64),
-                cat("vlen", np.int64),
-                b"".join(raw_parts),
-            ),
+            id_key,
+            obj,
+            prop.astype(np.int32),
+            elem,
+            a["action"],
+            a["insert"],
+            np.minimum(a["vcode"], TAG_UNKNOWN).astype(np.int32),
+            a["value_int"],
+            a["width"],
+            a["expand"],
+            mark_idx.astype(np.int32),
+            pred_src,
+            pred_key,
+            (a["vcode"], a["voff"], a["vlen"], a["vraw"]),
         )
 
     @classmethod

@@ -1,24 +1,22 @@
 #!/usr/bin/env python
-"""Benchmark: edit-trace N-way fan-in merge, device kernel vs host apply.
+"""Benchmark driver: the BASELINE.md configs on real hardware.
 
-The north-star workload (BASELINE.json): K divergent replicas of a text
-document built from the canonical edit trace (reference:
-rust/edit-trace/edits.json, 259,778 real editing operations) merged into
-one converged document. The device path extracts columns with the native
-codec core and resolves the whole merged op log in one batched kernel
-(automerge_tpu/ops); the baseline is the host-side sequential apply loop
-(automerge_tpu/core), the same algorithm shape as the reference's
-``apply_changes``.
-
-K replicas are produced by replaying distinct trace slices on a few real
-forks, then amplifying each divergent change under fresh actor ids —
-structurally identical concurrent edits from many actors, the same shape
-the reference's fork/merge benchmark configs describe.
+Primary metric (BASELINE.json): ops/sec merged on the edit-trace N-replica
+fan-in through the full device path (columnar extraction + batched merge
+kernel + readback), vs the sequential-apply baseline. The baseline divisor
+is the FASTER of (a) the measured native C++ sequential apply on this host
+(automerge_tpu/bench.py seq_apply_baseline — the reference's
+apply_changes loop shape, automerge.rs:1258-1280, natively compiled) and
+(b) the pinned Rust estimate documented in BASELINE.md — i.e. the
+conservative choice.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": ops/sec through the device merge path
-   (extraction + kernel), "unit": "ops/s",
-   "vs_baseline": speedup over host sequential merge}
+  {"metric": ..., "value": ..., "unit": "ops/s", "vs_baseline": ...,
+   "configs": {replay, fanin, mapcounter, rga, sync}}
+
+Env knobs: BENCH_BASE_EDITS, BENCH_REPLICAS, BENCH_FORK_EDITS,
+BENCH_REPLAY_EDITS, BENCH_MC_ACTORS, BENCH_MC_INCS, BENCH_RGA_ACTORS,
+BENCH_RGA_OPS, BENCH_SYNC_OPS, BENCH_HOST_CAP, BENCH_VERBOSE.
 """
 
 import json
@@ -26,171 +24,232 @@ import os
 import sys
 import time
 
-import numpy as np
-
-TRACE = "/root/reference/rust/edit-trace/edits.json"
-
-BASE_EDITS = int(os.environ.get("BENCH_BASE_EDITS", "20000"))
-REAL_FORKS = int(os.environ.get("BENCH_REAL_FORKS", "8"))
-AMPLIFY = int(os.environ.get("BENCH_AMPLIFY", "16"))  # replicas = 8*16 = 128
-FORK_EDITS = int(os.environ.get("BENCH_FORK_EDITS", "400"))
-REPS = int(os.environ.get("BENCH_REPS", "3"))
+# Pinned Rust-reference throughput estimates (ops/s) — see BASELINE.md
+# "Pinned baseline" for the reasoning. No Rust toolchain exists in this
+# image; the measured native C++ sequential apply below is the primary
+# baseline and these pins act as a floor so vs_baseline can never benefit
+# from a slow native build.
+RUST_PIN_REPLAY = 500_000.0   # local transaction replay (edit-trace bench)
+RUST_PIN_APPLY = 250_000.0    # remote apply_changes (per-op seek/insert)
 
 
-def load_trace():
-    if os.path.exists(TRACE):
-        with open(TRACE) as f:
-            return json.load(f)
-    # synthetic fallback: same shape as the trace, deterministic
-    rng = np.random.default_rng(0)
-    edits, length = [], 0
-    for _ in range(BASE_EDITS + REAL_FORKS * FORK_EDITS + 1000):
-        if length == 0 or rng.random() < 0.85:
-            pos = int(rng.integers(0, length + 1))
-            edits.append([pos, 0, "x"])
-            length += 1
-        else:
-            pos = int(rng.integers(0, length))
-            edits.append([pos, 1])
-            length -= 1
-    return edits
-
-
-def apply_edits(doc, text_obj, edits):
-    for e in edits:
-        ln = doc.length(text_obj)
-        pos = min(e[0], ln)
-        ndel = min(e[1], ln - pos)
-        doc.splice_text(text_obj, pos, ndel, "".join(e[2:]))
-
-
-def amplify_change(stored, new_actor: bytes):
-    """Re-author a divergent change under a fresh actor id.
-
-    The ops are position-identical concurrent edits by another actor —
-    exactly what K users typing the same places produces. Chunk-local op
-    encodings reference the author as actor 0, so only the actor table
-    changes; build_change recomputes bytes and hash.
-    """
-    from automerge_tpu.storage.change import StoredChange, build_change
-
-    return build_change(
-        StoredChange(
-            dependencies=list(stored.dependencies),
-            actor=new_actor,
-            other_actors=list(stored.other_actors),
-            seq=stored.seq,
-            start_op=stored.start_op,
-            timestamp=stored.timestamp,
-            message=stored.message,
-            ops=list(stored.ops),
-        )
-    )
+def env_int(name, default):
+    return int(os.environ.get(name, default))
 
 
 def main():
+    import numpy as np
+
+    from automerge_tpu import bench as W
     from automerge_tpu.api import AutoDoc
     from automerge_tpu.core.document import Document
     from automerge_tpu.ops import DeviceDoc, OpLog
-    from automerge_tpu.ops.merge import merge_columns, merge_kernel
-    from automerge_tpu.types import ActorId, ObjType
+    from automerge_tpu.ops.merge import merge_columns
+    from automerge_tpu.sync import SyncState
+    from automerge_tpu.types import ActorId
 
-    trace = load_trace()
+    verbose = os.environ.get("BENCH_VERBOSE")
+    results = {}
+
+    def note(msg):
+        if verbose:
+            print(msg, file=sys.stderr, flush=True)
+
+    trace = W.load_trace()
+
+    # ---- config 1: full-trace replay through the host transaction layer ----
+    n_replay = env_int("BENCH_REPLAY_EDITS", len(trace))
+    doc = AutoDoc(actor=ActorId(bytes([7]) * 16))
+    from automerge_tpu.types import ObjType
+
+    tobj = doc.put_object("_root", "text", ObjType.TEXT)
     t0 = time.perf_counter()
-    base = AutoDoc(actor=ActorId(bytes([1]) * 16))
-    text = base.put_object("_root", "text", ObjType.TEXT)
-    apply_edits(base, text, trace[:BASE_EDITS])
-    base.commit()
-    t_base = time.perf_counter() - t0
-
-    # real forks: distinct trace slices replayed on top of the base
-    t0 = time.perf_counter()
-    divergent = []
-    for i in range(REAL_FORKS):
-        f = base.fork(actor=ActorId(bytes([2]) * 15 + bytes([i])))
-        lo = BASE_EDITS + i * FORK_EDITS
-        apply_edits(f, text, trace[lo : lo + FORK_EDITS])
-        f.commit()
-        divergent.append(f.doc.history[-1].stored)
-    # amplification: the same divergence re-authored by more actors
-    changes = [a.stored for a in base.doc.history]
-    for k in range(AMPLIFY):
-        for i, d in enumerate(divergent):
-            if k == 0:
-                changes.append(d)
-            else:
-                changes.append(
-                    amplify_change(d, bytes([3]) * 14 + bytes([k, i]))
-                )
-    t_forks = time.perf_counter() - t0
-    n_replicas = REAL_FORKS * AMPLIFY
-
-    # --- device path: columnar extraction + batched merge kernel -----------
-    import jax
-    import jax.numpy as jnp
-
-    t0 = time.perf_counter()
-    log = OpLog.from_changes(changes)
-    t_extract = time.perf_counter() - t0
-    padded = log.padded_columns()
-    # device-resident timing: columns stay on chip, outputs are blocked on
-    # but not transferred (transfer costs are environment-specific; readback
-    # uses the hybrid native-walk path via merge_columns below)
-    cols = {k: jnp.asarray(v) for k, v in padded.items()}
-    jax.block_until_ready(cols)
-    jax.block_until_ready(merge_kernel(cols))  # warmup / compile
-    t_kernel = min(
-        _timed(lambda: jax.block_until_ready(merge_kernel(cols)))
-        for _ in range(REPS)
-    )
-    t_device = t_extract + t_kernel
-    res = merge_columns(padded)
-
-    # --- host baseline: sequential apply of the same changes ---------------
-    t0 = time.perf_counter()
-    host = Document(ActorId(bytes([9]) * 16))
-    host.apply_changes(changes)
-    t_host = time.perf_counter() - t0
-
-    # sanity: converged state must match
-    dev = DeviceDoc(log, res)
-    assert dev.text(text) == host.text(text), "device/host merge divergence"
-
-    ops = log.n
-    dev_rate = ops / t_device
-    host_rate = ops / t_host
-    result = {
-        "metric": "edit_trace_fanin_merge_ops_per_sec",
-        "value": round(dev_rate, 1),
-        "unit": "ops/s",
-        "vs_baseline": round(dev_rate / host_rate, 2),
+    n_ops = W.apply_edits(doc, tobj, trace[:n_replay])
+    doc.commit()
+    t_replay = time.perf_counter() - t0
+    results["replay"] = {
+        "edits": n_replay,
+        "ops": n_ops,
+        "seconds": round(t_replay, 3),
+        "ops_per_sec": round(n_ops / t_replay, 1),
+        "vs_baseline": round(n_ops / t_replay / RUST_PIN_REPLAY, 4),
     }
-    print(json.dumps(result))
-    if os.environ.get("BENCH_VERBOSE"):
-        print(
-            json.dumps(
-                {
-                    "ops_merged": ops,
-                    "replicas": n_replicas,
-                    "capacity": int(len(padded["action"])),
-                    "t_extract_s": round(t_extract, 4),
-                    "t_kernel_s": round(t_kernel, 4),
-                    "t_host_merge_s": round(t_host, 3),
-                    "t_base_build_s": round(t_base, 3),
-                    "t_fork_build_s": round(t_forks, 3),
-                    "host_ops_per_sec": round(host_rate, 1),
-                    "kernel_only_ops_per_sec": round(ops / t_kernel, 1),
-                    "device": str(jax.devices()[0]),
-                },
-            ),
-            file=sys.stderr,
-        )
+    note(f"replay: {results['replay']}")
+    del doc
 
-
-def _timed(fn):
+    # ---- config 2: N-way fan-in merge (primary) ----------------------------
+    base_edits = env_int("BENCH_BASE_EDITS", 120_000)
+    n_replicas = env_int("BENCH_REPLICAS", 512)
+    fork_edits = env_int("BENCH_FORK_EDITS", 120)
     t0 = time.perf_counter()
-    fn()
-    return time.perf_counter() - t0
+    base = W.build_base(trace, base_edits)
+    t_base = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    replica_changes = W.synth_fanin(base, trace, n_replicas, fork_edits, base_edits)
+    changes = list(base.changes) + replica_changes
+    t_synth = time.perf_counter() - t0
+    note(f"fanin build: base {t_base:.1f}s, synth {t_synth:.1f}s")
+
+    # device path: extraction + kernel + native linearization + readback
+    def device_merge_timed(chs, reps):
+        """Warm up (jit compile + page-in), then min-of-reps end to end."""
+        log = OpLog.from_changes(chs)
+        res = merge_columns(
+            log.padded_columns(), fetch=DeviceDoc.READ_FETCH, n_objs=log.n_objs
+        )
+        best = (float("inf"), float("inf"))
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            log = OpLog.from_changes(chs)
+            t_ex = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            res = merge_columns(
+                log.padded_columns(), fetch=DeviceDoc.READ_FETCH, n_objs=log.n_objs
+            )
+            t_mg = time.perf_counter() - t0
+            if t_ex + t_mg < sum(best):
+                best = (t_ex, t_mg)
+        return log, res, best
+
+    log, res, (t_extract, t_merge) = device_merge_timed(
+        changes, env_int("BENCH_REPS", 2)
+    )
+    t_device = t_extract + t_merge
+    n = log.n
+
+    # baseline 1: native sequential apply (measured)
+    t_native, native_text = W.seq_apply_baseline(changes, base.text_obj)
+    native_rate = n / t_native
+
+    # convergence check: device == native sequential
+    dev = DeviceDoc(log, res)
+    dev_text = dev.text(base.text_exid)
+    assert dev_text == native_text, "device/native merge divergence"
+
+    # baseline 2: the framework's own host python apply (rate from a slice)
+    host_cap = env_int("BENCH_HOST_CAP", 60_000)
+    host = Document(ActorId(bytes([9]) * 16))
+    t0 = time.perf_counter()
+    applied_ops = 0
+    for ch in changes:
+        host.apply_changes([ch])
+        applied_ops += len(ch.ops)
+        if applied_ops >= host_cap:
+            break
+    t_host = time.perf_counter() - t0
+    host_rate = applied_ops / t_host
+
+    baseline_rate = max(native_rate, RUST_PIN_APPLY)
+    dev_rate = n / t_device
+    results["fanin"] = {
+        "replicas": n_replicas,
+        "ops": n,
+        "t_extract_s": round(t_extract, 3),
+        "t_merge_s": round(t_merge, 3),
+        "p50_merge_latency_s": round(t_device, 3),
+        "ops_per_sec": round(dev_rate, 1),
+        "native_seq_apply_ops_per_sec": round(native_rate, 1),
+        "host_python_ops_per_sec": round(host_rate, 1),
+        "baseline_ops_per_sec": round(baseline_rate, 1),
+        "vs_baseline": round(dev_rate / baseline_rate, 3),
+    }
+    note(f"fanin: {results['fanin']}")
+
+    # ---- config 3: Map+Counter commutative merge ---------------------------
+    mc_actors = env_int("BENCH_MC_ACTORS", 10_000)
+    mc_incs = env_int("BENCH_MC_INCS", 100)
+    cdoc, keys = W.build_counter_base(64)
+    t0 = time.perf_counter()
+    mc_changes, mc_expected = W.synth_mapcounter(cdoc, keys, mc_actors, mc_incs)
+    t_synth = time.perf_counter() - t0
+    all_mc = [a.stored for a in cdoc.doc.history] + mc_changes
+    mlog, mres, (t_mc_ex, t_mc_mg) = device_merge_timed(all_mc, 1)
+    t_mc = t_mc_ex + t_mc_mg
+    mdev = DeviceDoc(mlog, mres)
+    # exact-total verification: every increment is +1
+    for k in keys[:4]:
+        got = mdev.get("_root", k)
+        assert got[0] == ("counter", mc_expected.get(k, 0)), (k, got)
+    mc_rate = mlog.n / t_mc
+    results["mapcounter"] = {
+        "actors": mc_actors,
+        "ops": mlog.n,
+        "t_synth_s": round(t_synth, 2),
+        "p50_merge_latency_s": round(t_mc, 3),
+        "ops_per_sec": round(mc_rate, 1),
+        "vs_baseline": round(mc_rate / RUST_PIN_APPLY, 3),
+    }
+    note(f"mapcounter: {results['mapcounter']}")
+    del mlog, mres, mdev, mc_changes, all_mc
+
+    # ---- config 4: RGA stress ---------------------------------------------
+    rga_actors = env_int("BENCH_RGA_ACTORS", 1_000)
+    rga_ops = env_int("BENCH_RGA_OPS", 200)
+    rbase = W.build_base(trace, 3_000)
+    rga_changes = W.synth_rga(rbase, rga_actors, rga_ops)
+    all_rga = list(rbase.changes) + rga_changes
+    rlog, rres, (t_rga_ex, t_rga_mg) = device_merge_timed(all_rga, 1)
+    t_rga = t_rga_ex + t_rga_mg
+    t_rn, rn_text = W.seq_apply_baseline(all_rga, rbase.text_obj)
+    rdev = DeviceDoc(rlog, rres)
+    assert rdev.text(rbase.text_exid) == rn_text, "rga device/native divergence"
+    rga_baseline = max(rlog.n / t_rn, RUST_PIN_APPLY)
+    rga_rate = rlog.n / t_rga
+    results["rga"] = {
+        "actors": rga_actors,
+        "ops": rlog.n,
+        "p50_merge_latency_s": round(t_rga, 3),
+        "ops_per_sec": round(rga_rate, 1),
+        "native_seq_apply_ops_per_sec": round(rlog.n / t_rn, 1),
+        "vs_baseline": round(rga_rate / rga_baseline, 3),
+    }
+    note(f"rga: {results['rga']}")
+    del rlog, rres, rdev, rga_changes, all_rga
+
+    # ---- config 5: sync catch-up ------------------------------------------
+    sync_ops = env_int("BENCH_SYNC_OPS", 100_000)
+    sbase = W.build_base(trace, 2_000)
+    n_sync_replicas = max(sync_ops // 2_000, 1)
+    sync_changes = W.synth_fanin(sbase, trace, n_sync_replicas, 2_000, 2_000)
+    ahead = AutoDoc.load(sbase.doc.save())
+    ahead.apply_changes(sync_changes)
+    behind = AutoDoc.load(sbase.doc.save())
+    s1, s2 = SyncState(), SyncState()
+    n_synced = sum(len(c.ops) for c in sync_changes)
+    t0 = time.perf_counter()
+    rounds = 0
+    while True:
+        m1 = ahead.generate_sync_message(s1)
+        m2 = behind.generate_sync_message(s2)
+        if m1 is None and m2 is None:
+            break
+        if m1 is not None:
+            behind.receive_sync_message(s2, m1)
+        if m2 is not None:
+            ahead.receive_sync_message(s1, m2)
+        rounds += 1
+        if rounds > 100:
+            raise RuntimeError("sync did not converge")
+    t_sync = time.perf_counter() - t0
+    assert behind.get_heads() == ahead.get_heads()
+    sync_rate = n_synced / t_sync
+    results["sync"] = {
+        "divergence_ops": n_synced,
+        "rounds": rounds,
+        "seconds": round(t_sync, 3),
+        "ops_per_sec": round(sync_rate, 1),
+        "vs_baseline": round(sync_rate / RUST_PIN_APPLY, 4),
+    }
+    note(f"sync: {results['sync']}")
+
+    out = {
+        "metric": "edit_trace_fanin_merge_ops_per_sec",
+        "value": results["fanin"]["ops_per_sec"],
+        "unit": "ops/s",
+        "vs_baseline": results["fanin"]["vs_baseline"],
+        "configs": results,
+    }
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

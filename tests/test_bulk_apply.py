@@ -1,0 +1,180 @@
+"""Differential tests: bulk (native) apply == incremental per-op apply.
+
+The bulk path (core/bulk_load.py) rebuilds the op store via the native
+sequential integrate; its result must be indistinguishable from replaying
+every op through op_store.insert_op (the incremental path the rest of the
+suite exercises).
+"""
+
+import random
+
+import pytest
+
+from automerge_tpu import native
+from automerge_tpu.api import AutoDoc
+from automerge_tpu.core.document import Document
+from automerge_tpu.types import ActorId, ObjType, ScalarValue
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native core unavailable"
+)
+
+
+def actor(n: int) -> ActorId:
+    return ActorId(bytes([n]) * 16)
+
+
+def build_divergent_docs(seed: int, n_forks: int = 4, n_edits: int = 40):
+    rng = random.Random(seed)
+    base = AutoDoc(actor(1))
+    t = base.put_object("_root", "text", ObjType.TEXT)
+    base.splice_text(t, 0, 0, "the quick brown fox jumps over the lazy dog")
+    base.put("_root", "count", ScalarValue("counter", 5))
+    base.put("_root", "title", "hello")
+    lst = base.put_object("_root", "items", ObjType.LIST)
+    base.insert(lst, 0, 1)
+    base.insert(lst, 1, 2)
+    base.commit()
+    forks = [base.fork(actor=actor(10 + i)) for i in range(n_forks)]
+    for i, f in enumerate(forks):
+        for j in range(n_edits):
+            ln = f.length(t)
+            which = rng.random()
+            if which < 0.5 or ln < 2:
+                f.splice_text(t, rng.randrange(ln + 1), 0, f"{i}{j % 10}")
+            elif which < 0.8:
+                f.splice_text(t, rng.randrange(ln - 1), 1, "")
+            elif which < 0.9:
+                f.increment("_root", "count", i + j)
+            else:
+                f.put("_root", "title", f"t{i}-{j}")
+        f.commit()
+    return base, forks, t, lst
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_bulk_matches_incremental(seed):
+    base, forks, t, lst = build_divergent_docs(seed)
+    changes = [a.stored for a in base.doc.history]
+    for f in forks:
+        changes.extend(
+            a.stored
+            for a in f.doc.history
+            if a.hash not in {x.hash for x in base.doc.history}
+        )
+
+    inc = Document(actor(8))
+    old = Document.BULK_MIN_OPS
+    try:
+        Document.BULK_MIN_OPS = 10**12  # force the incremental path
+        inc.apply_changes(changes)
+    finally:
+        Document.BULK_MIN_OPS = old
+
+    bulk = Document(actor(9))
+    bulk.apply_changes(changes, )
+    # force the bulk rebuild even under the ops threshold
+    from automerge_tpu.core.bulk_load import rebuild_op_store
+
+    rebuild_op_store(bulk)
+
+    assert bulk.text(t) == inc.text(t)
+    assert bulk.hydrate() == inc.hydrate()
+    assert bulk.get_heads() == inc.get_heads()
+    # conflict metadata and historical reads agree
+    assert bulk.get_all("_root", "title") == inc.get_all("_root", "title")
+    heads_mid = [c.hash for c in inc.history[: len(inc.history) // 2]][-1:]
+    if heads_mid:
+        assert bulk.text(t, heads=heads_mid) == inc.text(t, heads=heads_mid)
+
+
+def test_bulk_respects_causal_queue():
+    base, forks, t, lst = build_divergent_docs(3, n_forks=2, n_edits=10)
+    changes = [a.stored for a in forks[0].doc.history]
+    # withhold the base change: everything else is causally unready
+    held = changes[0]
+    rest = changes[1:]
+    doc = Document(actor(9))
+    doc.apply_changes(rest)
+    assert len(doc.history) == 0
+    assert len(doc.queue) == len(rest)
+    doc.apply_changes([held])
+    assert len(doc.history) == len(changes)
+    assert doc.text(t) == forks[0].text(t)
+
+
+def test_bulk_after_local_edits_keeps_editing_working():
+    """The rebuilt store must support subsequent local transactions."""
+    base, forks, t, lst = build_divergent_docs(4, n_forks=2, n_edits=15)
+    merged = Document(actor(9))
+    changes = [a.stored for a in base.doc.history]
+    for f in forks:
+        changes.extend(a.stored for a in f.doc.history[len(base.doc.history):])
+    merged.apply_changes(changes)
+    from automerge_tpu.core.bulk_load import rebuild_op_store
+
+    rebuild_op_store(merged)
+    doc = AutoDoc(actor(20))
+    doc.doc = merged
+    merged.set_actor(actor(20))
+    before = doc.text(t)
+    doc.splice_text(t, 0, 0, ">>")
+    doc.commit()
+    assert doc.text(t) == ">>" + before
+
+
+def test_bulk_dedups_within_batch():
+    base, forks, t, lst = build_divergent_docs(5, n_forks=2, n_edits=30)
+    changes = [a.stored for a in forks[0].doc.history]
+    doc = Document(actor(9))
+    doc.BULK_MIN_OPS = 1  # force bulk
+    doc.apply_changes(changes + [changes[-1], changes[0]])
+    assert len(doc.history) == len(changes)
+    assert doc.text(t) == forks[0].text(t)
+
+
+def test_bulk_rejects_duplicate_seq_in_batch():
+    base, forks, t, lst = build_divergent_docs(6, n_forks=2, n_edits=5)
+    changes = [a.stored for a in forks[0].doc.history]
+    from automerge_tpu.storage.change import StoredChange, build_change
+
+    dup = build_change(
+        StoredChange(
+            dependencies=list(changes[-1].dependencies),
+            actor=changes[-1].actor,
+            other_actors=list(changes[-1].other_actors),
+            seq=changes[-1].seq,  # same actor+seq, different content
+            start_op=changes[-1].start_op + 1000,
+            timestamp=7,
+            message="dup",
+            ops=[],
+        )
+    )
+    doc = Document(actor(9))
+    doc.BULK_MIN_OPS = 1
+    with pytest.raises(Exception, match="duplicate seq"):
+        doc.apply_changes(changes + [dup])
+
+
+def test_extract_trailing_empty_change():
+    """A zero-op (message-only) change at the end of a batch must extract."""
+    from automerge_tpu.ops import OpLog
+    from automerge_tpu.storage.change import StoredChange, build_change
+
+    base, forks, t, lst = build_divergent_docs(7, n_forks=1, n_edits=5)
+    changes = [a.stored for a in base.doc.history]
+    empty = build_change(
+        StoredChange(
+            dependencies=[changes[-1].hash],
+            actor=b"\x42" * 16,
+            other_actors=[],
+            seq=1,
+            start_op=1000,
+            timestamp=0,
+            message="empty",
+            ops=[],
+        )
+    )
+    log = OpLog.from_changes(changes + [empty], fast=True)
+    log2 = OpLog.from_changes(changes + [empty], fast=False)
+    assert log.n == log2.n
