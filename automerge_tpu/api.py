@@ -20,6 +20,7 @@ from typing import List, Optional
 
 from .core.document import AutomergeError, Document, ROOT
 from .core.transaction import Transaction
+from .patches.patch_log import PatchCallback, PatchLog
 from .types import ActorId, ObjType
 
 
@@ -30,6 +31,44 @@ class AutoDoc:
         self._manual: Optional[Transaction] = None
         self._isolation: Optional[List[bytes]] = None
         self._diff_cursor: List[bytes] = []
+        # persistent observer log (reference: autocommit.rs owns a PatchLog);
+        # inactive until an observer is attached so the hot path pays nothing
+        self.patch_log = PatchLog(active=False)
+        self._patch_callback: Optional[PatchCallback] = None
+
+    # -- observers ----------------------------------------------------------
+
+    def set_patch_callback(
+        self, callback: Optional[PatchCallback], from_scratch: bool = False
+    ) -> None:
+        """Attach a live observer: ``callback(patches)`` fires after every
+        commit / apply / merge / sync-receive / incremental load.
+
+        ``from_scratch=True`` leaves the log's cursor unset so the first
+        notification materializes the whole current state (reference:
+        automerge/current_state.rs — load with an active patch log).
+        Otherwise only changes made after attachment are reported.
+        """
+        self._patch_callback = callback
+        if callback is None:
+            self.patch_log.set_active(False)
+            return
+        self.patch_log.set_active(True)
+        if not from_scratch:
+            self.patch_log.reset(self.doc)
+        self._notify_patches()
+
+    def make_patches(self):
+        """Drain the patch log: patches covering everything since the last
+        drain (reference: Automerge::make_patches / autocommit diff cursor)."""
+        return self.patch_log.make_patches(self.doc)
+
+    def _notify_patches(self) -> None:
+        if self._patch_callback is None or not self.patch_log.is_active():
+            return
+        patches = self.patch_log.make_patches(self.doc)
+        if patches:
+            self._patch_callback(patches)
 
     # -- transaction management --------------------------------------------
 
@@ -70,6 +109,8 @@ class AutoDoc:
             # isolated edits build on each other: advance the isolation
             # point to the committed change (reference: autocommit isolate)
             self._isolation = [h]
+        if h is not None:
+            self._notify_patches()
         return h
 
     def rollback(self) -> int:
@@ -204,7 +245,9 @@ class AutoDoc:
     def merge(self, other: "AutoDoc") -> List[bytes]:
         self.commit()
         other.commit()
-        return self.doc.merge(other.doc)
+        heads = self.doc.merge(other.doc)
+        self._notify_patches()
+        return heads
 
     def fork(self, actor: Optional[ActorId] = None) -> "AutoDoc":
         self.commit()
@@ -217,6 +260,7 @@ class AutoDoc:
     def apply_changes(self, changes) -> None:
         self.commit()
         self.doc.apply_changes(changes)
+        self._notify_patches()
 
     def get_changes(self, have_deps: List[bytes]):
         self.commit()
@@ -267,6 +311,7 @@ class AutoDoc:
 
         self.commit()
         receive_sync_message(self.doc, state, message)
+        self._notify_patches()
 
     # -- save / load -------------------------------------------------------
 

@@ -1,0 +1,59 @@
+"""PatchLog: the live observer cursor feeding materialized views.
+
+Reference surface: rust/automerge/src/patches/patch_log.rs — a PatchLog
+with an active/inactive switch that every mutating path feeds, drained by
+``make_patches``. This implementation records the *heads cursor* instead
+of per-op events: draining diffs cursor→current through patches/diff.py.
+That one design choice makes every mutation route uniform — per-op apply,
+the native bulk rebuild (core/bulk_load.py), the device merge kernel, and
+load all advance the same cursor — where an event log would need bespoke
+instrumentation in each (and could not observe the batched paths at all).
+The produced patches are identical to the reference's collapsed event
+stream: applying them to the before-state materializes the after-state
+(tests/test_patches.py, tests/test_patch_log.py).
+
+When inactive, draining is a no-op and nothing is computed — the hot
+paths pay nothing (reference: patch_log.rs:105-152 active/inactive).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from .diff import diff
+from .patch import Patch
+
+
+class PatchLog:
+    __slots__ = ("active", "_cursor", "text_rep")
+
+    def __init__(self, active: bool = True, text_rep: str = "string"):
+        self.active = active
+        self._cursor: Optional[List[bytes]] = None  # None = materialize all
+        self.text_rep = text_rep
+
+    def set_active(self, active: bool) -> None:
+        self.active = active
+
+    def is_active(self) -> bool:
+        return self.active
+
+    def reset(self, doc) -> None:
+        """Move the cursor to the document's current heads."""
+        self._cursor = doc.get_heads()
+
+    def make_patches(self, doc) -> List[Patch]:
+        """Drain: patches covering everything since the cursor (or the whole
+        current state when the cursor was never set — the load /
+        current_state case, reference automerge/current_state.rs)."""
+        if not self.active:
+            self._cursor = doc.get_heads()
+            return []
+        before = self._cursor if self._cursor is not None else []
+        after = doc.get_heads()
+        patches = diff(doc, before, after)
+        self._cursor = after
+        return patches
+
+
+PatchCallback = Callable[[List[Patch]], None]
