@@ -330,3 +330,4 @@ class AutoDoc:
     def load_incremental(self, data: bytes, verify: bool = True) -> None:
         self.commit()
         self.doc.load_incremental(data, verify)
+        self._notify_patches()

@@ -2,54 +2,101 @@
 
 The batched alternative to the host OpStore for N-way merges: build an
 OpLog from many replicas' changes, run ops/merge.py once on device, then
-answer reads (text/get/keys/length/hydrate) from the resolved columns.
-Mirrors the reference ReadDoc surface (reference: rust/automerge/src/
-read.rs:32-236) for the current-state case; historical ``*_at`` reads stay
-on the host document, which shares the same change history.
+answer reads from the resolved columns. Mirrors the reference ReadDoc
+surface (reference: rust/automerge/src/read.rs:32-236) including the
+historical ``*_at`` variants: ``at(heads)`` re-resolves visibility under a
+clock mask (vectorized ``Clock::covers``, reference: clock.rs:71-77) while
+sharing the log and the RGA element order with the current-state view —
+element order depends only on the insert forest, never on the clock.
+
+Also a patch source: ``diff(before_heads, after_heads)`` emits the same
+path-qualified patches as the host differ (patches/diff.py) straight from
+two clock-masked kernel resolutions, so the device merge can feed
+materialized views / ``apply_patches`` without a host re-apply
+(reference: rust/automerge/src/automerge/diff.rs log_diff).
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..core.marks import Mark
+from ..patches.patch import (
+    DeleteMap,
+    DeleteSeq,
+    FlagConflict,
+    IncrementPatch,
+    Insert,
+    Patch,
+    PutMap,
+    PutSeq,
+    SpliceText,
+)
 from ..types import ObjType, is_make_action, objtype_for_action
 from .merge import merge_columns
-from .oplog import OpLog, TAG_COUNTER
+from .oplog import ACTOR_BITS, OpLog, TAG_COUNTER
 
 _MAKE_OBJ = {0: ObjType.MAP, 2: ObjType.LIST, 4: ObjType.TEXT, 6: ObjType.TABLE}
 _OBJ_REPLACEMENT = "￼"
+_PUT = 1
 _INCREMENT = 5
+_MARK = 7
 
 
 class DeviceDoc:
-    def __init__(self, log: OpLog, res: Dict[str, np.ndarray]):
+    def __init__(
+        self,
+        log: OpLog,
+        res: Dict[str, np.ndarray],
+        covered: Optional[np.ndarray] = None,
+        base: Optional["DeviceDoc"] = None,
+    ):
         self.log = log
         self.res = res
         n = log.n
+        self._base = base if base is not None else self
+        self.covered = (
+            covered if covered is not None else np.ones(n, np.bool_)
+        )
         self.visible = res["visible"][:n]
         self.winner = res["winner"][:n]
         self.conflicts = res["conflicts"][:n]
-        self.elem_index = res["elem_index"][:n]
-        # exact int64 counter totals, host-side (the device kernel keeps the
-        # int32 fast path; reference counters are i64, value.rs:369)
+        if base is None:
+            self.elem_index = res["elem_index"][:n]
+            self._views: Dict[tuple, "DeviceDoc"] = {}
+            self._hash_index = {ch.hash: ch for ch in log.changes}
+            self._rank_of = {a.bytes: i for i, a in enumerate(log.actors)}
+            # object id -> object type, from make ops (+ root)
+            self._obj_type: Dict[int, ObjType] = {0: ObjType.MAP}
+            for r in np.flatnonzero(np.isin(log.action[:n], (0, 2, 4, 6))):
+                self._obj_type[int(log.id_key[r])] = _MAKE_OBJ[int(log.action[r])]
+            # row ranges by object
+            order = np.argsort(log.obj_key[:n], kind="stable")
+            self._rows_by_obj = order.astype(np.int64)
+            self._obj_sorted = log.obj_key[:n][order]
+            self._all_elems_cache: Dict[int, List[int]] = {}
+        else:
+            self.elem_index = base.elem_index
+            self._obj_type = base._obj_type
+            self._rows_by_obj = base._rows_by_obj
+            self._obj_sorted = base._obj_sorted
+        # exact int64 counter totals, host-side, gated by this view's clock
+        # (the device kernel keeps the int32 fast path; reference counters
+        # are i64, value.rs:369)
         self.counter_val = log.value_int.copy()
         if len(log.pred_src):
-            mask = (log.action[log.pred_src] == _INCREMENT) & (log.pred_tgt >= 0)
+            mask = (
+                (log.action[log.pred_src] == _INCREMENT)
+                & (log.pred_tgt >= 0)
+                & self.covered[log.pred_src]
+            )
             np.add.at(
                 self.counter_val,
                 log.pred_tgt[mask],
                 log.value_int[log.pred_src[mask]],
             )
-        # object id -> object type, from make ops (+ root)
-        self._obj_type: Dict[int, ObjType] = {0: ObjType.MAP}
-        for r in np.flatnonzero(np.isin(log.action[:n], (0, 2, 4, 6))):
-            self._obj_type[int(log.id_key[r])] = _MAKE_OBJ[int(log.action[r])]
-        # row ranges by object
-        order = np.argsort(log.obj_key[:n], kind="stable")
-        self._rows_by_obj = order.astype(np.int64)
-        self._obj_sorted = log.obj_key[:n][order]
 
     # -- construction -------------------------------------------------------
 
@@ -63,6 +110,10 @@ class DeviceDoc:
         "visible", "winner", "conflicts", "elem_index",
         "obj_vis_len", "obj_text_width",
     )
+    # historical views reuse the base view's element order
+    VIEW_FETCH = (
+        "visible", "winner", "conflicts", "obj_vis_len", "obj_text_width",
+    )
 
     @classmethod
     def resolve(cls, log: OpLog) -> "DeviceDoc":
@@ -72,6 +123,58 @@ class DeviceDoc:
                 log.padded_columns(), fetch=cls.READ_FETCH, n_objs=log.n_objs
             ),
         )
+
+    # -- historical views ---------------------------------------------------
+
+    def current_heads(self) -> List[bytes]:
+        """Change hashes no other change in the log depends on."""
+        base = self._base
+        deps = {d for ch in base.log.changes for d in ch.dependencies}
+        return sorted(h for h in base._hash_index if h not in deps)
+
+    def _clock_vec(self, heads: Sequence[bytes]) -> np.ndarray:
+        """Dense per-actor-rank max-op vector for the clock at ``heads``
+        (the ancestor traversal of change_graph.rs:128-142, host-side)."""
+        base = self._base
+        vec = np.zeros(len(base.log.actors), np.int64)
+        stack = list(heads)
+        seen = set()
+        while stack:
+            h = stack.pop()
+            if h in seen:
+                continue
+            seen.add(h)
+            ch = base._hash_index.get(h)
+            if ch is None:
+                raise KeyError(f"unknown head {h.hex()}")
+            rank = base._rank_of[bytes(ch.actor)]
+            if ch.max_op > vec[rank]:
+                vec[rank] = ch.max_op
+            stack.extend(ch.dependencies)
+        return vec
+
+    def at(self, heads: Optional[Sequence[bytes]]) -> "DeviceDoc":
+        """The document as of ``heads``: same log, same element order,
+        visibility re-resolved under the clock mask (one kernel run,
+        cached per heads set)."""
+        base = self._base
+        if heads is None:
+            return base
+        key = tuple(sorted(heads))
+        view = base._views.get(key)
+        if view is None:
+            covered = base.log.covered_mask(base._clock_vec(heads))
+            res = merge_columns(
+                base.log.padded_columns(covered=covered),
+                fetch=self.VIEW_FETCH,
+                n_objs=base.log.n_objs,
+            )
+            view = DeviceDoc(base.log, res, covered=covered, base=base)
+            base._views[key] = view
+        return view
+
+    def _view(self, heads) -> "DeviceDoc":
+        return self if heads is None else self.at(heads)
 
     # -- row selection ------------------------------------------------------
 
@@ -86,6 +189,23 @@ class DeviceDoc:
             raise KeyError(f"no such object {self.log.export_id(obj_key)}")
         return t
 
+    def _all_elems(self, obj_key: int) -> List[int]:
+        """ALL element rows of a sequence in document order — including
+        invisible and mark elements (the host ``SeqObject.elements()``
+        walk; order is clock-independent so this lives on the base)."""
+        base = self._base
+        cached = base._all_elems_cache.get(obj_key)
+        if cached is None:
+            rows = [
+                (int(base.elem_index[r]), int(r))
+                for r in base._obj_rows(obj_key)
+                if base.log.insert[r] and base.elem_index[r] >= 0
+            ]
+            rows.sort()
+            cached = [r for _, r in rows]
+            base._all_elems_cache[obj_key] = cached
+        return cached
+
     # -- value rendering ----------------------------------------------------
 
     def _render(self, row: int):
@@ -96,7 +216,7 @@ class DeviceDoc:
                 objtype_for_action(a),
                 self.log.export_id(int(self.log.id_key[row])),
             )
-        if a == 1 and int(self.log.value_tag[row]) == TAG_COUNTER:
+        if a == _PUT and int(self.log.value_tag[row]) == TAG_COUNTER:
             return ("counter", int(self.counter_val[row]))
         return ("scalar", self.log.values[row])
 
@@ -105,30 +225,32 @@ class DeviceDoc:
     def object_type(self, obj: str) -> ObjType:
         return self._check_obj(self.log.import_id(obj))
 
-    def keys(self, obj: str = "_root") -> List[str]:
-        ok = self.log.import_id(obj)
-        self._check_obj(ok)
-        rows = self._obj_rows(ok)
+    def keys(self, obj: str = "_root", heads=None) -> List[str]:
+        view = self._view(heads)
+        ok = view.log.import_id(obj)
+        view._check_obj(ok)
+        rows = view._obj_rows(ok)
         props = {
-            int(self.log.prop[r])
+            int(view.log.prop[r])
             for r in rows
-            if self.log.prop[r] >= 0 and self.winner[r] >= 0
+            if view.log.prop[r] >= 0 and view.winner[r] >= 0
         }
-        return sorted(self.log.props[p] for p in props)
+        return sorted(view.log.props[p] for p in props)
 
-    def map_entries(self, obj: str = "_root") -> List[Tuple[str, object, str]]:
-        ok = self.log.import_id(obj)
-        self._check_obj(ok)
+    def map_entries(self, obj: str = "_root", heads=None) -> List[Tuple[str, object, str]]:
+        view = self._view(heads)
+        ok = view.log.import_id(obj)
+        view._check_obj(ok)
         best: Dict[int, int] = {}
-        for r in self._obj_rows(ok):
-            p = int(self.log.prop[r])
-            if p >= 0 and self.winner[r] >= 0:
-                best[p] = int(self.winner[r])
+        for r in view._obj_rows(ok):
+            p = int(view.log.prop[r])
+            if p >= 0 and view.winner[r] >= 0:
+                best[p] = int(view.winner[r])
         out = [
             (
-                self.log.props[p],
-                self._render(w),
-                self.log.export_id(int(self.log.id_key[w])),
+                view.log.props[p],
+                view._render(w),
+                view.log.export_id(int(view.log.id_key[w])),
             )
             for p, w in best.items()
         ]
@@ -137,55 +259,57 @@ class DeviceDoc:
 
     def _seq_elems(self, obj_key: int) -> List[Tuple[int, int]]:
         """Visible elements of a sequence: [(elem_row, winner_row)] in order."""
-        elems = [
-            (int(self.elem_index[r]), int(r), int(self.winner[r]))
-            for r in self._obj_rows(obj_key)
-            if self.log.insert[r] and self.winner[r] >= 0 and self.elem_index[r] >= 0
-        ]
-        elems.sort()
-        return [(r, w) for _, r, w in elems]
-
-    def list_items(self, obj: str) -> List[Tuple[object, str]]:
-        ok = self.log.import_id(obj)
-        self._check_obj(ok)
         return [
-            (self._render(w), self.log.export_id(int(self.log.id_key[w])))
-            for _, w in self._seq_elems(ok)
+            (r, int(self.winner[r]))
+            for r in self._all_elems(obj_key)
+            if self.winner[r] >= 0
         ]
 
-    def text(self, obj: str) -> str:
-        ok = self.log.import_id(obj)
-        self._check_obj(ok)
+    def list_items(self, obj: str, heads=None) -> List[Tuple[object, str]]:
+        view = self._view(heads)
+        ok = view.log.import_id(obj)
+        view._check_obj(ok)
+        return [
+            (view._render(w), view.log.export_id(int(view.log.id_key[w])))
+            for _, w in view._seq_elems(ok)
+        ]
+
+    def text(self, obj: str, heads=None) -> str:
+        view = self._view(heads)
+        ok = view.log.import_id(obj)
+        view._check_obj(ok)
         parts = []
-        for _, w in self._seq_elems(ok):
-            v = self.log.values[w]
+        for _, w in view._seq_elems(ok):
+            v = view.log.values[w]
             parts.append(v.value if v.tag == "str" else _OBJ_REPLACEMENT)
         return "".join(parts)
 
-    def length(self, obj: str = "_root") -> int:
-        ok = self.log.import_id(obj)
-        t = self._check_obj(ok)
+    def length(self, obj: str = "_root", heads=None) -> int:
+        view = self._view(heads)
+        ok = view.log.import_id(obj)
+        t = view._check_obj(ok)
         if t in (ObjType.MAP, ObjType.TABLE):
-            return len(self.keys(obj))
-        dense = int(np.searchsorted(self.log.obj_table, ok))
+            return len(view.keys(obj))
+        dense = int(np.searchsorted(view.log.obj_table, ok))
         if t == ObjType.TEXT:
-            return int(self.res["obj_text_width"][dense])
-        return int(self.res["obj_vis_len"][dense])
+            return int(view.res["obj_text_width"][dense])
+        return int(view.res["obj_vis_len"][dense])
 
-    def get_all(self, obj: str, prop) -> List[Tuple[object, str]]:
-        ok = self.log.import_id(obj)
-        t = self._check_obj(ok)
-        rows = self._obj_rows(ok)
+    def get_all(self, obj: str, prop, heads=None) -> List[Tuple[object, str]]:
+        view = self._view(heads)
+        ok = view.log.import_id(obj)
+        t = view._check_obj(ok)
+        rows = view._obj_rows(ok)
         if isinstance(prop, str):
             if t not in (ObjType.MAP, ObjType.TABLE):
                 raise ValueError("map lookup requires a map object")
             try:
-                p = self.log.props.index(prop)
+                p = view.log.props.index(prop)
             except ValueError:
                 return []
-            vis = [int(r) for r in rows if int(self.log.prop[r]) == p and self.visible[r]]
+            vis = [int(r) for r in rows if int(view.log.prop[r]) == p and view.visible[r]]
         else:
-            elems = self._seq_elems(ok)
+            elems = view._seq_elems(ok)
             if prop < 0:
                 return []
             if t == ObjType.TEXT:
@@ -194,7 +318,7 @@ class DeviceDoc:
                 er = None
                 at = 0
                 for r, w in elems:
-                    at += int(self.log.width[w])
+                    at += int(view.log.width[w])
                     if prop < at:
                         er = r
                         break
@@ -207,26 +331,129 @@ class DeviceDoc:
             vis = [
                 int(r)
                 for r in rows
-                if self.visible[r]
+                if view.visible[r]
                 and (
-                    (self.log.insert[r] and int(r) == er)
-                    or (not self.log.insert[r] and int(self.log.elem_ref[r]) == er)
+                    (view.log.insert[r] and int(r) == er)
+                    or (not view.log.insert[r] and int(view.log.elem_ref[r]) == er)
                 )
             ]
         vis.sort()  # rows are in Lamport order; winner last
         return [
-            (self._render(r), self.log.export_id(int(self.log.id_key[r])))
+            (view._render(r), view.log.export_id(int(view.log.id_key[r])))
             for r in vis
         ]
 
-    def get(self, obj: str, prop):
-        vals = self.get_all(obj, prop)
+    def get(self, obj: str, prop, heads=None):
+        vals = self.get_all(obj, prop, heads)
         return vals[-1] if vals else None
+
+    # -- cursors (reference: cursor.rs, automerge.rs seek_opid) -------------
+
+    def get_cursor(self, obj: str, position: int, heads=None) -> str:
+        view = self._view(heads)
+        ok = view.log.import_id(obj)
+        t = view._check_obj(ok)
+        if t in (ObjType.MAP, ObjType.TABLE):
+            raise ValueError("cursors only apply to sequences")
+        at = 0
+        for r, w in view._seq_elems(ok):
+            at += int(view.log.width[w]) if t == ObjType.TEXT else 1
+            if position < at:
+                return view.log.export_id(int(view.log.id_key[r]))
+        raise ValueError(f"cursor position {position} out of bounds")
+
+    def get_cursor_position(self, obj: str, cursor: str, heads=None) -> int:
+        view = self._view(heads)
+        ok = view.log.import_id(obj)
+        t = view._check_obj(ok)
+        if t in (ObjType.MAP, ObjType.TABLE):
+            raise ValueError("cursors only apply to sequences")
+        target = view.log.import_id(cursor)
+        index = 0
+        for r in view._all_elems(ok):
+            if int(view.log.id_key[r]) == target:
+                return index
+            w = int(view.winner[r])
+            if w >= 0:
+                index += int(view.log.width[w]) if t == ObjType.TEXT else 1
+        raise ValueError(f"cursor {cursor!r} not found in {obj!r}")
+
+    # -- marks (reference: marks.rs MarkStateMachine, automerge.rs:1370) ----
+
+    def marks(self, obj: str, heads=None) -> List[Mark]:
+        view = self._view(heads)
+        ok = view.log.import_id(obj)
+        t = view._check_obj(ok)
+        if t in (ObjType.MAP, ObjType.TABLE):
+            raise ValueError("marks on a non-sequence object")
+        log = view.log
+        is_text = t == ObjType.TEXT
+        open_marks: List[Tuple[int, str, object]] = []  # (begin id_key, name, value)
+        index = 0
+        spans: Dict[str, List[Mark]] = {}
+        for r in view._all_elems(ok):
+            if int(log.action[r]) == _MARK:
+                # mark begin/end ops are covered-or-absent, never "visible"
+                # (core/marks.py visible_or_mark)
+                if not view.covered[r]:
+                    continue
+                mi = int(log.mark_name_idx[r])
+                if mi >= 0:  # begin
+                    open_marks.append(
+                        (int(log.id_key[r]), log.mark_names[mi], log.values[r].to_py())
+                    )
+                    # packed id order == lamport order (rank = actor byte rank)
+                    open_marks.sort()
+                else:  # end: pairs with begin id (ctr-1, same actor)
+                    begin = int(log.id_key[r]) - (1 << ACTOR_BITS)
+                    open_marks = [e for e in open_marks if e[0] != begin]
+                continue
+            w = int(view.winner[r])
+            if w < 0:
+                continue
+            width = int(log.width[w]) if is_text else 1
+            current: Dict[str, object] = {}
+            for _, name, value in open_marks:  # lamport-ascending: last wins
+                current[name] = value
+            for name, value in current.items():
+                runs = spans.setdefault(name, [])
+                if runs and runs[-1].end == index and runs[-1].value == value:
+                    runs[-1].end = index + width
+                else:
+                    runs.append(Mark(index, index + width, name, value))
+            index += width
+        out = [
+            m
+            for runs in spans.values()
+            for m in runs
+            if m.value is not None  # null-valued spans are unmarks
+        ]
+        out.sort(key=lambda m: (m.start, m.name))
+        return out
+
+    # -- diff / patches -----------------------------------------------------
+
+    def diff(self, before_heads, after_heads=None) -> List[Patch]:
+        """Patches turning the state at ``before_heads`` into the state at
+        ``after_heads`` (None = current). Same shape and ordering as the
+        host differ; computed from two clock-masked kernel resolutions."""
+        vb = self.at(before_heads if before_heads is not None else [])
+        va = self._view(after_heads)
+        patches: List[Patch] = []
+        _diff_obj(vb, va, 0, [], patches)
+        return patches
+
+    def make_patches(self) -> List[Patch]:
+        """Patches materializing the whole current state (applying them to
+        an empty dict reproduces ``hydrate()`` — the current_state analogue,
+        reference: automerge/current_state.rs)."""
+        return self.diff([])
 
     # -- materialization ----------------------------------------------------
 
-    def hydrate(self, obj: str = "_root"):
-        return self._hydrate(self.log.import_id(obj))
+    def hydrate(self, obj: str = "_root", heads=None):
+        view = self._view(heads)
+        return view._hydrate(view.log.import_id(obj))
 
     def _hydrate(self, obj_key: int):
         t = self._check_obj(obj_key)
@@ -248,3 +475,157 @@ class DeviceDoc:
         if kind == "counter":
             return rendered[1]
         return rendered[1].to_py()
+
+
+# -- the device differ (mirrors patches/diff.py walk) ------------------------
+
+
+def _patch_value(view: DeviceDoc, row: int):
+    """Patch value of a winning op: hydrated subtree / counter / scalar."""
+    a = int(view.log.action[row])
+    if is_make_action(a):
+        return view._hydrate(int(view.log.id_key[row]))
+    if a == _PUT and int(view.log.value_tag[row]) == TAG_COUNTER:
+        return int(view.counter_val[row])
+    return view.log.values[row].to_py()
+
+
+def _is_counter_row(log: OpLog, row: int) -> bool:
+    return int(log.action[row]) == _PUT and int(log.value_tag[row]) == TAG_COUNTER
+
+
+def _diff_obj(vb, va, obj_key, path, patches):
+    t = va._check_obj(obj_key)
+    exid = va.log.export_id(obj_key)
+    if t in (ObjType.MAP, ObjType.TABLE):
+        _diff_map(vb, va, obj_key, exid, path, patches)
+    elif t == ObjType.TEXT:
+        _diff_text(vb, va, obj_key, exid, path, patches)
+    else:
+        _diff_list(vb, va, obj_key, exid, path, patches)
+
+
+def _diff_map(vb, va, obj_key, exid, path, patches):
+    log = va.log
+    groups: Dict[int, int] = {}  # prop -> representative row
+    for r in va._obj_rows(obj_key):
+        p = int(log.prop[r])
+        if p >= 0 and p not in groups:
+            groups[p] = int(r)
+    for p in sorted(groups, key=lambda p: log.props[p]):
+        rep = groups[p]
+        key = log.props[p]
+        wb = int(vb.winner[rep])
+        wa = int(va.winner[rep])
+        if wa < 0:
+            if wb >= 0:
+                patches.append(Patch(exid, list(path), DeleteMap(key)))
+            continue
+        conflict = int(va.conflicts[rep]) > 1
+        if wb < 0 or wb != wa:
+            patches.append(
+                Patch(exid, list(path), PutMap(key, _patch_value(va, wa), conflict))
+            )
+        elif _is_counter_row(log, wa):
+            delta = int(va.counter_val[wa]) - int(vb.counter_val[wa])
+            if delta:
+                patches.append(Patch(exid, list(path), IncrementPatch(key, delta)))
+        elif conflict and int(vb.conflicts[rep]) <= 1:
+            patches.append(Patch(exid, list(path), FlagConflict(key)))
+        if is_make_action(int(log.action[wa])) and wb == wa:
+            _diff_obj(
+                vb, va, int(log.id_key[wa]), path + [(exid, key)], patches
+            )
+
+
+def _diff_list(vb, va, obj_key, exid, path, patches):
+    log = va.log
+    idx = 0
+    pending_ins = None  # (index, [values])
+    for r in va._all_elems(obj_key):
+        wb = int(vb.winner[r])
+        wa = int(va.winner[r])
+        if wa < 0 and wb < 0:
+            continue
+        if wa >= 0 and wb < 0:
+            if pending_ins is None:
+                pending_ins = (idx, [])
+            pending_ins[1].append(_patch_value(va, wa))
+            idx += 1
+            continue
+        if pending_ins is not None:
+            patches.append(Patch(exid, list(path), Insert(*pending_ins)))
+            pending_ins = None
+        if wa < 0:
+            last = patches[-1] if patches else None
+            if (
+                last is not None
+                and last.obj == exid
+                and isinstance(last.action, DeleteSeq)
+                and last.action.index == idx
+            ):
+                last.action.length += 1
+            else:
+                patches.append(Patch(exid, list(path), DeleteSeq(idx)))
+            continue
+        conflict = int(va.conflicts[r]) > 1
+        if wb != wa:
+            patches.append(
+                Patch(exid, list(path), PutSeq(idx, _patch_value(va, wa), conflict))
+            )
+        elif _is_counter_row(log, wa):
+            delta = int(va.counter_val[wa]) - int(vb.counter_val[wa])
+            if delta:
+                patches.append(Patch(exid, list(path), IncrementPatch(idx, delta)))
+        elif conflict and int(vb.conflicts[r]) <= 1:
+            patches.append(Patch(exid, list(path), FlagConflict(idx)))
+        if is_make_action(int(log.action[wa])) and wb == wa:
+            _diff_obj(vb, va, int(log.id_key[wa]), path + [(exid, idx)], patches)
+        idx += 1
+    if pending_ins is not None:
+        patches.append(Patch(exid, list(path), Insert(*pending_ins)))
+
+
+def _diff_text(vb, va, obj_key, exid, path, patches):
+    log = va.log
+    idx = 0
+    pending = None  # [index, str] for inserts
+    for r in va._all_elems(obj_key):
+        wb = int(vb.winner[r])
+        wa = int(va.winner[r])
+        if wa < 0 and wb < 0:
+            continue
+        sa = _char(log, wa) if wa >= 0 else None
+        sb = _char(log, wb) if wb >= 0 else None
+        if wa >= 0 and wb < 0:
+            if pending is None:
+                pending = [idx, ""]
+            pending[1] += sa
+            idx += len(sa)
+            continue
+        if pending is not None:
+            patches.append(Patch(exid, list(path), SpliceText(pending[0], pending[1])))
+            pending = None
+        if wa < 0:
+            last = patches[-1] if patches else None
+            if (
+                last is not None
+                and last.obj == exid
+                and isinstance(last.action, DeleteSeq)
+                and last.action.index == idx
+            ):
+                last.action.length += len(sb)
+            else:
+                patches.append(Patch(exid, list(path), DeleteSeq(idx, len(sb))))
+            continue
+        if wb != wa and (sa != sb):
+            patches.append(Patch(exid, list(path), DeleteSeq(idx, len(sb))))
+            patches.append(Patch(exid, list(path), SpliceText(idx, sa)))
+        idx += len(sa)
+    if pending is not None:
+        patches.append(Patch(exid, list(path), SpliceText(pending[0], pending[1])))
+
+
+def _char(log: OpLog, row: int) -> str:
+    v = log.values[row]
+    return v.value if v.tag == "str" else _OBJ_REPLACEMENT

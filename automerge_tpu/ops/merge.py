@@ -56,12 +56,17 @@ def succ_resolution(c):
     The bandwidth-heavy phase; parallel/sharding.py shards the pred stream
     across a device mesh and psums these partial counters. One fused
     scatter-add carries all three accumulators.
+
+    ``covered`` gates each pred edge by its source op's clock coverage: a
+    successor outside the read clock does not overwrite (the vectorized
+    ``Clock::covers`` test on the succ side of ``visible_at``,
+    reference: types.rs:712-744, clock.rs:71-77).
     """
     P = c["action"].shape[0]
     action = c["action"]
     tgt = c["pred_tgt"]
-    hit = tgt >= 0
     src = c["pred_src"]
+    hit = (tgt >= 0) & c["covered"][src]
     src_is_inc = action[src] == _INCREMENT
     tgt_c = jnp.where(hit, tgt, 0)
     one = jnp.ones_like(tgt_c)
@@ -101,11 +106,16 @@ def resolve_state(c, succ_count, inc_count, counter_inc):
     obj_dense = c["obj_dense"]
 
     # --- 2. visibility -----------------------------------------------------
+    # ``covered`` masks ops outside the read clock (all-true for current
+    # state); RGA linearization below deliberately ignores it so element
+    # order — which depends only on the insert forest — is identical across
+    # historical views of one log.
     never = (action == _DELETE) | (action == _INCREMENT) | (action == _MARK)
     is_counter = (action == _PUT) & (c["value_tag"] == TAG_COUNTER)
     # counter puts survive increment successors (types.rs:712-720)
     visible = (
         valid
+        & c["covered"]
         & ~never
         & jnp.where(is_counter, succ_count == 0, (succ_count + inc_count) == 0)
     )

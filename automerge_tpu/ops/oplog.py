@@ -412,15 +412,20 @@ class OpLog:
 
     # -- device prep -----------------------------------------------------
 
-    def padded_columns(self, min_capacity: int = 16):
+    def padded_columns(self, min_capacity: int = 16, covered: np.ndarray = None):
         """Pad to power-of-two capacities for shape-stable jit.
 
         Everything is int32/bool — deliberately: int64 is emulated on TPU.
         Counter payloads are truncated to int32 on device (exact int64
         totals are recovered host-side from ``value_int`` when needed).
+
+        ``covered`` is the per-row clock mask for historical reads
+        (default: every op covered — the current-state resolution).
         """
         p = _capacity(self.n, min_capacity)
         q = _capacity(len(self.pred_src), min_capacity)
+        if covered is None:
+            covered = np.ones(self.n, np.bool_)
         return {
             "action": _pad(self.action, p, PAD_ACTION),
             "insert": _pad(self.insert, p, False),
@@ -430,9 +435,18 @@ class OpLog:
             "value_tag": _pad(self.value_tag, p, TAG_NULL),
             "value_i32": _pad(self.value_int.astype(np.int32), p, 0),
             "width": _pad(self.width, p, 0),
+            "covered": _pad(np.asarray(covered, np.bool_), p, False),
             "pred_src": _pad(self.pred_src, q, 0),
             "pred_tgt": _pad(self.pred_tgt, q, -1),
         }
+
+    def covered_mask(self, clock_max_op: np.ndarray) -> np.ndarray:
+        """Vectorized ``Clock::covers`` (reference: clock.rs:71-77): row i is
+        covered iff its counter <= clock_max_op[actor rank]. ``clock_max_op``
+        is the dense per-rank max-op vector (0 = actor not in clock)."""
+        ctr = self.id_key >> ACTOR_BITS
+        rank = (self.id_key & ACTOR_MASK).astype(np.int64)
+        return ctr <= np.asarray(clock_max_op, np.int64)[rank]
 
     # -- host-side id helpers ---------------------------------------------
 

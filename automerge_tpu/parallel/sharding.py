@@ -57,6 +57,7 @@ COLUMN_SPECS = {
     "value_tag": P(),
     "value_i32": P(),
     "width": P(),
+    "covered": P(),
     "pred_src": P(AXIS),
     "pred_tgt": P(AXIS),
 }

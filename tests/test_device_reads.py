@@ -1,0 +1,208 @@
+"""DeviceDoc read parity: historical reads, marks, cursors, diff.
+
+The device view at any heads must agree with the host document — same
+text/keys/values/hydrate at every snapshot, same mark spans, same cursor
+resolution, and diffs whose application transforms the before-state into
+the after-state (reference surface: rust/automerge/src/read.rs:32-236
+historical ``*_at`` variants, automerge/diff.rs, cursor.rs, marks.rs).
+"""
+
+import random
+
+import pytest
+
+from automerge_tpu.api import AutoDoc
+from automerge_tpu.ops import DeviceDoc
+from automerge_tpu.patches import apply_patches
+from automerge_tpu.types import ActorId, ObjType, ScalarValue
+
+
+def actor(i: int) -> ActorId:
+    return ActorId(bytes([i]) * 16)
+
+
+def host_merge(docs):
+    out = AutoDoc(actor=actor(250))
+    for d in docs:
+        out.merge(d)
+    return out
+
+
+def build_history():
+    """Two actors diverge and re-merge over text/map/list/counter state;
+    returns (docs, snapshots) where snapshots are heads after each phase."""
+    a = AutoDoc(actor=actor(1))
+    text = a.put_object("_root", "text", ObjType.TEXT)
+    notes = a.put_object("_root", "notes", ObjType.LIST)
+    a.put("_root", "clicks", ScalarValue("counter", 0))
+    a.splice_text(text, 0, 0, "hello world")
+    a.insert(notes, 0, "first")
+    a.commit()
+    snaps = [a.get_heads()]
+
+    b = a.fork(actor=actor(2))
+    a.splice_text(text, 5, 0, " brave")
+    a.put("_root", "from_a", 1)
+    a.increment("_root", "clicks", 3)
+    a.commit()
+    snaps.append(a.get_heads())
+
+    b.splice_text(text, 0, 5, "goodbye")
+    b.insert(notes, 1, "second")
+    b.put("_root", "from_b", 2)
+    b.increment("_root", "clicks", 10)
+    b.delete("_root", "from_b")
+    b.put("_root", "from_b", 3)
+    b.commit()
+    snaps.append(b.get_heads())
+
+    a.merge(b)
+    a.splice_text(text, 0, 0, ">> ")
+    a.commit()
+    snaps.append(a.get_heads())
+    return [a, b], snaps, text, notes
+
+
+def test_historical_reads_match_host():
+    docs, snaps, text, notes = build_history()
+    host = host_merge(docs)
+    dev = DeviceDoc.merge(docs)
+    for heads in snaps:
+        assert dev.text(text, heads=heads) == host.text(text, heads=heads)
+        assert dev.keys("_root", heads=heads) == host.keys("_root", heads=heads)
+        assert dev.length(text, heads=heads) == host.length(text, heads=heads)
+        assert dev.length(notes, heads=heads) == host.length(notes, heads=heads)
+        assert dev.hydrate(heads=heads) == host.hydrate(heads=heads)
+        got = dev.get("_root", "clicks", heads=heads)
+        want = host.get("_root", "clicks", heads=heads)
+        if want is None:
+            assert got is None
+        else:
+            assert got[0][1] == want[0][1]  # counter value
+
+
+def test_current_heads_matches_host():
+    docs, _, _, _ = build_history()
+    host = host_merge(docs)
+    dev = DeviceDoc.merge(docs)
+    assert sorted(dev.current_heads()) == sorted(host.get_heads())
+
+
+def test_view_at_empty_heads_is_empty():
+    docs, _, _, _ = build_history()
+    dev = DeviceDoc.merge(docs)
+    assert dev.hydrate(heads=[]) == {}
+
+
+def test_device_diff_applies_between_snapshots():
+    docs, snaps, _, _ = build_history()
+    host = host_merge(docs)
+    dev = DeviceDoc.merge(docs)
+    pairs = [([], snaps[0]), (snaps[0], snaps[1]), (snaps[0], snaps[3]),
+             (snaps[1], snaps[3]), (snaps[2], snaps[3]), (snaps[3], snaps[0])]
+    for before, after in pairs:
+        patches = dev.diff(before, after)
+        got = apply_patches(host.hydrate(heads=before), patches)
+        assert got == host.hydrate(heads=after), (before, after, patches)
+
+
+def test_device_diff_matches_host_diff():
+    docs, snaps, _, _ = build_history()
+    host = host_merge(docs)
+    dev = DeviceDoc.merge(docs)
+    assert dev.diff(snaps[0], snaps[3]) == host.diff(snaps[0], snaps[3])
+
+
+def test_make_patches_materializes_current_state():
+    docs, _, _, _ = build_history()
+    dev = DeviceDoc.merge(docs)
+    assert apply_patches({}, dev.make_patches()) == dev.hydrate()
+
+
+def test_marks_match_host():
+    a = AutoDoc(actor=actor(1))
+    text = a.put_object("_root", "text", ObjType.TEXT)
+    a.splice_text(text, 0, 0, "hello wonderful world")
+    a.mark(text, 0, 11, "bold", True)
+    a.commit()
+    h1 = a.get_heads()
+    b = a.fork(actor=actor(2))
+    a.mark(text, 6, 15, "italic", True)
+    a.commit()
+    b.unmark(text, 0, 5, "bold")
+    b.splice_text(text, 5, 0, " there")
+    b.commit()
+    a.merge(b)
+    host = host_merge([a, b])
+    dev = DeviceDoc.merge([a, b])
+    assert dev.marks(text) == host.marks(text)
+    assert dev.marks(text, heads=h1) == host.marks(text, heads=h1)
+
+
+def test_cursors_match_host():
+    docs, snaps, text, notes = build_history()
+    host = host_merge(docs)
+    dev = DeviceDoc.merge(docs)
+    n = host.length(text)
+    for pos in (0, 1, n // 2, n - 1):
+        c_host = host.get_cursor(text, pos)
+        c_dev = dev.get_cursor(text, pos)
+        assert c_dev == c_host
+        assert dev.get_cursor_position(text, c_dev) == pos
+    # cursors survive history: resolve a current cursor at an old snapshot
+    c = dev.get_cursor(text, 4)
+    assert dev.get_cursor_position(text, c, heads=snaps[0]) == \
+        host.get_cursor_position(text, c, heads=snaps[0])
+    with pytest.raises(ValueError):
+        dev.get_cursor(text, 10_000)
+
+
+def test_cursor_of_deleted_element_reports_would_be_index():
+    a = AutoDoc(actor=actor(1))
+    lst = a.put_object("_root", "l", ObjType.LIST)
+    for i in range(5):
+        a.insert(lst, i, i)
+    a.commit()
+    c = host_merge([a]).get_cursor(lst, 2)
+    a.delete(lst, 2)
+    a.commit()
+    host = host_merge([a])
+    dev = DeviceDoc.merge([a])
+    assert dev.get_cursor_position(lst, c) == host.get_cursor_position(lst, c) == 2
+
+
+def test_randomized_fork_merge_history_parity():
+    rng = random.Random(7)
+    root = AutoDoc(actor=actor(1))
+    text = root.put_object("_root", "text", ObjType.TEXT)
+    root.splice_text(text, 0, 0, "seed text here")
+    root.commit()
+    docs = [root]
+    snaps = [root.get_heads()]
+    for step in range(12):
+        if len(docs) < 4 and rng.random() < 0.4:
+            docs.append(docs[rng.randrange(len(docs))].fork(actor=actor(10 + step)))
+        d = docs[rng.randrange(len(docs))]
+        n = d.length(text)
+        op = rng.random()
+        if op < 0.5:
+            d.splice_text(text, rng.randrange(n + 1), 0, rng.choice("abcdef") * 2)
+        elif op < 0.75 and n > 2:
+            d.splice_text(text, rng.randrange(n - 1), 1, "")
+        else:
+            d.put("_root", f"k{rng.randrange(5)}", step)
+        d.commit()
+        if rng.random() < 0.35 and len(docs) > 1:
+            i, j = rng.sample(range(len(docs)), 2)
+            docs[i].merge(docs[j])
+        snaps.append(docs[0].get_heads())
+    host = host_merge(docs)
+    dev = DeviceDoc.merge(docs)
+    assert dev.hydrate() == host.hydrate()
+    for heads in snaps[::2]:
+        assert dev.text(text, heads=heads) == host.text(text, heads=heads)
+        assert dev.hydrate(heads=heads) == host.hydrate(heads=heads)
+    for before, after in [(snaps[0], None), (snaps[3], snaps[9]), ([], None)]:
+        patches = dev.diff(before, after)
+        want = host.hydrate(heads=after) if after is not None else host.hydrate()
+        assert apply_patches(host.hydrate(heads=before), patches) == want

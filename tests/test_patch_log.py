@@ -1,0 +1,147 @@
+"""Live PatchLog / observer path: every mutating route notifies.
+
+Reference behavior: patches/patch_log.rs (active/inactive switch, every
+mutator has a *_log_patches variant, lib.rs:100-102) and
+automerge/current_state.rs (patches materializing the whole doc on load).
+Here: a patch callback attached to AutoDoc fires after commit, merge,
+apply_changes, sync receive, and incremental load, and replaying the
+patches tracks hydrate() exactly.
+"""
+
+from automerge_tpu.api import AutoDoc
+from automerge_tpu.patches import apply_patches
+from automerge_tpu.types import ActorId, ObjType, ScalarValue
+
+
+def actor(i: int) -> ActorId:
+    return ActorId(bytes([i]) * 16)
+
+
+class Tracker:
+    """A materialized view maintained purely from patch notifications."""
+
+    def __init__(self, doc: AutoDoc, from_scratch=True):
+        self.state = {}
+        self.notifications = 0
+        doc.set_patch_callback(self._on_patches, from_scratch=from_scratch)
+
+    def _on_patches(self, patches):
+        self.notifications += 1
+        self.state = apply_patches(self.state, patches)
+
+
+def test_callback_fires_on_commit():
+    d = AutoDoc(actor=actor(1))
+    t = Tracker(d)
+    d.put("_root", "a", 1)
+    d.commit()
+    assert t.state == d.hydrate() == {"a": 1}
+    text = d.put_object("_root", "t", ObjType.TEXT)
+    d.splice_text(text, 0, 0, "hi")
+    d.commit()
+    assert t.state == d.hydrate() == {"a": 1, "t": "hi"}
+    assert t.notifications == 2
+
+
+def test_from_scratch_materializes_existing_state():
+    d = AutoDoc(actor=actor(1))
+    d.put("_root", "pre", "existing")
+    d.commit()
+    t = Tracker(d, from_scratch=True)
+    assert t.state == {"pre": "existing"}
+    assert t.notifications == 1
+
+
+def test_attach_without_scratch_reports_only_new_changes():
+    d = AutoDoc(actor=actor(1))
+    d.put("_root", "pre", "existing")
+    d.commit()
+    seen = []
+    d.set_patch_callback(lambda ps: seen.extend(ps))
+    assert seen == []  # nothing new yet
+    d.put("_root", "new", 1)
+    d.commit()
+    assert len(seen) == 1 and seen[0].action.key == "new"
+
+
+def test_callback_fires_on_merge_and_apply_changes():
+    d = AutoDoc(actor=actor(1))
+    t = Tracker(d)
+    other = AutoDoc(actor=actor(2))
+    other.put("_root", "via_merge", True)
+    other.commit()
+    d.merge(other)
+    assert t.state == d.hydrate()
+
+    third = AutoDoc(actor=actor(3))
+    third.put("_root", "via_apply", ScalarValue("counter", 4))
+    third.commit()
+    d.apply_changes(third.get_changes([]))
+    assert t.state == d.hydrate()
+
+
+def test_callback_fires_on_sync_receive():
+    from automerge_tpu.sync import SyncState
+
+    d1 = AutoDoc(actor=actor(1))
+    d2 = AutoDoc(actor=actor(2))
+    t = Tracker(d2)
+    d1.put("_root", "synced", "yes")
+    d1.commit()
+    s1, s2 = SyncState(), SyncState()
+    for _ in range(10):
+        m = d1.generate_sync_message(s1)
+        if m is not None:
+            d2.receive_sync_message(s2, m)
+        m2 = d2.generate_sync_message(s2)
+        if m2 is not None:
+            d1.receive_sync_message(s1, m2)
+        if m is None and m2 is None:
+            break
+    assert t.state == d2.hydrate() == {"synced": "yes"}
+
+
+def test_callback_fires_on_incremental_load():
+    d1 = AutoDoc(actor=actor(1))
+    d1.put("_root", "a", 1)
+    d1.commit()
+    saved = d1.save()
+    d1.put("_root", "b", 2)
+    d1.commit()
+    incr = d1.save_incremental_after([h for h in _heads_of(saved)])
+
+    d2 = AutoDoc.load(saved)
+    t = Tracker(d2)
+    d2.load_incremental(incr)
+    assert t.state == d2.hydrate() == {"a": 1, "b": 2}
+
+
+def _heads_of(saved: bytes):
+    return AutoDoc.load(saved).get_heads()
+
+
+def test_inactive_log_reports_nothing():
+    d = AutoDoc(actor=actor(1))
+    d.put("_root", "a", 1)
+    d.commit()
+    assert d.make_patches() == []  # log starts inactive
+    seen = []
+    d.set_patch_callback(lambda ps: seen.extend(ps))
+    d.set_patch_callback(None)  # detach deactivates
+    d.put("_root", "b", 2)
+    d.commit()
+    assert seen == []
+
+
+def test_tracker_follows_deep_edits():
+    d = AutoDoc(actor=actor(1))
+    t = Tracker(d)
+    m = d.put_object("_root", "m", ObjType.MAP)
+    lst = d.put_object(m, "list", ObjType.LIST)
+    d.insert(lst, 0, "x")
+    d.commit()
+    d.insert(lst, 1, "y")
+    d.delete(lst, 0)
+    d.put(m, "k", 9)
+    d.commit()
+    assert t.state == d.hydrate() == {"m": {"list": ["y"], "k": 9}}
