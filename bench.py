@@ -79,8 +79,8 @@ def main():
 
     # ---- config 2: N-way fan-in merge (primary) ----------------------------
     base_edits = env_int("BENCH_BASE_EDITS", 120_000)
-    n_replicas = env_int("BENCH_REPLICAS", 512)
-    fork_edits = env_int("BENCH_FORK_EDITS", 120)
+    n_replicas = env_int("BENCH_REPLICAS", 1024)
+    fork_edits = env_int("BENCH_FORK_EDITS", 250)
     t0 = time.perf_counter()
     base = W.build_base(trace, base_edits)
     t_base = time.perf_counter() - t0

@@ -253,3 +253,49 @@ def test_device_get_all_width_aware_text():
         assert got and got[-1][0] == ("scalar", ("str", want)), (pos, got)
         assert [v for v, _ in got] == [v for v, _ in host], pos
     assert dd.get_all(t, 5) == []
+
+
+def test_packed_transport_matches_dict(monkeypatch):
+    """The byte-minimizing packed transport (slope-RLE runs in, one
+    bit-packed vector out — ops/merge.py "packed transport") resolves
+    identically to the per-array dict path on a mixed workload."""
+    import numpy as np
+
+    from automerge_tpu.ops.merge import merge_columns
+
+    base = AutoDoc(actor=actor(1))
+    t = base.put_object("_root", "text", ObjType.TEXT)
+    base.splice_text(t, 0, 0, "packed transport base text")
+    base.put("_root", "count", ScalarValue("counter", 5))
+    lst = base.put_object("_root", "lst", ObjType.LIST)
+    base.insert(lst, 0, 1)
+    base.commit()
+    forks = [base.fork(actor=actor(10 + i)) for i in range(4)]
+    for i, f in enumerate(forks):
+        f.splice_text(t, i * 3, 1 if i % 2 else 0, f"[{i}]")
+        f.increment("_root", "count", i + 1)
+        f.put("_root", "k", i)
+        f.insert(lst, 0, 10 + i)
+        f.commit()
+
+    log = OpLog.from_documents(forks)
+    cols = log.padded_columns()
+    monkeypatch.setenv("AUTOMERGE_TPU_TRANSPORT", "dict")
+    r1 = merge_columns(cols, fetch=DeviceDoc.READ_FETCH, n_objs=log.n_objs)
+    monkeypatch.setenv("AUTOMERGE_TPU_TRANSPORT", "packed")
+    r2 = merge_columns(cols, fetch=DeviceDoc.READ_FETCH, n_objs=log.n_objs)
+    n = log.n
+    assert np.array_equal(r1["visible"][:n], r2["visible"][:n])
+    assert np.array_equal(r1["winner"][:n], r2["winner"][:n])
+    assert np.array_equal(r1["elem_index"][:n], r2["elem_index"][:n])
+    # conflicts travels as a flag; consumers only test > 1
+    assert np.array_equal(
+        np.asarray(r1["conflicts"][:n]) > 1, np.asarray(r2["conflicts"][:n]) > 1
+    )
+    for k in ("obj_vis_len", "obj_text_width"):
+        m = min(len(r1[k]), len(r2[k]))
+        assert np.array_equal(np.asarray(r1[k][:m]), np.asarray(r2[k][:m])), k
+
+    # and the full DeviceDoc read surface agrees with the host merge
+    dev = DeviceDoc(log, r2)
+    assert dev.hydrate() == host_merge(forks).hydrate()
