@@ -87,9 +87,7 @@ class AutoDoc:
             scope = None
             actor = self.doc.actor
             if self._isolation is not None:
-                scope = self.doc.clock_at(self._isolation)
-                level = len(self.doc.states.get(self.doc.actors.cache(self.doc.actor), ()))
-                actor = self.doc.actor.with_concurrency_suffix(level)
+                scope, actor = self.doc.isolate_actor(self._isolation)
             self._tx = Transaction(self.doc, scope=scope, actor=actor)
             if self._isolation is not None:
                 self._tx.deps = list(self._isolation)
@@ -180,8 +178,8 @@ class AutoDoc:
     def mark(self, obj: str, start: int, end: int, name: str, value, expand="after") -> None:
         self._ensure_tx().mark(obj, start, end, name, value, expand)
 
-    def unmark(self, obj: str, start: int, end: int, name: str) -> None:
-        self._ensure_tx().unmark(obj, start, end, name)
+    def unmark(self, obj: str, start: int, end: int, name: str, expand="none") -> None:
+        self._ensure_tx().unmark(obj, start, end, name, expand)
 
     # -- reads -------------------------------------------------------------
     # Reads see the open transaction's ops in place (the store is updated as
@@ -324,10 +322,19 @@ class AutoDoc:
         return self.doc.save_incremental_after(heads)
 
     @classmethod
-    def load(cls, data: bytes, actor: Optional[ActorId] = None, verify: bool = True) -> "AutoDoc":
-        return cls(document=Document.load(data, actor, verify))
+    def load(
+        cls,
+        data: bytes,
+        actor: Optional[ActorId] = None,
+        verify: bool = True,
+        on_partial: str = "error",
+    ) -> "AutoDoc":
+        return cls(document=Document.load(data, actor, verify, on_partial=on_partial))
 
-    def load_incremental(self, data: bytes, verify: bool = True) -> None:
+    def load_incremental(
+        self, data: bytes, verify: bool = True, on_partial: str = "ignore"
+    ) -> int:
         self.commit()
-        self.doc.load_incremental(data, verify)
+        applied = self.doc.load_incremental(data, verify, on_partial=on_partial)
         self._notify_patches()
+        return applied

@@ -61,9 +61,9 @@ from .op_store import (
 
 ROOT = "_root"
 
-
-class AutomergeError(ValueError):
-    pass
+# the typed hierarchy lives in automerge_tpu.errors (error.rs analogue);
+# re-exported here because this module historically defined it
+from ..errors import AutomergeError, DuplicateSeqNumber  # noqa: E402,F401
 
 
 class AppliedChange:
@@ -163,9 +163,7 @@ class Document:
             if change.hash in self.history_index:
                 continue
             if self._is_duplicate_seq(change):
-                raise AutomergeError(
-                    f"duplicate seq {change.seq} for actor {change.actor.hex()}"
-                )
+                raise DuplicateSeqNumber(change.seq, change.actor.hex())
             if self._is_causally_ready(change):
                 self._apply_change(change)
             else:
@@ -204,9 +202,7 @@ class Document:
             if change.hash in self.history_index or change.hash in seen_hashes:
                 continue
             if self._is_duplicate_seq(change) or (change.actor, change.seq) in seen_seqs:
-                raise AutomergeError(
-                    f"duplicate seq {change.seq} for actor {change.actor.hex()}"
-                )
+                raise DuplicateSeqNumber(change.seq, change.actor.hex())
             seen_hashes.add(change.hash)
             seen_seqs.add((change.actor, change.seq))
             pending.append(change)
@@ -367,6 +363,50 @@ class Document:
             self.deps.discard(dep)
         self.deps.add(applied.hash)
         self.max_op = max(self.max_op, applied.stored.max_op)
+
+    # -- transactions ------------------------------------------------------
+
+    def transaction(self, message=None, timestamp=None):
+        """Open a manual transaction at the current heads
+        (reference: automerge.rs transaction())."""
+        from .transaction import Transaction
+
+        return Transaction(self, message=message, timestamp=timestamp)
+
+    def isolate_actor(self, heads: List[bytes]):
+        """(scope clock, actor) for an isolated transaction at ``heads``.
+
+        Walks concurrency-suffix levels until it finds an actor whose
+        existing ops are all covered by the clock — pinning that actor in
+        the scope then cannot leak ops from a previous isolation session
+        at the same heads (reference: automerge.rs isolate_actor
+        1072-1092, get_isolated_actor_index)."""
+        scope = self.clock_at(heads)
+        actor = self.actor
+        level = 1
+        while True:
+            idx = self.actors.cache(actor)
+            idxs = self.states.get(idx)
+            max_op = self.history[idxs[-1]].stored.max_op if idxs else 0
+            if max_op == 0 or scope.covers((max_op, idx)):
+                return scope, actor
+            actor = self.actor.with_concurrency_suffix(level)
+            level += 1
+
+    def transaction_at(self, heads: List[bytes], message=None, timestamp=None):
+        """Open a manual transaction scoped to the state at ``heads``:
+        reads and position resolution see only ops the heads' clock covers,
+        and the transaction's actor gets a concurrency suffix so its opids
+        cannot collide with edits made since (reference:
+        automerge.rs:295-298 transaction_at, isolate_actor 1072-1092)."""
+        from .transaction import Transaction
+
+        scope, actor = self.isolate_actor(heads)
+        tx = Transaction(
+            self, message=message, timestamp=timestamp, scope=scope, actor=actor
+        )
+        tx.deps = list(heads)
+        return tx
 
     # -- merge / fork ------------------------------------------------------
 
@@ -684,26 +724,53 @@ class Document:
         return bytes(out)
 
     @classmethod
-    def load(cls, data: bytes, actor: Optional[ActorId] = None, verify: bool = True) -> "Document":
+    def load(
+        cls,
+        data: bytes,
+        actor: Optional[ActorId] = None,
+        verify: bool = True,
+        on_partial: str = "error",
+    ) -> "Document":
+        """Strict by default: any malformed chunk rejects the whole load
+        (the reference's LoadOptions defaults to OnPartialLoad::Error for
+        ``load``; pass on_partial="ignore" to keep the valid prefix —
+        automerge.rs:41-47,601-705)."""
         doc = cls(actor)
-        doc.load_incremental(data, verify=verify)
+        doc.load_incremental(data, verify=verify, on_partial=on_partial)
         return doc
 
-    def load_incremental(self, data: bytes, verify: bool = True) -> None:
+    def load_incremental(
+        self, data: bytes, verify: bool = True, on_partial: str = "ignore"
+    ) -> int:
+        """Apply every chunk in ``data``; returns the number applied.
+
+        A malformed tail stops the scan: with ``on_partial="ignore"`` (the
+        default, matching the reference's incremental load tolerating
+        trailing garbage — automerge.rs:730-769, OnPartialLoad::Ignore
+        automerge.rs:41-47) the valid prefix is kept; "error" re-raises.
+        """
         pos = 0
+        applied = 0
         while pos < len(data):
-            if pos + 9 > len(data):
-                raise AutomergeError("truncated chunk header")
-            if data[pos : pos + 4] != MAGIC_BYTES:
-                raise AutomergeError("invalid chunk magic bytes")
-            chunk_type = data[pos + 8]
-            if chunk_type == CHUNK_DOCUMENT:
-                parsed, pos = parse_document(data, pos)
-                changes = reconstruct_changes(parsed, verify=verify)
-                self.apply_changes(changes)
-            else:
-                change, pos = parse_change(data, pos)
-                self.apply_changes([change])
+            try:
+                if pos + 9 > len(data):
+                    raise AutomergeError("truncated chunk header")
+                if data[pos : pos + 4] != MAGIC_BYTES:
+                    raise AutomergeError("invalid chunk magic bytes")
+                chunk_type = data[pos + 8]
+                if chunk_type == CHUNK_DOCUMENT:
+                    parsed, pos = parse_document(data, pos)
+                    changes = reconstruct_changes(parsed, verify=verify)
+                else:
+                    change, pos = parse_change(data, pos)
+                    changes = [change]
+            except Exception:
+                if on_partial == "error":
+                    raise
+                break
+            self.apply_changes(changes)
+            applied += 1
+        return applied
 
 
 class _ReOp:

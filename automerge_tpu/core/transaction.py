@@ -33,6 +33,7 @@ from ..types import (
 )
 from .clock import Clock
 from .document import AppliedChange, AutomergeError, Document, ROOT
+from ..errors import InvalidOp, MissingCounter
 from .op_store import LIST_ENC, TEXT_ENC, MapObject, Op, ROOT_OBJ, SeqObject
 
 
@@ -171,13 +172,17 @@ class Transaction:
                 else []
             )
             if not any(o.is_counter for o in pred_ops):
-                raise AutomergeError(f"no counter at {prop!r} to increment")
+                raise MissingCounter(f"no counter at {prop!r} to increment")
+            # pred covers EVERY visible op at the slot: a conflicting
+            # non-counter value gains a (non-increment-surviving) successor
+            # and disappears (reference: inner.rs local_map_op + the
+            # visibility rule types.rs:712-744)
             op = Op(
                 id=self._next_id(),
                 action=Action.INCREMENT,
                 value=ScalarValue("int", by),
                 key=key_idx,
-                pred=self.doc.ops.sort_opids([o.id for o in pred_ops if o.is_counter]),
+                pred=self.doc.ops.sort_opids([o.id for o in pred_ops]),
             )
             self._apply(obj_id, op)
         else:
@@ -185,15 +190,17 @@ class Transaction:
             el = self.doc.ops.nth(obj_id, prop, enc, self.scope)
             if el is None:
                 raise AutomergeError(f"index {prop} out of bounds")
-            counters = [o for o in el.visible_ops(self.scope) if o.is_counter]
-            if not counters:
-                raise AutomergeError(f"no counter at index {prop} to increment")
+            visible = el.visible_ops(self.scope)
+            if not any(o.is_counter for o in visible):
+                raise MissingCounter(f"no counter at index {prop} to increment")
+            # pred covers every visible op at the element (see the map
+            # branch above for why)
             op = Op(
                 id=self._next_id(),
                 action=Action.INCREMENT,
                 value=ScalarValue("int", by),
                 elem=el.elem_id,
-                pred=self.doc.ops.sort_opids([o.id for o in counters]),
+                pred=self.doc.ops.sort_opids([o.id for o in visible]),
             )
             self._apply(obj_id, op)
 
@@ -206,7 +213,7 @@ class Transaction:
     def _seq_set(self, obj_id: OpId, index, action: int, value: ScalarValue) -> Op:
         """Overwrite the element at ``index`` (width-aware for text)."""
         if not isinstance(index, int):
-            raise AutomergeError("sequence positions must be integers")
+            raise InvalidOp(msg="sequence positions must be integers")
         info = self.doc.ops.get_obj(obj_id)
         enc = self._encoding(info.data)
         el = self.doc.ops.nth(obj_id, index, enc, self.scope)
@@ -297,7 +304,7 @@ class Transaction:
     def _insert_op(self, obj_id: OpId, index: int, action: int, value: ScalarValue) -> Op:
         info = self.doc.ops.get_obj(obj_id)
         if not isinstance(info.data, SeqObject):
-            raise AutomergeError("insert on a non-sequence object")
+            raise InvalidOp(msg="insert on a non-sequence object")
         enc = self._encoding(info.data)
         elem = self._insert_ref(obj_id, index, enc)
         op = Op(
@@ -314,8 +321,10 @@ class Transaction:
         self._check_open()
         obj_id = self._obj(obj)
         info = self.doc.ops.get_obj(obj_id)
-        if not isinstance(info.data, SeqObject):
-            raise AutomergeError("splice_text on a non-sequence object")
+        # text splices apply only to TEXT objects (reference: InvalidOp,
+        # transaction/inner.rs splice_text via automerge.rs op checks)
+        if not isinstance(info.data, SeqObject) or info.data.obj_type != ObjType.TEXT:
+            raise InvalidOp(msg="splice_text on a non-text object")
         enc = self._encoding(info.data)
         values = [ScalarValue("str", ch) for ch in text]
         self._splice(obj_id, pos, delete, values, enc)
@@ -325,7 +334,7 @@ class Transaction:
         obj_id = self._obj(obj)
         info = self.doc.ops.get_obj(obj_id)
         if not isinstance(info.data, SeqObject):
-            raise AutomergeError("splice on a non-sequence object")
+            raise InvalidOp(msg="splice on a non-sequence object")
         svals = [ScalarValue.from_py(v) for v in values]
         self._splice(obj_id, pos, delete, svals, self._encoding(info.data))
 
@@ -421,7 +430,7 @@ class Transaction:
         obj_id = self._obj(obj)
         info = self.doc.ops.get_obj(obj_id)
         if not isinstance(info.data, SeqObject):
-            raise AutomergeError("mark on a non-sequence object")
+            raise InvalidOp(msg="mark on a non-sequence object")
         if end <= start:
             raise AutomergeError("mark span must be non-empty")
         enc = self._encoding(info.data)
@@ -454,13 +463,44 @@ class Transaction:
         )
         self._apply(obj_id, end_op)
 
-    def unmark(self, obj: str, start: int, end: int, name: str) -> None:
-        self.mark(obj, start, end, name, None, expand="none")
+    def unmark(self, obj: str, start: int, end: int, name: str, expand="none") -> None:
+        """A null-valued mark span: clears ``name`` over [start, end).
+        ``expand`` governs whether edits at the boundaries fall inside the
+        cleared span (reference: transaction/inner.rs unmark)."""
+        self.mark(obj, start, end, name, None, expand=expand)
 
     # -- commit / rollback -------------------------------------------------
 
     def pending_ops(self) -> int:
         return len(self.operations)
+
+    # -- reads (reference: Transactable is a ReadDoc, transactable.rs) -----
+    #
+    # Reads resolve through the transaction's scope clock: an isolated
+    # transaction sees the historical state plus its own pending ops (the
+    # scope pins this transaction's actor), a plain transaction sees the
+    # current state plus pending ops.
+
+    def get(self, obj: str, prop):
+        return self.doc.get(obj, prop, clock=self.scope)
+
+    def get_all(self, obj: str, prop):
+        return self.doc.get_all(obj, prop, clock=self.scope)
+
+    def text(self, obj: str) -> str:
+        return self.doc.text(obj, clock=self.scope)
+
+    def length(self, obj: str) -> int:
+        return self.doc.length(obj, clock=self.scope)
+
+    def keys(self, obj: str = ROOT):
+        return self.doc.keys(obj, clock=self.scope)
+
+    def list_items(self, obj: str):
+        return self.doc.list_items(obj, clock=self.scope)
+
+    def map_entries(self, obj: str = ROOT):
+        return self.doc.map_entries(obj, clock=self.scope)
 
     def commit(self) -> Optional[bytes]:
         """Encode the pending ops as a change and append it to history."""

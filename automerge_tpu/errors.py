@@ -1,0 +1,122 @@
+"""Typed error hierarchy (the analogue of reference error.rs:1-134).
+
+Every framework error derives from ``AutomergeError`` (itself a
+``ValueError`` so existing broad handlers keep working). The typed
+subclasses mirror the reference's ``AutomergeError`` enum variants that
+carry semantic meaning callers dispatch on; parse-layer errors
+(ChunkParseError, LEBDecodeError, ...) live with their codecs and are
+re-exported here.
+"""
+
+from __future__ import annotations
+
+
+class AutomergeError(ValueError):
+    """Base class for all framework errors (reference: error.rs)."""
+
+
+class MissingCounter(AutomergeError):
+    """Increment of a property that holds no counter
+    (reference: error.rs AutomergeError::MissingCounter)."""
+
+    def __init__(self, msg="increment of a non-counter value"):
+        super().__init__(msg)
+
+
+class InvalidOp(AutomergeError):
+    """Operation not valid for the target object's type
+    (reference: error.rs AutomergeError::InvalidOp(ObjType))."""
+
+    def __init__(self, obj_type=None, msg=None):
+        self.obj_type = obj_type
+        super().__init__(msg or f"invalid op for object type {obj_type}")
+
+
+class DuplicateSeqNumber(AutomergeError):
+    """A change re-used a (actor, seq) slot
+    (reference: error.rs DuplicateSeqNumber)."""
+
+    def __init__(self, seq=None, actor=None):
+        self.seq = seq
+        self.actor = actor
+        super().__init__(f"duplicate seq {seq} for actor {actor}")
+
+
+class MissingDeps(AutomergeError):
+    """Changes could not be applied for want of their dependencies
+    (reference: error.rs MissingDeps)."""
+
+
+class InvalidHash(AutomergeError):
+    """A change hash failed verification or was malformed
+    (reference: error.rs InvalidHash)."""
+
+
+class MissingHash(AutomergeError):
+    """A requested change hash is not in this document's history
+    (reference: error.rs MissingHash)."""
+
+
+class InvalidObjId(AutomergeError):
+    """An object/op id string failed to resolve
+    (reference: error.rs InvalidObjId / InvalidObjIdFormat)."""
+
+
+class InvalidActorId(AutomergeError):
+    """An actor id string failed to parse
+    (reference: error.rs InvalidActorId)."""
+
+
+class InvalidIndex(AutomergeError):
+    """A sequence index is out of bounds
+    (reference: error.rs InvalidIndex)."""
+
+
+def _reexports():
+    from .core.change_graph import ChangeGraphError
+    from .core.op_store import OpStoreError
+    from .ops.extract import ExtractError
+    from .storage.chunk import ChunkParseError
+    from .storage.columns import ColumnLayoutError
+    from .sync.protocol import SyncError
+    from .utils.leb128 import LEBDecodeError
+
+    return {
+        "ChangeGraphError": ChangeGraphError,
+        "ChunkParseError": ChunkParseError,
+        "ColumnLayoutError": ColumnLayoutError,
+        "ExtractError": ExtractError,
+        "LEBDecodeError": LEBDecodeError,
+        "OpStoreError": OpStoreError,
+        "SyncError": SyncError,
+    }
+
+
+def __getattr__(name):
+    # parse-layer errors are defined with their codecs; resolve lazily so
+    # importing this module never pulls the whole package
+    table = _reexports()
+    if name in table:
+        return table[name]
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = [
+    "AutomergeError",
+    "ChangeGraphError",
+    "ChunkParseError",
+    "ColumnLayoutError",
+    "DuplicateSeqNumber",
+    "ExtractError",
+    "InvalidActorId",
+    "InvalidHash",
+    "InvalidIndex",
+    "InvalidObjId",
+    "InvalidOp",
+    "LEBDecodeError",
+    "MissingCounter",
+    "MissingDeps",
+    "MissingHash",
+    "OpStoreError",
+    "SyncError",
+]

@@ -356,6 +356,8 @@ def _slope_rle(x: np.ndarray):
     compress below n/8 runs (caller ships it as a plain column).
     """
     n = len(x)
+    if n == 0:
+        return None
     x64 = x.astype(np.int64)
     cands = [0, 1]
     if n > 2:
@@ -395,15 +397,9 @@ def encode_transport(cols) -> tuple:
     Returns (static_key, arrays) where ``static_key`` identifies the jit
     variant (which columns are plain) and ``arrays`` is the input pytree.
     """
+    p_sources = dict(cols, flags=_flags_column(cols))
     groups = {
-        "P": {
-            "flags": _flags_column(cols),
-            "prop": cols["prop"].astype(np.int32),
-            "elem_ref": cols["elem_ref"].astype(np.int32),
-            "obj_dense": cols["obj_dense"].astype(np.int32),
-            "value_i32": cols["value_i32"].astype(np.int32),
-            "width": cols["width"].astype(np.int32),
-        },
+        "P": {k: p_sources[k].astype(np.int32) for k in _P_ORDER},
         "Q": {k: cols[k].astype(np.int32) for k in _Q_ORDER},
     }
     arrays = {}

@@ -403,9 +403,17 @@ class OpLog:
 
     @classmethod
     def from_documents(cls, docs: Sequence) -> "OpLog":
-        """Union of several documents' histories (the N-way fan-in input)."""
+        """Union of several documents' histories (the N-way fan-in input).
+
+        AutoDocs are committed first — the device log is built from change
+        history, so pending transaction ops would otherwise be silently
+        absent (the reference's AutoCommit likewise commits at every
+        save/merge/sync boundary, autocommit.rs:582)."""
         changes: List[StoredChange] = []
         for d in docs:
+            commit = getattr(d, "commit", None)
+            if commit is not None:
+                commit()
             doc = getattr(d, "doc", d)  # AutoDoc or Document
             changes.extend(a.stored for a in doc.history)
         return cls.from_changes(changes)
