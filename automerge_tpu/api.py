@@ -84,13 +84,10 @@ class AutoDoc:
     def _ensure_tx(self) -> Transaction:
         self._check_manual()
         if self._tx is None:
-            scope = None
-            actor = self.doc.actor
             if self._isolation is not None:
-                scope, actor = self.doc.isolate_actor(self._isolation)
-            self._tx = Transaction(self.doc, scope=scope, actor=actor)
-            if self._isolation is not None:
-                self._tx.deps = list(self._isolation)
+                self._tx = self.doc.transaction_at(self._isolation)
+            else:
+                self._tx = Transaction(self.doc)
         return self._tx
 
     def commit(self, message: Optional[str] = None, timestamp: Optional[int] = None) -> Optional[bytes]:

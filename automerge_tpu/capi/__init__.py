@@ -1,0 +1,85 @@
+"""C ABI frontend build helper (see am.h / am_embed.cpp / shim.py).
+
+``build()`` compiles the cdylib (libautomerge_tpu.so) on demand with the
+same content-hash naming discipline as the codec core: a stale build of
+older sources can never be loaded by mistake. The library embeds the
+Python runtime, so consumers link only against the .so and include am.h
+(reference analogue: rust/automerge-c's cdylib + cbindgen header).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import sysconfig
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+HEADER = os.path.join(_HERE, "am.h")
+_SRC = os.path.join(_HERE, "am_embed.cpp")
+_TEST_SRC = os.path.join(_HERE, "test_am.c")
+_REPO_ROOT = os.path.dirname(os.path.dirname(_HERE))
+
+
+def _lib_name() -> str:
+    h = hashlib.sha256()
+    for p in (_SRC, HEADER, os.path.join(_HERE, "shim.py")):
+        with open(p, "rb") as f:
+            h.update(f.read())
+    return f"libautomerge_tpu-{h.hexdigest()[:16]}.so"
+
+
+def _embed_flags() -> tuple[list, list]:
+    inc = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    version = sysconfig.get_config_var("LDVERSION") or sysconfig.get_config_var(
+        "VERSION"
+    )
+    return [f"-I{inc}"], [f"-L{libdir}", f"-lpython{version}", "-ldl", "-lm"]
+
+
+def build(out_dir: Optional[str] = None) -> Optional[str]:
+    """Build (or reuse) the cdylib; returns its path, None if no compiler."""
+    out_dir = out_dir or _HERE
+    path = os.path.join(out_dir, _lib_name())
+    if os.path.exists(path):
+        return path
+    cflags, ldflags = _embed_flags()
+    tmp = f"{path}.tmp{os.getpid()}"
+    cmd = [
+        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+        f'-DAM_PYROOT="{_REPO_ROOT}"',
+        *cflags, "-o", tmp, _SRC, *ldflags,
+    ]
+    try:
+        r = subprocess.run(cmd, capture_output=True, timeout=180)
+        if r.returncode != 0 or not os.path.exists(tmp):
+            return None
+        os.replace(tmp, path)
+        return path
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+def build_test(lib_path: str, out_dir: Optional[str] = None) -> Optional[str]:
+    """Compile the C test program against the cdylib; returns its path."""
+    out_dir = out_dir or _HERE
+    exe = os.path.join(out_dir, "test_am")
+    cmd = [
+        "gcc", "-O1", "-o", exe, _TEST_SRC,
+        f"-I{_HERE}", lib_path, f"-Wl,-rpath,{os.path.dirname(lib_path)}",
+    ]
+    try:
+        r = subprocess.run(cmd, capture_output=True, timeout=120)
+        if r.returncode != 0:
+            return None
+        return exe
+    except (OSError, subprocess.TimeoutExpired):
+        return None

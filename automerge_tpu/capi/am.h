@@ -1,0 +1,133 @@
+/* automerge_tpu C ABI — the analogue of the reference's automerge-c
+ * frontend (reference: rust/automerge-c/src/doc.rs, result.rs, item.rs).
+ *
+ * Memory model: every operation returns an AMresult owning a sequence of
+ * tagged AMitems; the caller frees it with am_result_free. Strings and
+ * byte spans returned by item accessors are owned by the result and live
+ * until it is freed. Documents and sync states are opaque handles freed
+ * with their own destructors.
+ *
+ * Call am_init() once before anything else (it boots the embedded
+ * runtime; set AUTOMERGE_TPU_PYROOT if the framework is not importable
+ * from the default path), and am_shutdown() at exit.
+ */
+#ifndef AUTOMERGE_TPU_AM_H
+#define AUTOMERGE_TPU_AM_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct AMdoc AMdoc;
+typedef struct AMresult AMresult;
+typedef struct AMsyncState AMsyncState;
+
+typedef enum {
+  AM_STATUS_OK = 0,
+  AM_STATUS_ERROR = 1,
+} AMstatus;
+
+/* Matches automerge_tpu/capi/shim.py item tags. */
+typedef enum {
+  AM_VAL_VOID = 0,
+  AM_VAL_NULL = 1,
+  AM_VAL_BOOL = 2,
+  AM_VAL_INT = 3,
+  AM_VAL_UINT = 4,
+  AM_VAL_F64 = 5,
+  AM_VAL_STR = 6,
+  AM_VAL_BYTES = 7,
+  AM_VAL_COUNTER = 8,
+  AM_VAL_TIMESTAMP = 9,
+  AM_VAL_OBJ_ID = 10,
+} AMvalType;
+
+typedef enum {
+  AM_OBJ_MAP = 0,
+  AM_OBJ_LIST = 1,
+  AM_OBJ_TEXT = 2,
+  AM_OBJ_TABLE = 3,
+} AMobjType;
+
+#define AM_ROOT "_root"
+
+/* -- runtime ------------------------------------------------------------- */
+int am_init(void);
+void am_shutdown(void);
+
+/* -- results / items ------------------------------------------------------ */
+AMstatus am_result_status(const AMresult *r);
+const char *am_result_error(const AMresult *r); /* NULL when OK */
+size_t am_result_size(const AMresult *r);
+AMvalType am_item_type(const AMresult *r, size_t i);
+int64_t am_item_int(const AMresult *r, size_t i); /* INT/UINT/COUNTER/TIMESTAMP/BOOL */
+double am_item_f64(const AMresult *r, size_t i);
+const char *am_item_str(const AMresult *r, size_t i); /* STR / OBJ_ID */
+const uint8_t *am_item_bytes(const AMresult *r, size_t i, size_t *len);
+void am_result_free(AMresult *r);
+
+/* -- documents ------------------------------------------------------------ */
+AMdoc *am_create(const uint8_t *actor, size_t actor_len); /* NULL on error */
+AMdoc *am_load(const uint8_t *data, size_t len);
+AMdoc *am_fork(AMdoc *doc, const uint8_t *actor, size_t actor_len);
+void am_doc_free(AMdoc *doc);
+
+AMresult *am_save(AMdoc *doc);                       /* item: BYTES */
+AMresult *am_commit(AMdoc *doc, const char *message); /* item: BYTES hash (or empty) */
+AMresult *am_merge(AMdoc *doc, AMdoc *other);         /* items: BYTES hashes */
+AMresult *am_get_heads(AMdoc *doc);                   /* items: BYTES */
+AMresult *am_actor_id(AMdoc *doc);                    /* item: BYTES */
+
+/* -- map / list mutation --------------------------------------------------- */
+AMresult *am_map_put_null(AMdoc *doc, const char *obj, const char *key);
+AMresult *am_map_put_bool(AMdoc *doc, const char *obj, const char *key, int v);
+AMresult *am_map_put_int(AMdoc *doc, const char *obj, const char *key, int64_t v);
+AMresult *am_map_put_uint(AMdoc *doc, const char *obj, const char *key, uint64_t v);
+AMresult *am_map_put_f64(AMdoc *doc, const char *obj, const char *key, double v);
+AMresult *am_map_put_str(AMdoc *doc, const char *obj, const char *key, const char *v);
+AMresult *am_map_put_bytes(AMdoc *doc, const char *obj, const char *key,
+                           const uint8_t *v, size_t len);
+AMresult *am_map_put_counter(AMdoc *doc, const char *obj, const char *key, int64_t v);
+AMresult *am_map_put_timestamp(AMdoc *doc, const char *obj, const char *key, int64_t v);
+AMresult *am_map_put_object(AMdoc *doc, const char *obj, const char *key,
+                            AMobjType t); /* item: OBJ_ID */
+AMresult *am_map_delete(AMdoc *doc, const char *obj, const char *key);
+AMresult *am_map_increment(AMdoc *doc, const char *obj, const char *key, int64_t by);
+
+AMresult *am_list_put_int(AMdoc *doc, const char *obj, size_t index, int64_t v);
+AMresult *am_list_put_str(AMdoc *doc, const char *obj, size_t index, const char *v);
+AMresult *am_list_insert_null(AMdoc *doc, const char *obj, size_t index);
+AMresult *am_list_insert_int(AMdoc *doc, const char *obj, size_t index, int64_t v);
+AMresult *am_list_insert_str(AMdoc *doc, const char *obj, size_t index, const char *v);
+AMresult *am_list_insert_counter(AMdoc *doc, const char *obj, size_t index, int64_t v);
+AMresult *am_list_insert_object(AMdoc *doc, const char *obj, size_t index,
+                                AMobjType t); /* item: OBJ_ID */
+AMresult *am_list_delete(AMdoc *doc, const char *obj, size_t index);
+AMresult *am_list_increment(AMdoc *doc, const char *obj, size_t index, int64_t by);
+
+/* -- text ------------------------------------------------------------------ */
+AMresult *am_splice_text(AMdoc *doc, const char *obj, size_t pos, size_t del,
+                         const char *text);
+AMresult *am_text(AMdoc *doc, const char *obj); /* item: STR */
+
+/* -- reads ----------------------------------------------------------------- */
+AMresult *am_map_get(AMdoc *doc, const char *obj, const char *key);
+AMresult *am_map_get_all(AMdoc *doc, const char *obj, const char *key);
+AMresult *am_list_get(AMdoc *doc, const char *obj, size_t index);
+AMresult *am_keys(AMdoc *doc, const char *obj);   /* items: STR */
+AMresult *am_length(AMdoc *doc, const char *obj); /* item: UINT */
+
+/* -- sync ------------------------------------------------------------------ */
+AMsyncState *am_sync_state_new(void);
+void am_sync_state_free(AMsyncState *s);
+AMresult *am_generate_sync_message(AMdoc *doc, AMsyncState *s); /* BYTES or empty */
+AMresult *am_receive_sync_message(AMdoc *doc, AMsyncState *s, const uint8_t *msg,
+                                  size_t len);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* AUTOMERGE_TPU_AM_H */

@@ -1,0 +1,546 @@
+/* C ABI implementation: embeds the Python runtime and dispatches every
+ * call through automerge_tpu.capi.shim.call(fn, *args), converting the
+ * returned (tag, payload) tuples into AMresult items.
+ *
+ * The reference's C frontend wraps its Rust core the same way — a thin
+ * marshalling layer over the real document engine (reference:
+ * rust/automerge-c/src/doc.rs); here the engine is the Python/JAX
+ * framework, reached through one embedded interpreter.
+ */
+#include "am.h"
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Item {
+  AMvalType type = AM_VAL_VOID;
+  int64_t i = 0;
+  double f = 0.0;
+  std::string s;          // STR / OBJ_ID (NUL-terminated via c_str)
+  std::vector<uint8_t> b; // BYTES
+};
+
+} // namespace
+
+struct AMresult {
+  AMstatus status = AM_STATUS_OK;
+  std::string error;
+  std::vector<Item> items;
+};
+
+struct AMdoc {
+  int64_t handle;
+};
+
+struct AMsyncState {
+  int64_t handle;
+};
+
+static PyObject *g_shim = nullptr; // the shim module (owned)
+
+extern "C" int am_init(void) {
+  if (g_shim) return 0;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  const char *root = getenv("AUTOMERGE_TPU_PYROOT");
+#ifdef AM_PYROOT
+  if (!root) root = AM_PYROOT;
+#endif
+  if (root) {
+    PyObject *sys_path = PySys_GetObject("path"); // borrowed
+    PyObject *p = PyUnicode_FromString(root);
+    if (sys_path && p) PyList_Insert(sys_path, 0, p);
+    Py_XDECREF(p);
+  }
+  g_shim = PyImport_ImportModule("automerge_tpu.capi.shim");
+  if (!g_shim) {
+    PyErr_Print();
+    PyGILState_Release(gil);
+    return -1;
+  }
+  PyGILState_Release(gil);
+  return 0;
+}
+
+extern "C" void am_shutdown(void) {
+  if (!g_shim) return;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_CLEAR(g_shim);
+  PyGILState_Release(gil);
+  // the interpreter stays up: cheap, and safe for repeated init cycles
+}
+
+static std::string format_exception() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string msg = "unknown error";
+  if (value) {
+    PyObject *s = PyObject_Str(value);
+    if (s) {
+      const char *c = PyUnicode_AsUTF8(s);
+      if (c) msg = c;
+      Py_DECREF(s);
+    }
+  }
+  if (type) {
+    PyObject *n = PyObject_GetAttrString(type, "__name__");
+    if (n) {
+      const char *c = PyUnicode_AsUTF8(n);
+      if (c) msg = std::string(c) + ": " + msg;
+      Py_DECREF(n);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  return msg;
+}
+
+/* Convert shim items [(tag, payload), ...] into the result. */
+static bool convert_items(PyObject *list, AMresult *r) {
+  PyObject *seq = PySequence_Fast(list, "shim must return a sequence");
+  if (!seq) return false;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *t = PySequence_Fast_GET_ITEM(seq, i); // borrowed
+    PyObject *tag_o = PyTuple_GetItem(t, 0);
+    PyObject *val = PyTuple_GetItem(t, 1);
+    if (!tag_o || !val) {
+      Py_DECREF(seq);
+      return false;
+    }
+    Item item;
+    item.type = static_cast<AMvalType>(PyLong_AsLong(tag_o));
+    switch (item.type) {
+      case AM_VAL_F64:
+        item.f = PyFloat_AsDouble(val);
+        break;
+      case AM_VAL_STR:
+      case AM_VAL_OBJ_ID: {
+        const char *c = PyUnicode_AsUTF8(val);
+        if (!c) {
+          Py_DECREF(seq);
+          return false;
+        }
+        item.s = c;
+        break;
+      }
+      case AM_VAL_BYTES: {
+        char *buf = nullptr;
+        Py_ssize_t len = 0;
+        if (PyBytes_AsStringAndSize(val, &buf, &len) != 0) {
+          Py_DECREF(seq);
+          return false;
+        }
+        item.b.assign(buf, buf + len);
+        break;
+      }
+      case AM_VAL_NULL:
+      case AM_VAL_VOID:
+        break;
+      default: // ints, bools, counters, timestamps, handles
+        item.i = PyLong_AsLongLong(val);
+        break;
+    }
+    if (PyErr_Occurred()) {
+      Py_DECREF(seq);
+      return false;
+    }
+    r->items.push_back(std::move(item));
+  }
+  Py_DECREF(seq);
+  return true;
+}
+
+/* Call shim.call(fn, *args); args is a NEW reference to a tuple (stolen). */
+static AMresult *dispatch(const char *fn, PyObject *args) {
+  AMresult *r = new AMresult();
+  if (!g_shim) {
+    Py_XDECREF(args);
+    r->status = AM_STATUS_ERROR;
+    r->error = "am_init() has not been called";
+    return r;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject *out = nullptr;
+  if (args) {
+    PyObject *call = PyObject_GetAttrString(g_shim, "call");
+    PyObject *fn_o = PyUnicode_FromString(fn);
+    Py_ssize_t n = PyTuple_GET_SIZE(args);
+    PyObject *full = PyTuple_New(n + 1);
+    if (call && fn_o && full) {
+      PyTuple_SET_ITEM(full, 0, fn_o); // stolen
+      fn_o = nullptr;
+      for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *it = PyTuple_GET_ITEM(args, i);
+        Py_INCREF(it);
+        PyTuple_SET_ITEM(full, i + 1, it);
+      }
+      out = PyObject_CallObject(call, full);
+    }
+    Py_XDECREF(call);
+    Py_XDECREF(fn_o);
+    Py_XDECREF(full);
+    Py_DECREF(args);
+  } else {
+    r->status = AM_STATUS_ERROR;
+    r->error = "argument marshalling failed";
+  }
+  if (out) {
+    if (!convert_items(out, r)) {
+      r->status = AM_STATUS_ERROR;
+      r->error = format_exception();
+      r->items.clear();
+    }
+    Py_DECREF(out);
+  } else if (r->status == AM_STATUS_OK) {
+    r->status = AM_STATUS_ERROR;
+    r->error = format_exception();
+  }
+  PyGILState_Release(gil);
+  return r;
+}
+
+/* -- results / items -------------------------------------------------------*/
+
+extern "C" AMstatus am_result_status(const AMresult *r) { return r->status; }
+
+extern "C" const char *am_result_error(const AMresult *r) {
+  return r->status == AM_STATUS_OK ? nullptr : r->error.c_str();
+}
+
+extern "C" size_t am_result_size(const AMresult *r) { return r->items.size(); }
+
+extern "C" AMvalType am_item_type(const AMresult *r, size_t i) {
+  return i < r->items.size() ? r->items[i].type : AM_VAL_VOID;
+}
+
+extern "C" int64_t am_item_int(const AMresult *r, size_t i) {
+  return i < r->items.size() ? r->items[i].i : 0;
+}
+
+extern "C" double am_item_f64(const AMresult *r, size_t i) {
+  return i < r->items.size() ? r->items[i].f : 0.0;
+}
+
+extern "C" const char *am_item_str(const AMresult *r, size_t i) {
+  return i < r->items.size() ? r->items[i].s.c_str() : "";
+}
+
+extern "C" const uint8_t *am_item_bytes(const AMresult *r, size_t i, size_t *len) {
+  if (i >= r->items.size()) {
+    if (len) *len = 0;
+    return nullptr;
+  }
+  if (len) *len = r->items[i].b.size();
+  return r->items[i].b.data();
+}
+
+extern "C" void am_result_free(AMresult *r) { delete r; }
+
+/* -- documents -------------------------------------------------------------*/
+
+static AMdoc *handle_doc(AMresult *r) {
+  AMdoc *doc = nullptr;
+  if (r->status == AM_STATUS_OK && !r->items.empty()) {
+    doc = new AMdoc{r->items[0].i};
+  }
+  am_result_free(r);
+  return doc;
+}
+
+extern "C" AMdoc *am_create(const uint8_t *actor, size_t actor_len) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject *args = Py_BuildValue("(y#)", (const char *)actor, (Py_ssize_t)actor_len);
+  PyGILState_Release(gil);
+  return handle_doc(dispatch("create", args));
+}
+
+extern "C" AMdoc *am_load(const uint8_t *data, size_t len) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject *args = Py_BuildValue("(y#)", (const char *)data, (Py_ssize_t)len);
+  PyGILState_Release(gil);
+  return handle_doc(dispatch("load", args));
+}
+
+extern "C" AMdoc *am_fork(AMdoc *doc, const uint8_t *actor, size_t actor_len) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject *args = Py_BuildValue("(Ly#)", (long long)doc->handle,
+                                 (const char *)actor, (Py_ssize_t)actor_len);
+  PyGILState_Release(gil);
+  return handle_doc(dispatch("fork", args));
+}
+
+extern "C" void am_doc_free(AMdoc *doc) {
+  if (!doc) return;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject *args = Py_BuildValue("(L)", (long long)doc->handle);
+  PyGILState_Release(gil);
+  am_result_free(dispatch("free", args));
+  delete doc;
+}
+
+/* convenience: build args under the GIL, then dispatch */
+#define AM_ARGS(...)                                        \
+  PyObject *args;                                           \
+  {                                                         \
+    PyGILState_STATE gil = PyGILState_Ensure();             \
+    args = Py_BuildValue(__VA_ARGS__);                      \
+    PyGILState_Release(gil);                                \
+  }
+
+extern "C" AMresult *am_save(AMdoc *doc) {
+  AM_ARGS("(L)", (long long)doc->handle);
+  return dispatch("save", args);
+}
+
+extern "C" AMresult *am_commit(AMdoc *doc, const char *message) {
+  AM_ARGS("(Ls)", (long long)doc->handle, message ? message : "");
+  return dispatch("commit", args);
+}
+
+extern "C" AMresult *am_merge(AMdoc *doc, AMdoc *other) {
+  AM_ARGS("(LL)", (long long)doc->handle, (long long)other->handle);
+  return dispatch("merge", args);
+}
+
+extern "C" AMresult *am_get_heads(AMdoc *doc) {
+  AM_ARGS("(L)", (long long)doc->handle);
+  return dispatch("get_heads", args);
+}
+
+extern "C" AMresult *am_actor_id(AMdoc *doc) {
+  AM_ARGS("(L)", (long long)doc->handle);
+  return dispatch("actor_id", args);
+}
+
+/* -- map mutation ----------------------------------------------------------*/
+
+static AMresult *put_tagged(AMdoc *doc, const char *obj, const char *key,
+                            int tag, PyObject *payload /* stolen */) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject *args = payload
+      ? Py_BuildValue("(LssiN)", (long long)doc->handle, obj, key, tag, payload)
+      : nullptr;
+  PyGILState_Release(gil);
+  return dispatch("put", args);
+}
+
+extern "C" AMresult *am_map_put_null(AMdoc *d, const char *o, const char *k) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject *zero = PyLong_FromLong(0);
+  PyGILState_Release(gil);
+  return put_tagged(d, o, k, AM_VAL_NULL, zero);
+}
+
+extern "C" AMresult *am_map_put_bool(AMdoc *d, const char *o, const char *k, int v) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject *p = PyLong_FromLong(v ? 1 : 0);
+  PyGILState_Release(gil);
+  return put_tagged(d, o, k, AM_VAL_BOOL, p);
+}
+
+extern "C" AMresult *am_map_put_int(AMdoc *d, const char *o, const char *k, int64_t v) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject *p = PyLong_FromLongLong(v);
+  PyGILState_Release(gil);
+  return put_tagged(d, o, k, AM_VAL_INT, p);
+}
+
+extern "C" AMresult *am_map_put_uint(AMdoc *d, const char *o, const char *k, uint64_t v) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject *p = PyLong_FromUnsignedLongLong(v);
+  PyGILState_Release(gil);
+  return put_tagged(d, o, k, AM_VAL_UINT, p);
+}
+
+extern "C" AMresult *am_map_put_f64(AMdoc *d, const char *o, const char *k, double v) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject *p = PyFloat_FromDouble(v);
+  PyGILState_Release(gil);
+  return put_tagged(d, o, k, AM_VAL_F64, p);
+}
+
+extern "C" AMresult *am_map_put_str(AMdoc *d, const char *o, const char *k,
+                                    const char *v) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject *p = PyUnicode_FromString(v ? v : "");
+  PyGILState_Release(gil);
+  return put_tagged(d, o, k, AM_VAL_STR, p);
+}
+
+extern "C" AMresult *am_map_put_bytes(AMdoc *d, const char *o, const char *k,
+                                      const uint8_t *v, size_t len) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject *p = PyBytes_FromStringAndSize((const char *)v, (Py_ssize_t)len);
+  PyGILState_Release(gil);
+  return put_tagged(d, o, k, AM_VAL_BYTES, p);
+}
+
+extern "C" AMresult *am_map_put_counter(AMdoc *d, const char *o, const char *k,
+                                        int64_t v) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject *p = PyLong_FromLongLong(v);
+  PyGILState_Release(gil);
+  return put_tagged(d, o, k, AM_VAL_COUNTER, p);
+}
+
+extern "C" AMresult *am_map_put_timestamp(AMdoc *d, const char *o, const char *k,
+                                          int64_t v) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject *p = PyLong_FromLongLong(v);
+  PyGILState_Release(gil);
+  return put_tagged(d, o, k, AM_VAL_TIMESTAMP, p);
+}
+
+extern "C" AMresult *am_map_put_object(AMdoc *d, const char *o, const char *k,
+                                       AMobjType t) {
+  AM_ARGS("(Lssi)", (long long)d->handle, o, k, (int)t);
+  return dispatch("put_object", args);
+}
+
+extern "C" AMresult *am_map_delete(AMdoc *d, const char *o, const char *k) {
+  AM_ARGS("(Lss)", (long long)d->handle, o, k);
+  return dispatch("delete", args);
+}
+
+extern "C" AMresult *am_map_increment(AMdoc *d, const char *o, const char *k,
+                                      int64_t by) {
+  AM_ARGS("(LssL)", (long long)d->handle, o, k, (long long)by);
+  return dispatch("increment", args);
+}
+
+/* -- list mutation ---------------------------------------------------------*/
+
+extern "C" AMresult *am_list_put_int(AMdoc *d, const char *o, size_t i, int64_t v) {
+  AM_ARGS("(LsniL)", (long long)d->handle, o, (Py_ssize_t)i, AM_VAL_INT, (long long)v);
+  return dispatch("list_put", args);
+}
+
+extern "C" AMresult *am_list_put_str(AMdoc *d, const char *o, size_t i, const char *v) {
+  AM_ARGS("(Lsnis)", (long long)d->handle, o, (Py_ssize_t)i, AM_VAL_STR, v ? v : "");
+  return dispatch("list_put", args);
+}
+
+extern "C" AMresult *am_list_insert_null(AMdoc *d, const char *o, size_t i) {
+  AM_ARGS("(Lsnii)", (long long)d->handle, o, (Py_ssize_t)i, AM_VAL_NULL, 0);
+  return dispatch("insert", args);
+}
+
+extern "C" AMresult *am_list_insert_int(AMdoc *d, const char *o, size_t i, int64_t v) {
+  AM_ARGS("(LsniL)", (long long)d->handle, o, (Py_ssize_t)i, AM_VAL_INT, (long long)v);
+  return dispatch("insert", args);
+}
+
+extern "C" AMresult *am_list_insert_str(AMdoc *d, const char *o, size_t i,
+                                        const char *v) {
+  AM_ARGS("(Lsnis)", (long long)d->handle, o, (Py_ssize_t)i, AM_VAL_STR, v ? v : "");
+  return dispatch("insert", args);
+}
+
+extern "C" AMresult *am_list_insert_counter(AMdoc *d, const char *o, size_t i,
+                                            int64_t v) {
+  AM_ARGS("(LsniL)", (long long)d->handle, o, (Py_ssize_t)i, AM_VAL_COUNTER,
+          (long long)v);
+  return dispatch("insert", args);
+}
+
+extern "C" AMresult *am_list_insert_object(AMdoc *d, const char *o, size_t i,
+                                           AMobjType t) {
+  AM_ARGS("(Lsni)", (long long)d->handle, o, (Py_ssize_t)i, (int)t);
+  return dispatch("insert_object", args);
+}
+
+extern "C" AMresult *am_list_delete(AMdoc *d, const char *o, size_t i) {
+  AM_ARGS("(Lsn)", (long long)d->handle, o, (Py_ssize_t)i);
+  return dispatch("list_delete", args);
+}
+
+extern "C" AMresult *am_list_increment(AMdoc *d, const char *o, size_t i, int64_t by) {
+  AM_ARGS("(LsnL)", (long long)d->handle, o, (Py_ssize_t)i, (long long)by);
+  return dispatch("list_increment", args);
+}
+
+/* -- text ------------------------------------------------------------------*/
+
+extern "C" AMresult *am_splice_text(AMdoc *d, const char *o, size_t pos, size_t del,
+                                    const char *text) {
+  AM_ARGS("(Lsnns)", (long long)d->handle, o, (Py_ssize_t)pos, (Py_ssize_t)del,
+          text ? text : "");
+  return dispatch("splice_text", args);
+}
+
+extern "C" AMresult *am_text(AMdoc *d, const char *o) {
+  AM_ARGS("(Ls)", (long long)d->handle, o);
+  return dispatch("text", args);
+}
+
+/* -- reads -----------------------------------------------------------------*/
+
+extern "C" AMresult *am_map_get(AMdoc *d, const char *o, const char *k) {
+  AM_ARGS("(Lss)", (long long)d->handle, o, k);
+  return dispatch("get", args);
+}
+
+extern "C" AMresult *am_map_get_all(AMdoc *d, const char *o, const char *k) {
+  AM_ARGS("(Lss)", (long long)d->handle, o, k);
+  return dispatch("get_all", args);
+}
+
+extern "C" AMresult *am_list_get(AMdoc *d, const char *o, size_t i) {
+  AM_ARGS("(Lsn)", (long long)d->handle, o, (Py_ssize_t)i);
+  return dispatch("list_get", args);
+}
+
+extern "C" AMresult *am_keys(AMdoc *d, const char *o) {
+  AM_ARGS("(Ls)", (long long)d->handle, o);
+  return dispatch("keys", args);
+}
+
+extern "C" AMresult *am_length(AMdoc *d, const char *o) {
+  AM_ARGS("(Ls)", (long long)d->handle, o);
+  return dispatch("length", args);
+}
+
+/* -- sync ------------------------------------------------------------------*/
+
+extern "C" AMsyncState *am_sync_state_new(void) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject *empty = PyTuple_New(0);
+  PyGILState_Release(gil);
+  AMresult *r = dispatch("sync_state_new", empty);
+  AMsyncState *s = nullptr;
+  if (r->status == AM_STATUS_OK && !r->items.empty()) {
+    s = new AMsyncState{r->items[0].i};
+  }
+  am_result_free(r);
+  return s;
+}
+
+extern "C" void am_sync_state_free(AMsyncState *s) {
+  if (!s) return;
+  AM_ARGS("(L)", (long long)s->handle);
+  am_result_free(dispatch("sync_state_free", args));
+  delete s;
+}
+
+extern "C" AMresult *am_generate_sync_message(AMdoc *d, AMsyncState *s) {
+  AM_ARGS("(LL)", (long long)d->handle, (long long)s->handle);
+  return dispatch("generate_sync_message", args);
+}
+
+extern "C" AMresult *am_receive_sync_message(AMdoc *d, AMsyncState *s,
+                                             const uint8_t *msg, size_t len) {
+  AM_ARGS("(LLy#)", (long long)d->handle, (long long)s->handle, (const char *)msg,
+          (Py_ssize_t)len);
+  return dispatch("receive_sync_message", args);
+}

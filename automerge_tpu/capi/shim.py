@@ -1,0 +1,248 @@
+"""Python side of the C ABI frontend (see am.h / am_embed.cpp).
+
+The embedded interpreter calls ONE entry point, ``call(fn, *args)``, which
+returns a flat list of (tag, payload) item tuples — the AMitem model of
+the reference's C frontend (reference: automerge-c/src/item.rs tagged
+AMitem values, result.rs AMresult item sequences). Keeping the
+marshalling here means the C layer never touches framework objects, only
+ints/floats/str/bytes.
+
+Documents and sync states are held in registries keyed by int64 handles;
+the C ``AMdoc``/``AMsyncState`` structs wrap those handles.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from ..api import AutoDoc
+from ..sync import SyncState
+from ..types import ActorId, ObjType, ScalarValue
+
+# item tags — MUST match the AMvalType enum in am.h
+VOID = 0
+NULL = 1
+BOOL = 2
+INT = 3
+UINT = 4
+F64 = 5
+STR = 6
+BYTES = 7
+COUNTER = 8
+TIMESTAMP = 9
+OBJ_ID = 10
+HANDLE = 11
+
+_OBJTYPE = {0: ObjType.MAP, 1: ObjType.LIST, 2: ObjType.TEXT, 3: ObjType.TABLE}
+
+_docs: Dict[int, AutoDoc] = {}
+_syncs: Dict[int, SyncState] = {}
+_next_handle = 1
+
+Item = Tuple[int, object]
+
+
+def _register(table, value) -> int:
+    global _next_handle
+    h = _next_handle
+    _next_handle += 1
+    table[h] = value
+    return h
+
+
+def _doc(h: int) -> AutoDoc:
+    doc = _docs.get(h)
+    if doc is None:
+        raise ValueError(f"invalid document handle {h}")
+    return doc
+
+
+def _scalar(tag: int, payload) -> object:
+    if tag == NULL:
+        return ScalarValue("null")
+    if tag == BOOL:
+        return ScalarValue("bool", bool(payload))
+    if tag == INT:
+        return ScalarValue("int", int(payload))
+    if tag == UINT:
+        return ScalarValue("uint", int(payload))
+    if tag == F64:
+        return ScalarValue("f64", float(payload))
+    if tag == STR:
+        return ScalarValue("str", payload)
+    if tag == BYTES:
+        return ScalarValue("bytes", payload)
+    if tag == COUNTER:
+        return ScalarValue("counter", int(payload))
+    if tag == TIMESTAMP:
+        return ScalarValue("timestamp", int(payload))
+    raise ValueError(f"unsupported value tag {tag}")
+
+
+def _render_item(rendered, exid) -> List[Item]:
+    kind = rendered[0]
+    if kind == "obj":
+        return [(OBJ_ID, exid)]
+    if kind == "counter":
+        return [(COUNTER, int(rendered[1]))]
+    sv = rendered[1]
+    tag = {
+        "null": NULL, "bool": BOOL, "int": INT, "uint": UINT, "f64": F64,
+        "str": STR, "bytes": BYTES, "counter": COUNTER, "timestamp": TIMESTAMP,
+    }.get(sv.tag)
+    if tag is None:
+        return [(BYTES, bytes(sv.value[1]))]  # unknown: raw payload
+    if tag == BOOL:
+        return [(BOOL, 1 if sv.value else 0)]
+    if tag == NULL:
+        return [(NULL, 0)]
+    return [(tag, sv.value)]
+
+
+# -- entry points (dispatched by name from C) ---------------------------------
+
+
+def create(actor: bytes) -> List[Item]:
+    doc = AutoDoc(actor=ActorId(actor) if actor else None)
+    return [(HANDLE, _register(_docs, doc))]
+
+
+def load(data: bytes) -> List[Item]:
+    return [(HANDLE, _register(_docs, AutoDoc.load(data)))]
+
+
+def fork(h: int, actor: bytes) -> List[Item]:
+    doc = _doc(h).fork(actor=ActorId(actor) if actor else None)
+    return [(HANDLE, _register(_docs, doc))]
+
+
+def free(h: int) -> List[Item]:
+    _docs.pop(h, None)
+    return []
+
+
+def save(h: int) -> List[Item]:
+    return [(BYTES, _doc(h).save())]
+
+
+def commit(h: int, message) -> List[Item]:
+    hash_ = _doc(h).commit(message=message or None)
+    return [(BYTES, hash_)] if hash_ is not None else []
+
+
+def merge(h: int, other: int) -> List[Item]:
+    return [(BYTES, x) for x in _doc(h).merge(_doc(other))]
+
+
+def put(h: int, obj: str, key: str, tag: int, payload) -> List[Item]:
+    _doc(h).put(obj, key, _scalar(tag, payload))
+    return []
+
+
+def put_object(h: int, obj: str, key: str, objtype: int) -> List[Item]:
+    return [(OBJ_ID, _doc(h).put_object(obj, key, _OBJTYPE[objtype]))]
+
+
+def insert(h: int, obj: str, index: int, tag: int, payload) -> List[Item]:
+    _doc(h).insert(obj, index, _scalar(tag, payload))
+    return []
+
+
+def insert_object(h: int, obj: str, index: int, objtype: int) -> List[Item]:
+    return [(OBJ_ID, _doc(h).insert_object(obj, index, _OBJTYPE[objtype]))]
+
+
+def list_put(h: int, obj: str, index: int, tag: int, payload) -> List[Item]:
+    _doc(h).put(obj, index, _scalar(tag, payload))
+    return []
+
+
+def delete(h: int, obj: str, key: str) -> List[Item]:
+    _doc(h).delete(obj, key)
+    return []
+
+
+def list_delete(h: int, obj: str, index: int) -> List[Item]:
+    _doc(h).delete(obj, index)
+    return []
+
+
+def increment(h: int, obj: str, key: str, by: int) -> List[Item]:
+    _doc(h).increment(obj, key, by)
+    return []
+
+
+def list_increment(h: int, obj: str, index: int, by: int) -> List[Item]:
+    _doc(h).increment(obj, index, by)
+    return []
+
+
+def splice_text(h: int, obj: str, pos: int, delete_n: int, text: str) -> List[Item]:
+    _doc(h).splice_text(obj, pos, delete_n, text)
+    return []
+
+
+def text(h: int, obj: str) -> List[Item]:
+    return [(STR, _doc(h).text(obj))]
+
+
+def length(h: int, obj: str) -> List[Item]:
+    return [(UINT, _doc(h).length(obj))]
+
+
+def keys(h: int, obj: str) -> List[Item]:
+    return [(STR, k) for k in _doc(h).keys(obj)]
+
+
+def get(h: int, obj: str, key: str) -> List[Item]:
+    got = _doc(h).get(obj, key)
+    return _render_item(*got) if got is not None else []
+
+
+def list_get(h: int, obj: str, index: int) -> List[Item]:
+    got = _doc(h).get(obj, index)
+    return _render_item(*got) if got is not None else []
+
+
+def get_all(h: int, obj: str, key) -> List[Item]:
+    out: List[Item] = []
+    for rendered, exid in _doc(h).get_all(obj, key):
+        out.extend(_render_item(rendered, exid))
+    return out
+
+
+def get_heads(h: int) -> List[Item]:
+    return [(BYTES, x) for x in _doc(h).get_heads()]
+
+
+def actor_id(h: int) -> List[Item]:
+    return [(BYTES, _doc(h).get_actor().bytes)]
+
+
+def sync_state_new() -> List[Item]:
+    return [(HANDLE, _register(_syncs, SyncState()))]
+
+
+def sync_state_free(h: int) -> List[Item]:
+    _syncs.pop(h, None)
+    return []
+
+
+def generate_sync_message(h: int, sh: int) -> List[Item]:
+    msg = _doc(h).generate_sync_message(_syncs[sh])
+    return [(BYTES, msg.encode())] if msg is not None else []
+
+
+def receive_sync_message(h: int, sh: int, data: bytes) -> List[Item]:
+    from ..sync.protocol import Message
+
+    _doc(h).receive_sync_message(_syncs[sh], Message.decode(data))
+    return []
+
+
+def call(fn: str, *args) -> List[Item]:
+    """The single dispatch point the C layer uses."""
+    impl = globals().get(fn)
+    if impl is None or fn.startswith("_"):
+        raise ValueError(f"unknown C API function {fn!r}")
+    return impl(*args)

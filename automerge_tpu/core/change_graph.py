@@ -14,7 +14,10 @@ from typing import Dict, Iterable, List, Set
 from .clock import Clock, ClockData
 
 
-class ChangeGraphError(ValueError):
+from ..errors import AutomergeError
+
+
+class ChangeGraphError(AutomergeError):
     pass
 
 

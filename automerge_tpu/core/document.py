@@ -96,6 +96,9 @@ class Document:
         self.deps: Set[bytes] = set()
         self.change_graph = ChangeGraph()
         self.max_op = 0
+        # live manual transactions (registered by Transaction); a device
+        # merge or save while one is open would silently miss its ops
+        self.open_transactions = set()
 
     # -- identity ----------------------------------------------------------
 

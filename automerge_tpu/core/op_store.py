@@ -29,7 +29,10 @@ LIST_ENC = 0
 TEXT_ENC = 1
 
 
-class OpStoreError(ValueError):
+from ..errors import AutomergeError
+
+
+class OpStoreError(AutomergeError):
     pass
 
 

@@ -61,6 +61,7 @@ class Transaction:
             scope.isolate(self.actor_idx)
         self.operations: List[Tuple[OpId, Op]] = []
         self._done = False
+        doc.open_transactions.add(self)
 
     # -- helpers -----------------------------------------------------------
 
@@ -506,6 +507,7 @@ class Transaction:
         """Encode the pending ops as a change and append it to history."""
         self._check_open()
         self._done = True
+        self.doc.open_transactions.discard(self)
         if not self.operations and self.message is None:
             return None
         change = self._export_change()
@@ -518,6 +520,7 @@ class Transaction:
     def rollback(self) -> int:
         self._check_open()
         self._done = True
+        self.doc.open_transactions.discard(self)
         n = len(self.operations)
         for obj_id, op in reversed(self.operations):
             self.doc.ops.remove_op(obj_id, op)

@@ -72,33 +72,29 @@ class InvalidIndex(AutomergeError):
     (reference: error.rs InvalidIndex)."""
 
 
-def _reexports():
-    from .core.change_graph import ChangeGraphError
-    from .core.op_store import OpStoreError
-    from .ops.extract import ExtractError
-    from .storage.chunk import ChunkParseError
-    from .storage.columns import ColumnLayoutError
-    from .sync.protocol import SyncError
-    from .utils.leb128 import LEBDecodeError
-
-    return {
-        "ChangeGraphError": ChangeGraphError,
-        "ChunkParseError": ChunkParseError,
-        "ColumnLayoutError": ColumnLayoutError,
-        "ExtractError": ExtractError,
-        "LEBDecodeError": LEBDecodeError,
-        "OpStoreError": OpStoreError,
-        "SyncError": SyncError,
-    }
+# parse-layer errors are defined with their codecs and resolved lazily so
+# importing this module never pulls the whole package; the static name map
+# keeps __getattr__ inert for every other lookup (dunder probes during
+# import would otherwise recurse into half-initialized modules)
+_LAZY = {
+    "ChangeGraphError": ".core.change_graph",
+    "ChunkParseError": ".storage.chunk",
+    "ColumnLayoutError": ".storage.columns",
+    "ExtractError": ".ops.extract",
+    "LEBDecodeError": ".utils.leb128",
+    "OpStoreError": ".core.op_store",
+    "SyncError": ".sync.protocol",
+}
 
 
 def __getattr__(name):
-    # parse-layer errors are defined with their codecs; resolve lazily so
-    # importing this module never pulls the whole package
-    table = _reexports()
-    if name in table:
-        return table[name]
-    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    mod_name = _LAZY.get(name)
+    if mod_name is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    mod = importlib.import_module(mod_name, __package__)
+    return getattr(mod, name)
 
 
 __all__ = [

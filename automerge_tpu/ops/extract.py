@@ -44,7 +44,10 @@ _CODE_ULEB = 3
 _INT_CODES = (3, 4, 8, 9)  # uint, int, counter, timestamp
 
 
-class ExtractError(ValueError):
+from ..errors import AutomergeError
+
+
+class ExtractError(AutomergeError):
     pass
 
 

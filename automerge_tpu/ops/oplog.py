@@ -415,6 +415,11 @@ class OpLog:
             if commit is not None:
                 commit()
             doc = getattr(d, "doc", d)  # AutoDoc or Document
+            if getattr(doc, "open_transactions", None):
+                raise ValueError(
+                    "document has an open manual transaction; commit or "
+                    "roll it back before building a device log"
+                )
             changes.extend(a.stored for a in doc.history)
         return cls.from_changes(changes)
 

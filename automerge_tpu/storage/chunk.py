@@ -30,7 +30,10 @@ CHUNK_COMPRESSED = 2
 DEFLATE_MIN_SIZE = 256  # reference: storage/change.rs DEFLATE_MIN_SIZE
 
 
-class ChunkParseError(ValueError):
+from ..errors import AutomergeError
+
+
+class ChunkParseError(AutomergeError):
     pass
 
 

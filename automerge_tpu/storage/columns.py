@@ -50,7 +50,10 @@ def normalize(s: int) -> int:
     return s & ~DEFLATE_BIT
 
 
-class ColumnLayoutError(ValueError):
+from ..errors import AutomergeError
+
+
+class ColumnLayoutError(AutomergeError):
     pass
 
 

@@ -25,7 +25,10 @@ SYNC_STATE_TYPE = 0x43
 HASH_SIZE = 32
 
 
-class SyncError(ValueError):
+from ..errors import AutomergeError
+
+
+class SyncError(AutomergeError):
     pass
 
 

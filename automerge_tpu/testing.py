@@ -45,7 +45,7 @@ __all__ = [
 
 def new_doc(seed: int = None) -> AutoDoc:
     """A fresh AutoDoc with a random (or seeded) actor id."""
-    raw = os.urandom(16) if seed is None else bytes([seed]) * 16
+    raw = os.urandom(16) if seed is None else (seed % (1 << 128)).to_bytes(16, "little")
     return AutoDoc(actor=ActorId(raw))
 
 

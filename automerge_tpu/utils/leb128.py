@@ -9,7 +9,10 @@ signed values use SLEB128 (two's complement, sign-extended).
 from __future__ import annotations
 
 
-class LEBDecodeError(ValueError):
+from ..errors import AutomergeError
+
+
+class LEBDecodeError(AutomergeError):
     pass
 
 
