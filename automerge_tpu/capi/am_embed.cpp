@@ -46,8 +46,10 @@ static PyObject *g_shim = nullptr; // the shim module (owned)
 
 extern "C" int am_init(void) {
   if (g_shim) return 0;
+  bool we_initialized = false;
   if (!Py_IsInitialized()) {
     Py_InitializeEx(0);
+    we_initialized = true;
   }
   PyGILState_STATE gil = PyGILState_Ensure();
   const char *root = getenv("AUTOMERGE_TPU_PYROOT");
@@ -67,6 +69,11 @@ extern "C" int am_init(void) {
     return -1;
   }
   PyGILState_Release(gil);
+  if (we_initialized) {
+    // Py_InitializeEx leaves this thread holding the GIL; release it so
+    // other threads' PyGILState_Ensure calls can ever succeed
+    PyEval_SaveThread();
+  }
   return 0;
 }
 
@@ -193,7 +200,9 @@ static AMresult *dispatch(const char *fn, PyObject *args) {
     Py_DECREF(args);
   } else {
     r->status = AM_STATUS_ERROR;
-    r->error = "argument marshalling failed";
+    r->error = g_shim ? "argument marshalling failed"
+                      : "am_init() has not been called";
+    if (g_shim && PyErr_Occurred()) PyErr_Clear();
   }
   if (out) {
     if (!convert_items(out, r)) {
@@ -259,6 +268,7 @@ static AMdoc *handle_doc(AMresult *r) {
 }
 
 extern "C" AMdoc *am_create(const uint8_t *actor, size_t actor_len) {
+  if (!g_shim) return nullptr;
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject *args = Py_BuildValue("(y#)", (const char *)actor, (Py_ssize_t)actor_len);
   PyGILState_Release(gil);
@@ -266,6 +276,7 @@ extern "C" AMdoc *am_create(const uint8_t *actor, size_t actor_len) {
 }
 
 extern "C" AMdoc *am_load(const uint8_t *data, size_t len) {
+  if (!g_shim) return nullptr;
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject *args = Py_BuildValue("(y#)", (const char *)data, (Py_ssize_t)len);
   PyGILState_Release(gil);
@@ -273,6 +284,7 @@ extern "C" AMdoc *am_load(const uint8_t *data, size_t len) {
 }
 
 extern "C" AMdoc *am_fork(AMdoc *doc, const uint8_t *actor, size_t actor_len) {
+  if (!g_shim) return nullptr;
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject *args = Py_BuildValue("(Ly#)", (long long)doc->handle,
                                  (const char *)actor, (Py_ssize_t)actor_len);
@@ -282,6 +294,7 @@ extern "C" AMdoc *am_fork(AMdoc *doc, const uint8_t *actor, size_t actor_len) {
 
 extern "C" void am_doc_free(AMdoc *doc) {
   if (!doc) return;
+  if (!g_shim) { delete doc; return; }
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject *args = Py_BuildValue("(L)", (long long)doc->handle);
   PyGILState_Release(gil);
@@ -290,9 +303,12 @@ extern "C" void am_doc_free(AMdoc *doc) {
 }
 
 /* convenience: build args under the GIL, then dispatch */
+/* build args under the GIL — but only once am_init has run; calling
+ * PyGILState_Ensure on an uninitialized interpreter aborts the process,
+ * so an un-initialized library must flow through dispatch's error path */
 #define AM_ARGS(...)                                        \
-  PyObject *args;                                           \
-  {                                                         \
+  PyObject *args = nullptr;                                 \
+  if (g_shim) {                                             \
     PyGILState_STATE gil = PyGILState_Ensure();             \
     args = Py_BuildValue(__VA_ARGS__);                      \
     PyGILState_Release(gil);                                \
@@ -327,6 +343,7 @@ extern "C" AMresult *am_actor_id(AMdoc *doc) {
 
 static AMresult *put_tagged(AMdoc *doc, const char *obj, const char *key,
                             int tag, PyObject *payload /* stolen */) {
+  if (!g_shim) return dispatch("put", nullptr);
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject *args = payload
       ? Py_BuildValue("(LssiN)", (long long)doc->handle, obj, key, tag, payload)
@@ -336,6 +353,7 @@ static AMresult *put_tagged(AMdoc *doc, const char *obj, const char *key,
 }
 
 extern "C" AMresult *am_map_put_null(AMdoc *d, const char *o, const char *k) {
+  if (!g_shim) return dispatch("put", nullptr);
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject *zero = PyLong_FromLong(0);
   PyGILState_Release(gil);
@@ -343,6 +361,7 @@ extern "C" AMresult *am_map_put_null(AMdoc *d, const char *o, const char *k) {
 }
 
 extern "C" AMresult *am_map_put_bool(AMdoc *d, const char *o, const char *k, int v) {
+  if (!g_shim) return dispatch("put", nullptr);
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject *p = PyLong_FromLong(v ? 1 : 0);
   PyGILState_Release(gil);
@@ -350,6 +369,7 @@ extern "C" AMresult *am_map_put_bool(AMdoc *d, const char *o, const char *k, int
 }
 
 extern "C" AMresult *am_map_put_int(AMdoc *d, const char *o, const char *k, int64_t v) {
+  if (!g_shim) return dispatch("put", nullptr);
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject *p = PyLong_FromLongLong(v);
   PyGILState_Release(gil);
@@ -357,6 +377,7 @@ extern "C" AMresult *am_map_put_int(AMdoc *d, const char *o, const char *k, int6
 }
 
 extern "C" AMresult *am_map_put_uint(AMdoc *d, const char *o, const char *k, uint64_t v) {
+  if (!g_shim) return dispatch("put", nullptr);
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject *p = PyLong_FromUnsignedLongLong(v);
   PyGILState_Release(gil);
@@ -364,6 +385,7 @@ extern "C" AMresult *am_map_put_uint(AMdoc *d, const char *o, const char *k, uin
 }
 
 extern "C" AMresult *am_map_put_f64(AMdoc *d, const char *o, const char *k, double v) {
+  if (!g_shim) return dispatch("put", nullptr);
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject *p = PyFloat_FromDouble(v);
   PyGILState_Release(gil);
@@ -372,6 +394,7 @@ extern "C" AMresult *am_map_put_f64(AMdoc *d, const char *o, const char *k, doub
 
 extern "C" AMresult *am_map_put_str(AMdoc *d, const char *o, const char *k,
                                     const char *v) {
+  if (!g_shim) return dispatch("put", nullptr);
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject *p = PyUnicode_FromString(v ? v : "");
   PyGILState_Release(gil);
@@ -380,6 +403,7 @@ extern "C" AMresult *am_map_put_str(AMdoc *d, const char *o, const char *k,
 
 extern "C" AMresult *am_map_put_bytes(AMdoc *d, const char *o, const char *k,
                                       const uint8_t *v, size_t len) {
+  if (!g_shim) return dispatch("put", nullptr);
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject *p = PyBytes_FromStringAndSize((const char *)v, (Py_ssize_t)len);
   PyGILState_Release(gil);
@@ -388,6 +412,7 @@ extern "C" AMresult *am_map_put_bytes(AMdoc *d, const char *o, const char *k,
 
 extern "C" AMresult *am_map_put_counter(AMdoc *d, const char *o, const char *k,
                                         int64_t v) {
+  if (!g_shim) return dispatch("put", nullptr);
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject *p = PyLong_FromLongLong(v);
   PyGILState_Release(gil);
@@ -396,6 +421,7 @@ extern "C" AMresult *am_map_put_counter(AMdoc *d, const char *o, const char *k,
 
 extern "C" AMresult *am_map_put_timestamp(AMdoc *d, const char *o, const char *k,
                                           int64_t v) {
+  if (!g_shim) return dispatch("put", nullptr);
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject *p = PyLong_FromLongLong(v);
   PyGILState_Release(gil);
@@ -514,6 +540,7 @@ extern "C" AMresult *am_length(AMdoc *d, const char *o) {
 /* -- sync ------------------------------------------------------------------*/
 
 extern "C" AMsyncState *am_sync_state_new(void) {
+  if (!g_shim) return nullptr;
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject *empty = PyTuple_New(0);
   PyGILState_Release(gil);
@@ -528,6 +555,7 @@ extern "C" AMsyncState *am_sync_state_new(void) {
 
 extern "C" void am_sync_state_free(AMsyncState *s) {
   if (!s) return;
+  if (!g_shim) { delete s; return; }
   AM_ARGS("(L)", (long long)s->handle);
   am_result_free(dispatch("sync_state_free", args));
   delete s;

@@ -210,21 +210,11 @@ def rebuild_op_store(doc) -> None:
                 raise ValueError("seq update targets missing element")
             el.updates.append(op)
 
-    # ---- visibility counters ---------------------------------------------
+    # ---- visibility counters + block index (one sweep) ---------------------
     for info in store.objects.values():
         data = info.data
         if isinstance(data, SeqObject):
-            vis = 0
-            width = 0
-            el = data.head.next
-            while el is not None:
-                w = el.winner()
-                if w is not None:
-                    vis += 1
-                    width += w.text_width()
-                el = el.next
-            data.visible_len = vis
-            data.text_width = width
+            data.rebuild_blocks()
 
     doc.ops = store
 

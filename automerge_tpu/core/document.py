@@ -97,8 +97,12 @@ class Document:
         self.change_graph = ChangeGraph()
         self.max_op = 0
         # live manual transactions (registered by Transaction); a device
-        # merge or save while one is open would silently miss its ops
-        self.open_transactions = set()
+        # merge or save while one is open would silently miss its ops.
+        # Weak refs: an abandoned (unreachable, never committed) transaction
+        # must not block the document forever.
+        import weakref
+
+        self.open_transactions = weakref.WeakSet()
 
     # -- identity ----------------------------------------------------------
 
@@ -585,11 +589,17 @@ class Document:
         clock = self._resolve_clock(heads, clock)
         enc = TEXT_ENC if info.data.obj_type == ObjType.TEXT else LIST_ENC
         target = self.import_id(cursor)
+        el = info.data.by_id.get(target)
+        if el is None:
+            raise AutomergeError(f"cursor {cursor!r} not found in {obj!r}")
+        if clock is None:
+            # O(blocks + block size) via the order-statistics index
+            return self.ops.position_of(obj_id, el, enc)
         index = 0
-        for el in info.data.elements():
-            if el.elem_id == target:
+        for e in info.data.elements():
+            if e is el:
                 return index
-            w = el.winner(clock)
+            w = e.winner(clock)
             if w is not None:
                 index += w.text_width() if enc == TEXT_ENC else 1
         raise AutomergeError(f"cursor {cursor!r} not found in {obj!r}")

@@ -128,13 +128,14 @@ class Op:
 class Element:
     """A sequence element: its defining insert op plus overwriting ops."""
 
-    __slots__ = ("op", "updates", "prev", "next")
+    __slots__ = ("op", "updates", "prev", "next", "block")
 
     def __init__(self, op: Optional[Op]):
         self.op = op  # None only for the head sentinel
         self.updates: List[Op] = []
         self.prev: Optional["Element"] = None
         self.next: Optional["Element"] = None
+        self.block: Optional["Block"] = None
 
     @property
     def elem_id(self) -> OpId:
@@ -154,12 +155,36 @@ class Element:
         return vis[-1] if vis else None
 
 
+class Block:
+    """A run of consecutive elements with visibility aggregates.
+
+    The order-statistics index over the element list: blocks carry
+    (visible count, visible text width) so index resolution skips whole
+    blocks instead of walking elements — the role the reference's B-tree
+    node ``Index`` plays (reference: op_tree/node.rs:88-144,
+    query/list_state.rs:76-120), in flat-block form.
+    """
+
+    __slots__ = ("els", "vis", "width")
+
+    def __init__(self):
+        self.els: List[Element] = []
+        self.vis = 0
+        self.width = 0
+
+
+# block split threshold: nth costs O(#blocks + BLOCK_MAX); with ~n/128
+# blocks both terms stay small through million-element sequences
+BLOCK_MAX = 256
+
+
 class SeqObject:
     __slots__ = (
         "obj_type",
         "head",
         "tail",
         "by_id",
+        "blocks",
         "visible_len",
         "text_width",
         "_cursor",  # (Element, list_index, text_index) of a visible element
@@ -170,12 +195,86 @@ class SeqObject:
         self.head = Element(None)
         self.tail = self.head
         self.by_id: Dict[OpId, Element] = {}
+        self.blocks: List[Block] = []
         self.visible_len = 0
         self.text_width = 0
         self._cursor = None
 
     def invalidate_cursor(self) -> None:
         self._cursor = None
+
+    # -- block index maintenance ------------------------------------------
+
+    def block_insert_after(self, prev: Element, el: Element) -> None:
+        """Register ``el`` (just linked after ``prev``) in the block index."""
+        if prev.op is None:  # head sentinel -> front of the first block
+            if not self.blocks:
+                self.blocks.append(Block())
+            b = self.blocks[0]
+            b.els.insert(0, el)
+        else:
+            b = prev.block
+            b.els.insert(b.els.index(prev) + 1, el)
+        el.block = b
+        w = el.winner()
+        if w is not None:
+            b.vis += 1
+            b.width += w.text_width()
+        if len(b.els) > BLOCK_MAX:
+            self._split_block(b)
+
+    def _split_block(self, b: Block) -> None:
+        half = len(b.els) // 2
+        nb = Block()
+        nb.els = b.els[half:]
+        b.els = b.els[:half]
+        for el in nb.els:
+            el.block = nb
+            w = el.winner()
+            if w is not None:
+                nb.vis += 1
+                nb.width += w.text_width()
+        b.vis -= nb.vis
+        b.width -= nb.width
+        self.blocks.insert(self.blocks.index(b) + 1, nb)
+
+    def block_remove(self, el: Element) -> None:
+        b = el.block
+        if b is None:
+            return
+        w = el.winner()
+        if w is not None:
+            b.vis -= 1
+            b.width -= w.text_width()
+        b.els.remove(el)
+        el.block = None
+        if not b.els:
+            self.blocks.remove(b)
+
+    def block_vis_delta(self, el: Element, dvis: int, dwidth: int) -> None:
+        b = el.block
+        if b is not None and (dvis or dwidth):
+            b.vis += dvis
+            b.width += dwidth
+
+    def rebuild_blocks(self) -> None:
+        """Partition the element list into fresh blocks (bulk load path)."""
+        self.blocks = []
+        b = None
+        el = self.head.next
+        while el is not None:
+            if b is None or len(b.els) >= BLOCK_MAX:
+                b = Block()
+                self.blocks.append(b)
+            b.els.append(el)
+            el.block = b
+            w = el.winner()
+            if w is not None:
+                b.vis += 1
+                b.width += w.text_width()
+            el = el.next
+        self.visible_len = sum(x.vis for x in self.blocks)
+        self.text_width = sum(x.width for x in self.blocks)
 
     def seed_cursor(self, el, at: int, encoding: int) -> None:
         """Re-seed the position cursor after local edits (the analogue of
@@ -345,6 +444,7 @@ class OpStore:
         else:
             obj.tail = el
         obj.by_id[op.id] = el
+        obj.block_insert_after(prev, el)
         if op.visible():
             obj.visible_len += 1
             obj.text_width += op.text_width()
@@ -370,6 +470,7 @@ class OpStore:
         after_vis, after_w = self._elem_visibility(el)
         obj.visible_len += after_vis - before_vis
         obj.text_width += after_w - before_w
+        obj.block_vis_delta(el, after_vis - before_vis, after_w - before_w)
 
     @staticmethod
     def _elem_visibility(el: Element) -> Tuple[int, int]:
@@ -399,6 +500,7 @@ class OpStore:
             if op.insert:
                 el = obj.by_id.pop(op.id, None)
                 if el is not None:
+                    obj.block_remove(el)
                     if el.op.visible():
                         obj.visible_len -= 1
                         obj.text_width -= el.op.text_width()
@@ -418,6 +520,7 @@ class OpStore:
                     after_vis, after_w = self._elem_visibility(el)
                     obj.visible_len += after_vis - before_vis
                     obj.text_width += after_w - before_w
+                    obj.block_vis_delta(el, after_vis - before_vis, after_w - before_w)
 
     # -- reads -------------------------------------------------------------
 
@@ -457,7 +560,9 @@ class OpStore:
         if cur is not None and encoding == cur[3]:
             el, li, ti = cur[0], cur[1], cur[2]
             at = li if encoding == LIST_ENC else ti
-            if el.winner() is not None:
+            # local walks beat the block scan only for short jumps (the
+            # sequential-editing pattern); long jumps go through the index
+            if abs(index - at) <= BLOCK_MAX and el.winner() is not None:
                 if at <= index:
                     found = self._walk_forward(obj, el, at, index, encoding)
                 else:
@@ -498,6 +603,8 @@ class OpStore:
 
     def _nth_scan(self, obj, index, encoding, clock):
         """(element, span start) of the visible element covering ``index``."""
+        if clock is None:
+            return self._nth_blocks(obj, index, encoding)
         at = 0
         for el in obj.elements():
             w = el.winner(clock)
@@ -505,11 +612,56 @@ class OpStore:
                 continue
             width = 1 if encoding == LIST_ENC else w.text_width()
             if at <= index < at + width:
-                if clock is None:
-                    self._set_cursor(obj, el, at, encoding)
                 return el, at
             at += width
         return None, -1
+
+    def _nth_blocks(self, obj, index, encoding):
+        """Current-state nth via the block index: skip whole blocks by
+        their visibility aggregates, walk only the target block
+        (vectorized Nth/ListState node skipping, query/list_state.rs)."""
+        if index < 0:
+            return None, -1
+        at = 0
+        for b in obj.blocks:
+            span = b.vis if encoding == LIST_ENC else b.width
+            if index < at + span:
+                for el in b.els:
+                    w = el.winner()
+                    if w is None:
+                        continue
+                    width = 1 if encoding == LIST_ENC else w.text_width()
+                    if at <= index < at + width:
+                        self._set_cursor(obj, el, at, encoding)
+                        return el, at
+                    at += width
+                return None, -1  # unreachable if aggregates are consistent
+            at += span
+        return None, -1
+
+    def position_of(self, obj_id: OpId, el: Element, encoding: int = LIST_ENC) -> int:
+        """Span-start position of ``el`` in current state: the sum of
+        visible widths before it — O(#blocks + block size) via the index
+        (reference: seek_opid / SeekOpId resolving a cursor to an index,
+        automerge.rs:1484-1518)."""
+        obj = self.get_obj(obj_id).data
+        if not isinstance(obj, SeqObject):
+            raise OpStoreError("position_of on map object")
+        b = el.block
+        if b is None:
+            raise OpStoreError("element not indexed")
+        at = 0
+        for blk in obj.blocks:
+            if blk is b:
+                break
+            at += blk.vis if encoding == LIST_ENC else blk.width
+        for e in b.els:
+            if e is el:
+                return at
+            w = e.winner()
+            if w is not None:
+                at += 1 if encoding == LIST_ENC else w.text_width()
+        raise OpStoreError("element missing from its block")
 
     def _set_cursor(self, obj, el, at, encoding):
         if encoding == LIST_ENC:

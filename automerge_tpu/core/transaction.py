@@ -63,6 +63,16 @@ class Transaction:
         self._done = False
         doc.open_transactions.add(self)
 
+    def __del__(self):
+        # an abandoned transaction rolls back, like the reference's
+        # `impl Drop for Transaction` (manual_transaction.rs): its ops were
+        # applied to the op store eagerly and must not outlive it
+        if not getattr(self, "_done", True):
+            try:
+                self.rollback()
+            except Exception:
+                pass
+
     # -- helpers -----------------------------------------------------------
 
     def _next_id(self) -> OpId:

@@ -400,3 +400,81 @@ class TestMidElementSplice:
         # replacement lands at the rewound position
         doc.splice_text(t, 1, 4, "Z")
         assert doc.text(t) == "Z"
+
+
+class TestBlockIndex:
+    """The order-statistics block index (op_store.Block) must agree with a
+    linear walk after any interleaving of inserts/updates/deletes/merges."""
+
+    def _assert_consistent(self, doc, obj):
+        from automerge_tpu.core.op_store import LIST_ENC, TEXT_ENC
+
+        info = doc.doc.ops.get_obj(doc.doc.import_obj(obj))
+        data = info.data
+        # block partition == element list, aggregates == recount
+        walked = []
+        vis = width = 0
+        for b in data.blocks:
+            bvis = bwidth = 0
+            for el in b.els:
+                walked.append(el)
+                assert el.block is b
+                w = el.winner()
+                if w is not None:
+                    bvis += 1
+                    bwidth += w.text_width()
+            assert (b.vis, b.width) == (bvis, bwidth), "stale block aggregates"
+            vis += bvis
+            width += bwidth
+        linear = list(data.elements())
+        assert walked == linear, "block order diverged from element list"
+        assert vis == data.visible_len and width == data.text_width
+        # nth through the index == nth by scan, every position
+        enc = TEXT_ENC if data.obj_type.name == "TEXT" else LIST_ENC
+        at = 0
+        for el in linear:
+            w = el.winner()
+            if w is None:
+                continue
+            ww = w.text_width() if enc == TEXT_ENC else 1
+            for i in range(at, at + ww):
+                got = doc.doc.ops.nth(doc.doc.import_obj(obj), i, enc)
+                assert got is el, f"nth({i}) mismatch"
+            assert doc.doc.ops.position_of(doc.doc.import_obj(obj), el, enc) == at
+            at += ww
+
+    def test_randomized_block_consistency(self):
+        import random
+
+        rng = random.Random(7)
+        doc = AutoDoc(actor=ActorId(bytes([1]) * 16))
+        t = doc.put_object("_root", "t", ObjType.TEXT)
+        for step in range(300):
+            n = doc.length(t)
+            r = rng.random()
+            if r < 0.55 or n == 0:
+                doc.splice_text(t, rng.randint(0, n), 0, rng.choice("abcdef") * rng.randint(1, 3))
+            elif r < 0.85:
+                pos = rng.randint(0, n - 1)
+                doc.splice_text(t, pos, min(rng.randint(1, 3), n - pos), "")
+            else:
+                doc.commit()
+                f = doc.fork(actor=ActorId(bytes([rng.randint(2, 250)]) * 16))
+                m = doc.length(t)
+                f.splice_text(t, rng.randint(0, m), 0, "XY")
+                f.commit()
+                doc.merge(f)
+            if step % 50 == 49:
+                self._assert_consistent(doc, t)
+        self._assert_consistent(doc, t)
+
+    def test_rollback_restores_block_index(self):
+        doc = AutoDoc(actor=ActorId(bytes([1]) * 16))
+        t = doc.put_object("_root", "t", ObjType.TEXT)
+        doc.splice_text(t, 0, 0, "hello world")
+        doc.commit()
+        tx = doc.transaction()
+        tx.splice_text(t, 0, 3, "XX")
+        tx.rollback()
+        assert doc.text(t) == "hello world"
+        self._assert_consistent(doc, t)
