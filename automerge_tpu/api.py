@@ -310,9 +310,13 @@ class AutoDoc:
 
     # -- save / load -------------------------------------------------------
 
-    def save(self, deflate: bool = True) -> bytes:
+    def save(self, deflate: bool = True, retain_orphans: bool = True) -> bytes:
         self.commit()
-        return self.doc.save(deflate)
+        return self.doc.save(deflate, retain_orphans=retain_orphans)
+
+    def save_and_verify(self, deflate: bool = True) -> bytes:
+        self.commit()
+        return self.doc.save_and_verify(deflate)
 
     def save_incremental_after(self, heads: List[bytes]) -> bytes:
         self.commit()
@@ -325,8 +329,14 @@ class AutoDoc:
         actor: Optional[ActorId] = None,
         verify: bool = True,
         on_partial: str = "error",
+        string_migration: str = "none",
     ) -> "AutoDoc":
-        return cls(document=Document.load(data, actor, verify, on_partial=on_partial))
+        return cls(
+            document=Document.load(
+                data, actor, verify,
+                on_partial=on_partial, string_migration=string_migration,
+            )
+        )
 
     def load_incremental(
         self, data: bytes, verify: bool = True, on_partial: str = "ignore"

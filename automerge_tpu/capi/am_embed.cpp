@@ -66,6 +66,7 @@ extern "C" int am_init(void) {
   if (!g_shim) {
     PyErr_Print();
     PyGILState_Release(gil);
+    if (we_initialized) PyEval_SaveThread(); // never exit still holding the GIL
     return -1;
   }
   PyGILState_Release(gil);

@@ -158,6 +158,13 @@ class Document:
 
     def apply_changes(self, changes: Iterable[StoredChange]) -> None:
         changes = list(changes)
+        from .. import trace
+
+        if trace.enabled():
+            trace.event(
+                "apply_changes", changes=len(changes),
+                ops=sum(len(c.ops) for c in changes),
+            )
         if self._bulk_eligible(changes):
             try:
                 self._apply_changes_bulk(changes)
@@ -624,6 +631,97 @@ class Document:
 
     # -- materialization ---------------------------------------------------
 
+    def dump(self, file=None) -> None:
+        """Print the full op table — id/ins/obj/key/value/pred/succ per op,
+        in document order (reference: automerge.rs:1190-1239 dump())."""
+        import sys
+
+        out = file or sys.stdout
+
+        def short(opid: OpId) -> str:
+            if opid[0] == 0:
+                return "_root"
+            return f"{opid[0]}@{self.actors.get(opid[1]).to_hex()[:4]}"
+
+        def render(op: Op) -> str:
+            if is_make_action(op.action):
+                return f"make({objtype_for_action(op.action).name.lower()})"
+            if op.is_inc:
+                return f"inc({op.value.value})"
+            if op.is_delete:
+                return "del"
+            if op.is_mark:
+                name = op.mark_name if op.mark_name is not None else "/"
+                return f"mark({name},{op.value.to_py()!r})"
+            return f"{op.value.tag}:{op.value.to_py()!r}"
+
+        print(
+            f"  {'id':12} {'ins':3} {'obj':12} {'key':12} "
+            f"{'value':16} {'pred':16} {'succ':16}",
+            file=out,
+        )
+        for obj_id in sorted(
+            self.ops.objects, key=lambda o: (o[0], o[1] if o[0] else -1)
+        ):
+            info = self.ops.get_obj(obj_id)
+            rows = []
+            if isinstance(info.data, MapObject):
+                for key_idx in sorted(
+                    info.data.props, key=lambda k: self.props.get(k)
+                ):
+                    for op in info.data.props[key_idx]:
+                        rows.append((self.props.get(key_idx), op))
+            else:
+                for el, op in info.data.ops_in_order():
+                    rows.append((short(el.elem_id), op))
+            for key, op in rows:
+                pred = ",".join(short(p) for p in op.pred)
+                succ = ",".join(short(s) for s in op.succ)
+                ins = "t" if op.insert else "f"
+                print(
+                    f"  {short(op.id):12} {ins:3} {short(obj_id):12} "
+                    f"{key:12} {render(op):16} {pred:16} {succ:16}",
+                    file=out,
+                )
+
+    def convert_scalar_strings_to_text(self) -> None:
+        """Replace every visible string scalar in a map or list with a TEXT
+        object holding the same content — the reference's StringMigration::
+        ConvertToText load option (automerge.rs:1567-1610).
+
+        Parity quirk preserved: a key holding CONFLICTING strings converts
+        each visible value in turn, so the last conversion wins and the
+        conflict collapses — exactly what the reference's per-op
+        ``tx.put_object`` loop does (automerge.rs:1603-1609)."""
+        to_convert = []
+        for obj_id, info in list(self.ops.objects.items()):
+            data = info.data
+            if isinstance(data, MapObject):
+                if data.obj_type not in (ObjType.MAP, ObjType.TABLE):
+                    continue
+                for key_idx, run in data.props.items():
+                    for op in run:
+                        if op.visible() and op.action == Action.PUT and op.value.tag == "str":
+                            to_convert.append(
+                                (self.export_id(obj_id), self.props.get(key_idx), op.value.value)
+                            )
+            elif data.obj_type == ObjType.LIST:
+                index = 0
+                for el in data.elements():
+                    w = el.winner()
+                    if w is None:
+                        continue
+                    if w.action == Action.PUT and w.value.tag == "str":
+                        to_convert.append((self.export_id(obj_id), index, w.value.value))
+                    index += 1
+        if not to_convert:
+            return
+        tx = self.transaction()
+        for obj, prop, text in to_convert:
+            text_id = tx.put_object(obj, prop, ObjType.TEXT)
+            tx.splice_text(text_id, 0, 0, text)
+        tx.commit()
+
     def hydrate(self, obj: str = ROOT, heads=None, clock=None):
         """Materialize an object tree into plain Python values."""
         obj_id = self.import_obj(obj)
@@ -654,7 +752,29 @@ class Document:
 
     # -- save / load -------------------------------------------------------
 
-    def save(self, deflate: bool = True) -> bytes:
+    def save(self, deflate: bool = True, retain_orphans: bool = True) -> bytes:
+        """Compact document chunk; queued (causally-unready) changes are
+        appended as trailing change chunks so they survive a save/load
+        cycle (reference: SaveOptions{retain_orphans}, automerge.rs:959-963)
+        unless ``retain_orphans=False``."""
+        from .. import trace
+
+        with trace.span("save"):
+            data = self._save_document(deflate)
+        if retain_orphans:
+            for orphan in self.queue:
+                if orphan.raw_bytes:
+                    data += orphan.raw_bytes
+        return data
+
+    def save_and_verify(self, deflate: bool = True) -> bytes:
+        """Save, then load the result back before returning — slow, for
+        debugging corrupt-save suspicions (reference: automerge.rs:973)."""
+        data = self.save(deflate)
+        Document.load(data)
+        return data
+
+    def _save_document(self, deflate: bool = True) -> bytes:
         sorted_idx = self.actors.sorted_order()  # sorted position -> global idx
         remap = [0] * len(sorted_idx)  # global idx -> sorted position
         for pos, g in enumerate(sorted_idx):
@@ -743,13 +863,23 @@ class Document:
         actor: Optional[ActorId] = None,
         verify: bool = True,
         on_partial: str = "error",
+        string_migration: str = "none",
     ) -> "Document":
         """Strict by default: any malformed chunk rejects the whole load
         (the reference's LoadOptions defaults to OnPartialLoad::Error for
         ``load``; pass on_partial="ignore" to keep the valid prefix —
-        automerge.rs:41-47,601-705)."""
+        automerge.rs:41-47,601-705). ``string_migration="convert_to_text"``
+        rewrites scalar strings into TEXT objects after loading
+        (StringMigration, automerge.rs:1567-1610)."""
+        from .. import trace
+
         doc = cls(actor)
-        doc.load_incremental(data, verify=verify, on_partial=on_partial)
+        with trace.span("load", bytes=len(data)):
+            doc.load_incremental(data, verify=verify, on_partial=on_partial)
+        if string_migration == "convert_to_text":
+            doc.convert_scalar_strings_to_text()
+        elif string_migration != "none":
+            raise ValueError(f"unknown string_migration {string_migration!r}")
         return doc
 
     def load_incremental(

@@ -23,7 +23,7 @@ from __future__ import annotations
 
 from typing import Dict, Iterator, List, Optional, Tuple
 
-from ..types import Action, ObjType, OpId, ScalarValue, is_make_action
+from ..types import Action, ObjType, OpId, ScalarValue, is_make_action, str_width
 
 LIST_ENC = 0
 TEXT_ENC = 1
@@ -118,7 +118,7 @@ class Op:
 
     def text_width(self) -> int:
         if self.value.tag == "str":
-            return len(self.value.value)
+            return str_width(self.value.value)
         return 1
 
     def __repr__(self):

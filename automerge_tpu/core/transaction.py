@@ -66,10 +66,17 @@ class Transaction:
     def __del__(self):
         # an abandoned transaction rolls back, like the reference's
         # `impl Drop for Transaction` (manual_transaction.rs): its ops were
-        # applied to the op store eagerly and must not outlive it
+        # applied to the op store eagerly and must not outlive it.
+        # ONLY when nothing was committed since this transaction opened —
+        # rolling back underneath later commits (which Rust's &mut borrow
+        # rules out statically) would tear out ops they built on.
         if not getattr(self, "_done", True):
             try:
-                self.rollback()
+                if self.doc.max_op == self.start_op - 1:
+                    self.rollback()
+                else:
+                    self._done = True
+                    self.doc.open_transactions.discard(self)
             except Exception:
                 pass
 
@@ -520,6 +527,10 @@ class Transaction:
         self.doc.open_transactions.discard(self)
         if not self.operations and self.message is None:
             return None
+        from .. import trace
+
+        if trace.enabled():
+            trace.event("commit", ops=len(self.operations), seq=self.seq)
         change = self._export_change()
         applied = AppliedChange(
             change, self.actor_idx, self._export_actor_map(change)
@@ -582,5 +593,7 @@ class Transaction:
 
 def _sv_width(v: ScalarValue, enc: int) -> int:
     if enc == TEXT_ENC and v.tag == "str":
-        return len(v.value)
+        from ..types import str_width
+
+        return str_width(v.value)
     return 1

@@ -47,6 +47,32 @@ _INT_CODES = (3, 4, 8, 9)  # uint, int, counter, timestamp
 from ..errors import AutomergeError
 
 
+def _str_widths(raw: bytes, voff, vlen, vcode, n) -> "np.ndarray":
+    """Per-row text widths in the configured index unit, vectorized over
+    the raw value buffer (reference: text_value.rs width-per-encoding)."""
+    from ..types import get_text_encoding
+
+    width = np.ones(n, np.int32)
+    if not len(raw):
+        return width
+    srows = vcode == 6
+    enc = get_text_encoding()
+    if enc == "utf8":
+        width[srows] = vlen[srows].astype(np.int32)
+        return width
+    rb = np.frombuffer(raw, np.uint8)
+    cont = np.concatenate([[0], np.cumsum((rb & 0xC0) == 0x80)])
+    cps = (vlen[srows] - (cont[(voff + vlen)[srows]] - cont[voff[srows]])).astype(
+        np.int32
+    )
+    if enc == "utf16":
+        # supplementary-plane code points (4-byte UTF-8) take two units
+        supp = np.concatenate([[0], np.cumsum((rb & 0xF8) == 0xF0)])
+        cps = cps + (supp[(voff + vlen)[srows]] - supp[voff[srows]]).astype(np.int32)
+    width[srows] = cps
+    return width
+
+
 class ExtractError(AutomergeError):
     pass
 
@@ -107,15 +133,7 @@ def change_arrays(change: StoredChange) -> Dict[str, np.ndarray]:
             value_int[r], _ = decode_sleb(raw, o)
     value_int[vcode == 2] = 1  # true
 
-    # utf-8 char widths for string values, vectorized over the raw buffer
-    width = np.ones(n, np.int32)
-    if len(raw):
-        rb = np.frombuffer(raw, np.uint8)
-        cont = np.concatenate([[0], np.cumsum((rb & 0xC0) == 0x80)])
-        srows = vcode == 6
-        width[srows] = (
-            vlen[srows] - (cont[(voff + vlen)[srows]] - cont[voff[srows]])
-        ).astype(np.int32)
+    width = _str_widths(raw, voff, vlen, vcode, n)
 
     # string-ish host columns (map keys, mark names): python decode, cheap
     # because RLE runs collapse repeats; None = entirely-null column (the
@@ -332,15 +350,7 @@ def batch_arrays(changes) -> Dict[str, object]:
         raise ExtractError(f"bad integer value payload at row {-rc - 1}")
     value_int = value_int[:N]
 
-    # utf-8 char widths for string values
-    width = np.ones(N, np.int32)
-    if len(raw):
-        rb = np.frombuffer(raw, np.uint8)
-        cont = np.concatenate([[0], np.cumsum((rb & 0xC0) == 0x80)])
-        srows = vcode == 6
-        width[srows] = (
-            vlen[srows] - (cont[(voff + vlen)[srows]] - cont[voff[srows]])
-        ).astype(np.int32)
+    width = _str_widths(raw, voff, vlen, vcode, N)
 
     return {
         "n": N,

@@ -25,7 +25,7 @@ from typing import Dict, Iterable, List, Sequence, Tuple
 import numpy as np
 
 from ..storage.change import StoredChange
-from ..types import ActorId, ScalarValue
+from ..types import ActorId, ScalarValue, str_width
 
 # Up to 2^20 distinct actors per merged log; counters up to 2^43.
 ACTOR_BITS = 20
@@ -208,7 +208,7 @@ class OpLog:
                 vtag.append(_value_tag(v))
                 vint.append(_int_payload(v))
                 values.append(v)
-                width.append(len(v.value) if v.tag == "str" else 1)
+                width.append(str_width(v.value) if v.tag == "str" else 1)
                 for pc, pa in cop.pred:
                     pred_src.append(row)
                     pred_key.append(pack_id(pc, ranks[pa]))

@@ -1,0 +1,65 @@
+"""Tracing instrumentation (the reference weaves the ``tracing`` crate
+through load/commit/insert — automerge.rs:579,600, op_set.rs:232,
+transaction/inner.rs:80,122; here the standard logging module plays that
+role).
+
+Disabled by default and free when off: every hook is guarded by
+``logger.isEnabledFor`` so the hot paths pay one cached attribute check.
+Enable with e.g.::
+
+    import logging
+    logging.getLogger("automerge_tpu").setLevel(logging.DEBUG)
+    logging.basicConfig()
+
+or set AUTOMERGE_TPU_TRACE=1 in the environment before first import.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+logger = logging.getLogger("automerge_tpu")
+
+if os.environ.get("AUTOMERGE_TPU_TRACE"):
+    logger.setLevel(logging.DEBUG)
+    if not logger.handlers:
+        logging.basicConfig()
+
+_DEBUG = logging.DEBUG
+
+
+def enabled() -> bool:
+    return logger.isEnabledFor(_DEBUG)
+
+
+def event(name: str, **fields) -> None:
+    """One structured trace line: ``name k=v k=v``."""
+    if logger.isEnabledFor(_DEBUG):
+        body = " ".join(f"{k}={v}" for k, v in fields.items())
+        logger.debug("%s %s", name, body)
+
+
+class span:
+    """``with span("load", bytes=n):`` — logs entry/exit with wall time."""
+
+    __slots__ = ("name", "fields", "t0")
+
+    def __init__(self, name: str, **fields):
+        self.name = name
+        self.fields = fields
+        self.t0 = 0.0
+
+    def __enter__(self):
+        if logger.isEnabledFor(_DEBUG):
+            self.t0 = time.perf_counter()
+            event(self.name, phase="begin", **self.fields)
+        return self
+
+    def __exit__(self, *exc):
+        if logger.isEnabledFor(_DEBUG):
+            ms = (time.perf_counter() - self.t0) * 1e3
+            status = "error" if exc[0] else "ok"
+            event(self.name, phase="end", status=status, ms=round(ms, 2), **self.fields)
+        return False

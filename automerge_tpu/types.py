@@ -144,6 +144,42 @@ def is_make_action(a: int) -> bool:
 
 
 # ---------------------------------------------------------------------------
+# Text width encoding
+#
+# The unit a text index counts in. The reference fixes this per BUILD —
+# chars natively, UTF-16 code units under wasm, UTF-8 bytes behind the
+# utf8-indexing feature (reference: text_value.rs:5-15, types.rs:701-706
+# Op::width) — so a process-level setting is the faithful analogue. It
+# must be chosen before documents are built; changing it mid-document
+# desynchronizes cached width aggregates.
+
+TEXT_ENCODINGS = ("unicode", "utf8", "utf16")
+_text_encoding = "unicode"
+
+
+def set_text_encoding(encoding: str) -> None:
+    """Select the text index unit: "unicode" code points (default),
+    "utf8" bytes, or "utf16" code units."""
+    global _text_encoding
+    if encoding not in TEXT_ENCODINGS:
+        raise ValueError(f"unknown text encoding {encoding!r}")
+    _text_encoding = encoding
+
+
+def get_text_encoding() -> str:
+    return _text_encoding
+
+
+def str_width(s: str) -> int:
+    """Width of ``s`` in the configured text index unit."""
+    if _text_encoding == "unicode":
+        return len(s)
+    if _text_encoding == "utf8":
+        return len(s.encode("utf-8"))
+    return sum(2 if ord(c) > 0xFFFF else 1 for c in s)
+
+
+# ---------------------------------------------------------------------------
 # Scalar values
 
 

@@ -1,0 +1,213 @@
+"""Options + observability parity: SaveOptions/LoadOptions analogues,
+dump(), tracing, text width encodings.
+
+Reference surface: automerge.rs:41-135 (LoadOptions: OnPartialLoad,
+VerificationMode, StringMigration), 959-973 (SaveOptions retain_orphans,
+save_and_verify), 1190-1239 (dump), 1567-1610 (text migration);
+text_value.rs:5-15 (width per encoding).
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+
+import pytest
+
+from automerge_tpu.api import AutoDoc
+from automerge_tpu.ops import DeviceDoc
+from automerge_tpu.testing import assert_doc, map_, new_doc, text_
+from automerge_tpu.types import (
+    ActorId,
+    ObjType,
+    get_text_encoding,
+    set_text_encoding,
+)
+
+
+def test_save_retains_orphans():
+    """Causally-unready changes survive a save/load cycle by default."""
+    doc = new_doc(1)
+    doc.put("_root", "a", 1)
+    doc.commit()
+
+    # a change whose dependency this doc never sees -> parked in the queue
+    other = doc.fork(actor=ActorId(bytes([9]) * 16))
+    other.put("_root", "b", 2)
+    other.commit()
+    dep_hash = other.get_heads()[0]
+    other.put("_root", "c", 3)
+    other.commit()
+    orphan = other.get_changes([])[-1]
+    assert orphan.dependencies == [dep_hash]
+
+    doc.apply_changes([orphan])
+    assert doc.get("_root", "c") is None  # queued, not applied
+
+    reloaded = AutoDoc.load(doc.save())
+    # the orphan rode along; delivering its dependency completes it
+    dep = next(c for c in other.get_changes([]) if c.hash == dep_hash)
+    reloaded.apply_changes([dep])
+    assert reloaded.get("_root", "c") is not None
+
+    # and retain_orphans=False drops it
+    bare = AutoDoc.load(doc.save(retain_orphans=False))
+    bare.apply_changes([dep])
+    assert bare.get("_root", "c") is None
+
+
+def test_save_and_verify():
+    doc = new_doc(2)
+    doc.put("_root", "x", 1)
+    data = doc.save_and_verify()
+    assert AutoDoc.load(data).get("_root", "x") is not None
+
+
+def test_string_migration_convert_to_text():
+    doc = new_doc(3)
+    doc.put("_root", "title", "hello")
+    lst = doc.put_object("_root", "lst", ObjType.LIST)
+    doc.insert(lst, 0, "world")
+    doc.insert(lst, 1, 42)
+    t = doc.put_object("_root", "t", ObjType.TEXT)
+    doc.splice_text(t, 0, 0, "stays scalar chars")
+    doc.commit()
+
+    migrated = AutoDoc.load(doc.save(), string_migration="convert_to_text")
+    got = migrated.get("_root", "title")
+    assert got[0][0] == "obj" and got[0][1] == ObjType.TEXT
+    assert migrated.text(got[0][2]) == "hello"
+    lgot = migrated.get(lst, 0)
+    assert lgot[0][0] == "obj" and lgot[0][1] == ObjType.TEXT
+    assert migrated.text(lgot[0][2]) == "world"
+    assert migrated.get(lst, 1)[0][0] == "scalar"  # non-strings untouched
+    assert migrated.text(t) == "stays scalar chars"  # text chars untouched
+
+    # the migration is ordinary history: it merges and survives save/load
+    again = AutoDoc.load(migrated.save())
+    assert again.text(got[0][2]) == "hello"
+
+
+def test_dump_prints_op_table():
+    doc = new_doc(4)
+    doc.put("_root", "k", 1)
+    t = doc.put_object("_root", "t", ObjType.TEXT)
+    doc.splice_text(t, 0, 0, "ab")
+    doc.splice_text(t, 0, 1, "")
+    doc.put("_root", "k", 2)
+    doc.commit()
+    buf = io.StringIO()
+    doc.doc.dump(file=buf)
+    out = buf.getvalue()
+    assert "id" in out and "pred" in out and "succ" in out
+    assert "make(text)" in out
+    assert "int:1" in out and "int:2" in out
+    # delete ops are not stored (they live as succ entries, like the
+    # reference's doc format): the deleted char row shows its successor
+    lines = out.strip().splitlines()
+    a_row = next(l for l in lines if "str:'a'" in l)
+    assert "@" in a_row.split("str:'a'")[1], "deleted char should show succ"
+    n_ops = sum(len(c.ops) for c in doc.get_changes([]))
+    n_deletes = sum(
+        1 for c in doc.get_changes([]) for op in c.ops if op.action == 3
+    )
+    assert len(lines) == 1 + n_ops - n_deletes
+
+
+def test_tracing_hooks_emit_when_enabled():
+    from automerge_tpu import trace
+
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    h = Capture()
+    trace.logger.addHandler(h)
+    old_level = trace.logger.level
+    trace.logger.setLevel(logging.DEBUG)
+    try:
+        doc = new_doc(5)
+        doc.put("_root", "x", 1)
+        doc.commit()
+        data = doc.save()
+        AutoDoc.load(data)
+        doc2 = new_doc(6)
+        doc2.apply_changes(doc.get_changes([]))
+    finally:
+        trace.logger.removeHandler(h)
+        trace.logger.setLevel(old_level)
+    joined = "\n".join(records)
+    assert "commit" in joined
+    assert "save" in joined
+    assert "load" in joined
+    assert "apply_changes" in joined
+
+
+def test_tracing_silent_when_disabled():
+    from automerge_tpu import trace
+
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    h = Capture()
+    trace.logger.addHandler(h)
+    trace.logger.setLevel(logging.WARNING)
+    try:
+        doc = new_doc(7)
+        doc.put("_root", "x", 1)
+        doc.commit()
+    finally:
+        trace.logger.removeHandler(h)
+    assert records == []
+
+
+@pytest.fixture
+def restore_encoding():
+    old = get_text_encoding()
+    yield
+    set_text_encoding(old)
+
+
+def test_text_width_encodings(restore_encoding):
+    """Index units per encoding (reference: text_value.rs, Op::width).
+
+    "a🐻b" is 3 code points, 6 UTF-8 bytes, 4 UTF-16 units.
+    """
+    s = "a\U0001f43bb"
+
+    def build():
+        doc = AutoDoc(actor=ActorId(bytes([1]) * 16))
+        t = doc.put_object("_root", "t", ObjType.TEXT)
+        for i, ch in enumerate(s):
+            doc.splice_text(t, doc.length(t), 0, ch)
+        doc.commit()
+        return doc, t
+
+    set_text_encoding("unicode")
+    doc, t = build()
+    assert doc.length(t) == 3
+    assert doc.get(t, 1)[0] == ("scalar", ("str", "\U0001f43b"))
+
+    set_text_encoding("utf16")
+    doc, t = build()
+    assert doc.length(t) == 4
+    # index 1 and 2 both land inside the bear's two UTF-16 units
+    assert doc.get(t, 1)[0] == ("scalar", ("str", "\U0001f43b"))
+    assert doc.get(t, 2)[0] == ("scalar", ("str", "\U0001f43b"))
+    assert doc.get(t, 3)[0] == ("scalar", ("str", "b"))
+    # device path agrees on widths
+    dev = DeviceDoc.merge([doc])
+    assert dev.length(t) == 4
+
+    set_text_encoding("utf8")
+    doc, t = build()
+    assert doc.length(t) == 6
+    assert doc.get(t, 4)[0] == ("scalar", ("str", "\U0001f43b"))
+    assert doc.get(t, 5)[0] == ("scalar", ("str", "b"))
+    dev = DeviceDoc.merge([doc])
+    assert dev.length(t) == 6
