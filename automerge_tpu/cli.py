@@ -49,28 +49,9 @@ def cmd_export(args) -> int:
 
 
 def _import_value(doc, obj, key, value, insert=False):
-    def put(o, k, v):
-        if insert:
-            doc.insert(o, k, v)
-        else:
-            doc.put(o, k, v)
+    from .functional import write_value
 
-    def put_obj(o, k, t):
-        return doc.insert_object(o, k, t) if insert else doc.put_object(o, k, t)
-
-    if isinstance(value, dict):
-        child = put_obj(obj, key, ObjType.MAP)
-        for k in sorted(value):
-            _import_value(doc, child, k, value[k])
-    elif isinstance(value, list):
-        child = put_obj(obj, key, ObjType.LIST)
-        for i, v in enumerate(value):
-            _import_value(doc, child, i, v, insert=True)
-    elif isinstance(value, str):
-        child = put_obj(obj, key, ObjType.TEXT)
-        doc.splice_text(child, 0, 0, value)
-    else:
-        put(obj, key, value)
+    write_value(doc, obj, key, value, insert=insert, str_as_text=True, sort_keys=True)
 
 
 def cmd_import(args) -> int:

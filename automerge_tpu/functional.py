@@ -1,0 +1,414 @@
+"""The idiomatic functional API: immutable document values + proxy trees.
+
+The analogue of the reference's JS wrapper (reference:
+javascript/src/stable.ts:194-1183 init/change/merge/..., proxies.ts:506-567
+mapProxy/listProxy/textProxy): documents are treated as immutable values —
+``change(doc, fn)`` hands ``fn`` a mutable proxy of the root and returns a
+NEW document value; the input is untouched. Under the hood each value
+wraps an AutoDoc; "immutability" is by-construction (operations fork
+before mutating), not by copying state.
+
+    import automerge_tpu.functional as am
+
+    d1 = am.init()
+    d2 = am.change(d1, lambda d: d.update({"title": "hello"}))
+    d3 = am.change(d2, lambda d: d["items"].append("first"))
+    d4 = am.merge(d3, other)
+    data = am.save(d4)
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from .api import AutoDoc
+from .types import ActorId, ObjType, ScalarValue
+
+__all__ = [
+    "Counter",
+    "Doc",
+    "Text",
+    "change",
+    "change_at",
+    "clone",
+    "fork",
+    "from_dict",
+    "get_actor",
+    "get_heads",
+    "init",
+    "load",
+    "merge",
+    "save",
+    "to_dict",
+]
+
+
+class Doc:
+    """An immutable document value. Read like a dict; mutate via change()."""
+
+    __slots__ = ("_auto", "_superseded")
+
+    def __init__(self, auto: AutoDoc):
+        object.__setattr__(self, "_auto", auto)
+        object.__setattr__(self, "_superseded", False)
+
+    # reads (delegate to a read-only proxy of the root)
+    def __getitem__(self, key):
+        return _read_value(self._auto, "_root", key)
+
+    def __contains__(self, key) -> bool:
+        return key in self._auto.keys("_root")
+
+    def __iter__(self):
+        return iter(self._auto.keys("_root"))
+
+    def __len__(self) -> int:
+        return len(self._auto.keys("_root"))
+
+    def get(self, key, default=None):
+        try:
+            return self[key]
+        except KeyError:
+            return default
+
+    def keys(self):
+        return self._auto.keys("_root")
+
+    def to_py(self):
+        return self._auto.hydrate()
+
+    def __eq__(self, other):
+        if isinstance(other, Doc):
+            return self._auto.hydrate() == other._auto.hydrate()
+        return self._auto.hydrate() == other
+
+    # content equality without content hashing: unhashable, loudly
+    __hash__ = None
+
+    def __repr__(self):
+        return f"Doc({self._auto.hydrate()!r})"
+
+    def __setattr__(self, *_):
+        raise TypeError("documents are immutable; use change(doc, fn)")
+
+
+class Counter:
+    """Wraps an int so change() writes a CRDT counter, not a plain int."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value: int = 0):
+        self.value = value
+
+
+class Text:
+    """Wraps a string so change() creates a TEXT object (char-wise CRDT)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value: str = ""):
+        self.value = value
+
+
+# -- construction / lifecycle -------------------------------------------------
+
+
+def init(actor: Optional[bytes] = None) -> Doc:
+    return Doc(AutoDoc(actor=ActorId(actor) if actor else None))
+
+
+def from_dict(contents: dict, actor: Optional[bytes] = None) -> Doc:
+    """init + one change installing ``contents`` (reference: stable.ts from())."""
+    return change(init(actor), lambda d: d.update(contents))
+
+
+def load(data: bytes, actor: Optional[bytes] = None) -> Doc:
+    return Doc(AutoDoc.load(data, actor=ActorId(actor) if actor else None))
+
+
+def save(doc: Doc) -> bytes:
+    return doc._auto.save()
+
+
+def clone(doc: Doc, actor: Optional[bytes] = None) -> Doc:
+    return Doc(doc._auto.fork(actor=ActorId(actor) if actor else None))
+
+
+fork = clone
+
+
+def get_heads(doc: Doc) -> List[bytes]:
+    return doc._auto.get_heads()
+
+
+def get_actor(doc: Doc) -> bytes:
+    return doc._auto.get_actor().bytes
+
+
+def merge(doc: Doc, other: Doc) -> Doc:
+    """A new value containing both histories; inputs stay readable (merge
+    creates no new changes, so the shared actor cannot mint colliding
+    seqs)."""
+    merged = doc._auto.fork(actor=doc._auto.get_actor())
+    merged.merge(other._auto)
+    return Doc(merged)
+
+
+def _take(doc: Doc) -> AutoDoc:
+    """Consume ``doc`` for a mutating operation: the new value keeps the
+    SAME actor (seq continues), so the old value may no longer author
+    changes — using it again raises, exactly like the JS wrapper's
+    "attempting to change an outdated document" (stable.ts _change)."""
+    if doc._superseded:
+        raise RuntimeError(
+            "attempting to change an outdated document; clone() it first"
+        )
+    object.__setattr__(doc, "_superseded", True)
+    return doc._auto.fork(actor=doc._auto.get_actor())
+
+
+def change(doc: Doc, fn_or_message, fn: Callable = None) -> Doc:
+    """Apply ``fn(root_proxy)`` as one transaction on a NEW document value
+    (reference: stable.ts:355 change())."""
+    if fn is None:
+        message, fn = None, fn_or_message
+    else:
+        message = fn_or_message
+    auto = _take(doc)
+    fn(MapProxy(auto, "_root"))
+    auto.commit(message=message)
+    return Doc(auto)
+
+
+def change_at(doc: Doc, heads: List[bytes], fn: Callable) -> Doc:
+    """Change the document as of ``heads`` — the edit lands concurrent with
+    everything since (reference: stable.ts changeAt / isolation)."""
+    auto = _take(doc)
+    auto.isolate(list(heads))
+    fn(MapProxy(auto, "_root"))
+    auto.integrate()
+    auto.commit()
+    return Doc(auto)
+
+
+# -- proxies ------------------------------------------------------------------
+
+
+def _read_value(auto: AutoDoc, obj: str, key):
+    got = auto.get(obj, key)
+    if got is None:
+        raise KeyError(key) if isinstance(key, str) else IndexError(key)
+    rendered, _ = got
+    if rendered[0] == "obj":
+        t, exid = rendered[1], rendered[2]
+        if t in (ObjType.MAP, ObjType.TABLE):
+            return MapProxy(auto, exid)
+        if t == ObjType.TEXT:
+            return TextProxy(auto, exid)
+        return ListProxy(auto, exid)
+    if rendered[0] == "counter":
+        return rendered[1]
+    return rendered[1].to_py()
+
+
+def write_value(
+    auto,
+    obj: str,
+    key,
+    value,
+    insert: bool = False,
+    str_as_text: bool = False,
+    sort_keys: bool = False,
+):
+    """Recursively assign a plain Python value at key/index, creating CRDT
+    objects for containers. The one tree writer shared by the functional
+    proxies (strings as scalars, like the reference's next API) and the
+    CLI JSON importer (strings as TEXT objects, like the reference CLI —
+    pass ``str_as_text=True, sort_keys=True``)."""
+
+    def put_or_insert(v):
+        if insert:
+            auto.insert(obj, key, v)
+        else:
+            auto.put(obj, key, v)
+
+    def make(obj_type):
+        if insert:
+            return auto.insert_object(obj, key, obj_type)
+        return auto.put_object(obj, key, obj_type)
+
+    if isinstance(value, Counter):
+        put_or_insert(ScalarValue("counter", value.value))
+    elif isinstance(value, Text) or (str_as_text and isinstance(value, str)):
+        text = value.value if isinstance(value, Text) else value
+        t = make(ObjType.TEXT)
+        if text:
+            auto.splice_text(t, 0, 0, text)
+    elif isinstance(value, dict):
+        m = make(ObjType.MAP)
+        for k in sorted(value) if sort_keys else value:
+            write_value(auto, m, k, value[k], str_as_text=str_as_text, sort_keys=sort_keys)
+    elif isinstance(value, (list, tuple)):
+        lst = make(ObjType.LIST)
+        for i, v in enumerate(value):
+            write_value(
+                auto, lst, i, v,
+                insert=True, str_as_text=str_as_text, sort_keys=sort_keys,
+            )
+    elif isinstance(value, (MapProxy, ListProxy, TextProxy)):
+        raise TypeError("cannot re-assign a live proxy; build plain values")
+    else:
+        put_or_insert(value)
+
+
+_write_value = write_value
+
+
+class MapProxy:
+    """dict-like view over a map object inside an open change()."""
+
+    __slots__ = ("_auto", "_obj")
+
+    def __init__(self, auto: AutoDoc, obj: str):
+        self._auto = auto
+        self._obj = obj
+
+    def __getitem__(self, key: str):
+        return _read_value(self._auto, self._obj, key)
+
+    def __setitem__(self, key: str, value):
+        _write_value(self._auto, self._obj, key, value)
+
+    def __delitem__(self, key: str):
+        self._auto.delete(self._obj, key)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._auto.keys(self._obj)
+
+    def __iter__(self):
+        return iter(self._auto.keys(self._obj))
+
+    def __len__(self) -> int:
+        return len(self._auto.keys(self._obj))
+
+    def keys(self):
+        return self._auto.keys(self._obj)
+
+    def get(self, key, default=None):
+        if key in self:
+            return _read_value(self._auto, self._obj, key)
+        return default
+
+    def update(self, entries: dict):
+        for k, v in entries.items():
+            self[k] = v
+
+    def increment(self, key: str, by: int = 1):
+        self._auto.increment(self._obj, key, by)
+
+    def to_py(self):
+        return self._auto.hydrate(self._obj)
+
+    def __repr__(self):
+        return f"MapProxy({self.to_py()!r})"
+
+
+class ListProxy:
+    """list-like view over a list object inside an open change()."""
+
+    __slots__ = ("_auto", "_obj")
+
+    def __init__(self, auto: AutoDoc, obj: str):
+        self._auto = auto
+        self._obj = obj
+
+    def _norm(self, i: int) -> int:
+        n = len(self)
+        if i < 0:
+            i += n
+        return i
+
+    def __getitem__(self, i: int):
+        return _read_value(self._auto, self._obj, self._norm(i))
+
+    def __setitem__(self, i: int, value):
+        _write_value(self._auto, self._obj, self._norm(i), value)
+
+    def __delitem__(self, i: int):
+        self._auto.delete(self._obj, self._norm(i))
+
+    def __len__(self) -> int:
+        return self._auto.length(self._obj)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def append(self, value):
+        _write_value(self._auto, self._obj, len(self), value, insert=True)
+
+    def insert(self, i: int, value):
+        _write_value(self._auto, self._obj, self._norm(i), value, insert=True)
+
+    def extend(self, values):
+        for v in values:
+            self.append(v)
+
+    def pop(self, i: int = -1):
+        i = self._norm(i)
+        v = self[i]
+        del self[i]
+        return v
+
+    def increment(self, i: int, by: int = 1):
+        self._auto.increment(self._obj, self._norm(i), by)
+
+    def to_py(self):
+        return self._auto.hydrate(self._obj)
+
+    def __repr__(self):
+        return f"ListProxy({self.to_py()!r})"
+
+
+class TextProxy:
+    """str-like view over a text object inside an open change()."""
+
+    __slots__ = ("_auto", "_obj")
+
+    def __init__(self, auto: AutoDoc, obj: str):
+        self._auto = auto
+        self._obj = obj
+
+    def __str__(self) -> str:
+        return self._auto.text(self._obj)
+
+    def __len__(self) -> int:
+        return self._auto.length(self._obj)
+
+    def splice(self, pos: int, delete: int, text: str = ""):
+        self._auto.splice_text(self._obj, pos, delete, text)
+
+    def insert(self, pos: int, text: str):
+        self.splice(pos, 0, text)
+
+    def delete(self, pos: int, length: int = 1):
+        self.splice(pos, length, "")
+
+    def append(self, text: str):
+        self.splice(len(self), 0, text)
+
+    def mark(self, start: int, end: int, name: str, value, expand="after"):
+        self._auto.mark(self._obj, start, end, name, value, expand)
+
+    def unmark(self, start: int, end: int, name: str, expand="none"):
+        self._auto.unmark(self._obj, start, end, name, expand)
+
+    def to_py(self) -> str:
+        return str(self)
+
+    def __repr__(self):
+        return f"TextProxy({str(self)!r})"
+
+
+def to_dict(doc: Doc):
+    return doc._auto.hydrate()

@@ -1,0 +1,137 @@
+"""The idiomatic functional API (automerge_tpu.functional).
+
+Mirrors the reference's JS wrapper semantics (reference:
+javascript/src/stable.ts init/change/merge, proxies.ts map/list/text
+proxies, javascript/test/basic_tests): documents are immutable values,
+change() returns a new one, proxies write through a transaction.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+import automerge_tpu.functional as am
+from automerge_tpu.ops import DeviceDoc
+
+
+def test_change_returns_new_value_and_preserves_input():
+    d1 = am.init(actor=bytes([1]) * 16)
+    d2 = am.change(d1, lambda d: d.update({"title": "hello"}))
+    assert d1.to_py() == {}
+    assert d2.to_py() == {"title": "hello"}
+    assert d2["title"] == "hello"
+
+
+def test_nested_containers_from_plain_values():
+    d = am.from_dict(
+        {
+            "config": {"depth": {"n": 3}},
+            "items": [1, "two", [True, None]],
+            "text": am.Text("abc"),
+            "votes": am.Counter(10),
+        },
+        actor=bytes([2]) * 16,
+    )
+    assert d.to_py() == {
+        "config": {"depth": {"n": 3}},
+        "items": [1, "two", [True, None]],
+        "text": "abc",
+        "votes": 10,
+    }
+    assert d["config"]["depth"]["n"] == 3
+    assert list(d["items"][2]) == [True, None]
+    assert str(d["text"]) == "abc"
+
+
+def test_nested_path_requires_assignment():
+    d = am.init()
+    # reads of missing keys raise (no silent auto-create, matching the JS
+    # wrapper where reading a missing key yields undefined, not a new map)
+    with pytest.raises(KeyError):
+        am.change(d, lambda r: r["typo"]["b"])
+    d2 = am.change(am.init(), lambda r: r.update({"a": {"b": {"c": 1}}}))
+    assert d2.to_py() == {"a": {"b": {"c": 1}}}
+
+
+def test_list_mutations():
+    d = am.from_dict({"l": [1, 2, 3]})
+
+    def edit(r):
+        lst = r["l"]
+        lst.append(4)
+        lst.insert(0, 0)
+        del lst[2]
+        lst[0] = 100
+        assert lst.pop() == 4
+        lst.extend([7, 8])
+
+    d2 = am.change(d, edit)
+    assert d2.to_py()["l"] == [100, 1, 3, 7, 8]
+
+
+def test_text_and_marks():
+    d = am.from_dict({"t": am.Text("hello world")})
+
+    def edit(r):
+        t = r["t"]
+        t.splice(5, 6, "!")
+        t.append("!")
+        t.mark(0, 5, "bold", True)
+
+    d2 = am.change(d, edit)
+    assert str(d2["t"]) == "hello!!"
+    marks = d2._auto.marks(d2._auto.get("_root", "t")[0][2])
+    assert marks and marks[0].name == "bold"
+
+
+def test_counter_increment():
+    d = am.from_dict({"n": am.Counter(5)})
+    d2 = am.change(d, lambda r: r.increment("n", 3))
+    assert d2["n"] == 8
+
+
+def test_merge_is_a_value_operation():
+    base = am.from_dict({"t": am.Text("base")}, actor=bytes([1]) * 16)
+    a = am.change(am.clone(base, actor=bytes([2]) * 16), lambda r: r["t"].append(" A"))
+    b = am.change(am.clone(base, actor=bytes([3]) * 16), lambda r: r["t"].insert(0, "B "))
+    m1 = am.merge(a, b)
+    m2 = am.merge(b, a)
+    assert m1 == m2
+    assert str(m1["t"]) == str(m2["t"])
+    # inputs untouched
+    assert str(a["t"]) == "base A"
+    assert str(b["t"]) == "B base"
+
+
+def test_save_load_roundtrip():
+    d = am.from_dict({"x": 1, "l": [1, 2]})
+    d2 = am.load(am.save(d))
+    assert d2 == d
+
+
+def test_change_at_is_concurrent():
+    d1 = am.from_dict({"t": am.Text("aaabbb")}, actor=bytes([1]) * 16)
+    heads = am.get_heads(d1)
+    d2 = am.change(d1, lambda r: r["t"].append("ccc"))
+    d3 = am.change_at(d2, heads, lambda r: r["t"].insert(0, "X"))
+    # the historical edit didn't see ccc but both survive
+    assert str(d3["t"]) == "Xaaabbbccc"
+
+
+def test_doc_is_immutable():
+    d = am.init()
+    with pytest.raises(TypeError):
+        d.foo = 1
+
+
+def test_functional_docs_feed_device_merge():
+    base = am.from_dict({"t": am.Text("shared ")}, actor=bytes([1]) * 16)
+    docs = []
+    for i in range(4):
+        c = am.clone(base, actor=bytes([10 + i]) * 16)
+        docs.append(am.change(c, lambda r, i=i: r["t"].append(f"[{i}]")))
+    dev = DeviceDoc.merge([d._auto for d in docs])
+    host = docs[0]
+    for other in docs[1:]:
+        host = am.merge(host, other)
+    assert dev.hydrate() == host.to_py()

@@ -1,0 +1,256 @@
+"""Cross-format hardening: byte-level goldens beyond the tiny fixtures.
+
+Three layers (VERDICT r1 item 10):
+  1. the reference's hand-written change-chunk wire example
+     (reference: rust/automerge/tests/test.rs:1266-1291 — a spec-level
+     byte vector, decoded and re-encoded byte-exactly here)
+  2. hand-assembled sync-message bytes checked field by field
+  3. committed golden documents (marks, counters, multi-actor, compressed
+     doc columns) that every future build must load to the pinned state
+     AND re-encode to the pinned bytes
+"""
+
+from __future__ import annotations
+
+import os
+import zlib
+
+import pytest
+
+from automerge_tpu.api import AutoDoc
+from automerge_tpu.expanded import collapse_change, expand_change
+from automerge_tpu.storage.change import build_change, parse_change
+from automerge_tpu.sync.protocol import Message
+from automerge_tpu.types import ActorId, ObjType, ScalarValue
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "goldens")
+
+# The reference's hand-written change chunk (test.rs:1266-1291): actor
+# 0x1234, seq 1, startOp 1, time -12345604 (sleb), message
+# "Initialization", one op: set x=1 (uint), 10 trailing extra bytes.
+REFERENCE_CHANGE = bytes(
+    [
+        0x85, 0x6F, 0x4A, 0x83,  # magic
+        0xB2, 0x98, 0x9E, 0xA9,  # checksum
+        1, 61, 0, 2, 0x12, 0x34,  # type=change, len, deps=0, actor "1234"
+        1, 1, 0xFC, 0xFA, 0xDC, 0xFF, 5,  # seq, startOp, time
+        14,  # message length
+        *b"Initialization",
+        0, 6,  # other actors = 0, column count
+        0x15, 3, 0x34, 1, 0x42, 2,  # keyStr, insert, action col specs
+        0x56, 2, 0x57, 1, 0x70, 2,  # valLen, valRaw, predNum col specs
+        0x7F, 1, 0x78,  # keyStr: "x"
+        1,  # insert: false
+        0x7F, 1,  # action: set
+        0x7F, 19,  # valLen: 1 byte, type uint
+        1,  # valRaw: 1
+        0x7F, 0,  # predNum: 0
+        0, 1, 2, 3, 4, 5, 6, 7, 8, 9,  # extra bytes
+    ]
+)
+
+
+class TestReferenceWireExample:
+    def test_parse_reference_change_bytes(self):
+        ch, pos = parse_change(REFERENCE_CHANGE)
+        assert pos == len(REFERENCE_CHANGE)
+        assert ch.actor == bytes([0x12, 0x34])
+        assert ch.seq == 1
+        assert ch.start_op == 1
+        assert ch.message == "Initialization"
+        assert ch.dependencies == []
+        assert len(ch.ops) == 1
+        op = ch.ops[0]
+        assert op.key.prop == "x"
+        assert not op.insert
+        assert op.value == ScalarValue("uint", 1)
+        assert op.pred == []
+        assert ch.extra_bytes == bytes(range(10))
+
+    def test_reencode_is_byte_identical(self):
+        ch, _ = parse_change(REFERENCE_CHANGE)
+        rebuilt = build_change(ch)
+        assert rebuilt.raw_bytes == REFERENCE_CHANGE
+        assert rebuilt.hash == ch.hash
+
+    def test_expanded_roundtrip_preserves_reference_bytes(self):
+        import json
+
+        ch, _ = parse_change(REFERENCE_CHANGE)
+        j = json.loads(json.dumps(expand_change(ch)))
+        collapsed = collapse_change(j)
+        assert collapsed.raw_bytes == REFERENCE_CHANGE
+
+    def test_timestamp_sleb(self):
+        ch, _ = parse_change(REFERENCE_CHANGE)
+        # 0xFC 0xFA 0xDC 0xFF 0x05 decodes to this sleb value
+        assert ch.timestamp == 1610038652
+
+    def test_applies_as_a_document(self):
+        doc = AutoDoc(actor=ActorId(bytes([9]) * 16))
+        doc.load_incremental(REFERENCE_CHANGE, on_partial="error")
+        assert doc.get("_root", "x")[0] == ("scalar", ScalarValue("uint", 1))
+
+
+class TestSyncMessageBytes:
+    def test_wire_fields(self):
+        """Message encode lays out 0x42 | heads | need | have | changes
+        exactly as sync.rs:473-557 does."""
+        doc = AutoDoc(actor=ActorId(bytes([1]) * 16))
+        doc.put("_root", "k", 1)
+        doc.commit()
+        ch = doc.get_changes([])[0]
+        h = ch.hash
+        msg = Message(heads=[h], need=[], have=[], changes=[ch])
+        raw = msg.encode()
+        assert raw[0] == 0x42  # MESSAGE_TYPE_SYNC
+        assert raw[1] == 1  # heads count
+        assert raw[2:34] == h  # head hash bytes
+        assert raw[34] == 0  # need count
+        assert raw[35] == 0  # have count
+        assert raw[36] == 1  # change count
+        # change payload is the length-prefixed raw chunk
+        ln = raw[37]
+        assert raw[38 : 38 + ln] == ch.raw_bytes
+        # and decodes back
+        dec = Message.decode(raw)
+        assert dec.heads == [h] and [c.hash for c in dec.changes] == [h]
+
+    def test_sync_state_bytes(self):
+        from automerge_tpu.sync.protocol import SyncState
+
+        s = SyncState()
+        s.shared_heads = [bytes(range(32))]
+        raw = s.encode()
+        assert raw[0] == 0x43  # MESSAGE_TYPE_SYNC_STATE
+        assert raw[1] == 1
+        assert raw[2:34] == bytes(range(32))
+        assert SyncState.decode(raw).shared_heads == s.shared_heads
+
+
+def _golden_doc() -> AutoDoc:
+    """Deterministic document covering marks, counters, multi-actor merges,
+    nested objects, deletes, and >256-byte columns (deflate kicks in)."""
+    a = AutoDoc(actor=ActorId(bytes([0xAA]) * 16))
+    text = a.put_object("_root", "text", ObjType.TEXT)
+    a.splice_text(text, 0, 0, "the quick brown fox jumps over the lazy dog " * 12)
+    a.mark(text, 4, 9, "bold", True, expand="both")
+    a.mark(text, 10, 15, "link", "https://example.com", expand="none")
+    a.put("_root", "votes", ScalarValue("counter", 100))
+    a.put("_root", "when", ScalarValue("timestamp", 1700000000000))
+    a.put("_root", "blob", ScalarValue("bytes", bytes(range(64))))
+    nested = a.put_object("_root", "nested", ObjType.MAP)
+    lst = a.put_object(nested, "list", ObjType.LIST)
+    for i in range(40):
+        a.insert(lst, i, i * 7)
+    a.commit()
+
+    b = a.fork(actor=ActorId(bytes([0xBB]) * 16))
+    b.splice_text(text, 0, 4, "THE ")
+    b.increment("_root", "votes", 11)
+    b.put("_root", "who", "actor-b")
+    b.commit()
+
+    c = a.fork(actor=ActorId(bytes([0xCC]) * 16))
+    c.delete(lst, 0)
+    c.put(lst, 0, "replaced")
+    c.increment("_root", "votes", -3)
+    c.put("_root", "who", "actor-c")
+    c.commit()
+
+    a.merge(b)
+    a.merge(c)
+    a.splice_text(text, 0, 0, "¡unicode – 🦊! ")
+    a.commit()
+    return a
+
+
+GOLDEN_PATH = os.path.join(GOLDEN_DIR, "rich_multiactor.automerge")
+
+
+def test_golden_document_bytes_stable():
+    """The committed golden must load to the same state and re-save to the
+    exact committed bytes — any drift in codecs/column layout fails here."""
+    doc = _golden_doc()
+    data = doc.save()
+    if not os.path.exists(GOLDEN_PATH):
+        if os.environ.get("AUTOMERGE_TPU_REGEN_GOLDENS"):
+            os.makedirs(GOLDEN_DIR, exist_ok=True)
+            with open(GOLDEN_PATH, "wb") as f:
+                f.write(data)
+        else:
+            pytest.fail(
+                "golden fixture missing; it must be committed. Set "
+                "AUTOMERGE_TPU_REGEN_GOLDENS=1 to regenerate deliberately."
+            )
+    golden = open(GOLDEN_PATH, "rb").read()
+    assert data == golden, "save bytes drifted from the committed golden"
+
+    loaded = AutoDoc.load(golden)
+    assert loaded.hydrate() == doc.hydrate()
+    assert loaded.get_heads() == doc.get_heads()
+    text_id = loaded.get("_root", "text")[0][2]
+    marks = loaded.marks(text_id)
+    assert {m.name for m in marks} == {"bold", "link"}
+    assert loaded.get("_root", "votes")[0] == ("counter", 108)
+    # deflate did engage for the big text column
+    assert len(golden) < len(doc.save(deflate=False))
+    # and a resave of the LOADED doc is also byte-identical
+    assert loaded.save() == golden
+
+
+def test_golden_change_chunks_stable():
+    """Each change chunk re-encodes byte-identically after parse (hash
+    verification would catch value drift; this catches encoding drift)."""
+    doc = _golden_doc()
+    for ch in doc.get_changes([]):
+        reparsed, _ = parse_change(ch.raw_bytes)
+        assert build_change(reparsed).raw_bytes == ch.raw_bytes
+
+
+def test_golden_compressed_chunk_roundtrip():
+    from automerge_tpu.storage.chunk import compress_chunk
+
+    doc = _golden_doc()
+    big = max(doc.get_changes([]), key=lambda c: len(c.raw_bytes))
+    comp = compress_chunk(big.raw_bytes)
+    assert comp[8] == 2  # compressed chunk type
+    assert len(comp) < len(big.raw_bytes)
+    reparsed, _ = parse_change(comp)
+    assert reparsed.hash == big.hash
+    assert reparsed.raw_bytes == big.raw_bytes
+
+
+def test_remote_insert_at_mark_boundary_converges():
+    """A REMOTE insert landing at concurrent mark boundaries: placement is
+    RGA (op-id) order — mark boundary ops are ordinary invisible elements
+    in the reference too (inner.rs:716-741 do_insert of MarkBegin/End) —
+    so the guaranteed property across replicas is CONVERGENCE: same text,
+    same spans, in both merge orders and on the device (VERDICT r1 weak #8).
+    Local boundary inserts honoring expand are covered in test_marks."""
+    from automerge_tpu.ops import DeviceDoc
+
+    for expand in ("both", "none", "after", "before"):
+        a = AutoDoc(actor=ActorId(bytes([1]) * 16))
+        t = a.put_object("_root", "t", ObjType.TEXT)
+        a.splice_text(t, 0, 0, "hello world")
+        a.commit()
+        b = a.fork(actor=ActorId(bytes([2]) * 16))
+
+        a.mark(t, 0, 5, "bold", True, expand=expand)
+        a.commit()
+        # concurrent remote inserts at both boundaries
+        b.splice_text(t, 5, 0, "XYZ")
+        b.splice_text(t, 0, 0, "Q")
+        b.commit()
+
+        a.merge(b)
+        b.merge(a)
+        assert a.text(t) == b.text(t), expand
+        spans_a = sorted((m.start, m.end, m.name) for m in a.marks(t))
+        spans_b = sorted((m.start, m.end, m.name) for m in b.marks(t))
+        assert spans_a == spans_b, (expand, spans_a, spans_b)
+        assert spans_a, f"mark lost in merge under expand={expand}"
+        dev = DeviceDoc.merge([a, b])
+        spans_d = sorted((m.start, m.end, m.name) for m in dev.marks(t))
+        assert spans_d == spans_a, (expand, spans_d, spans_a)
