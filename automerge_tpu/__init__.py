@@ -15,4 +15,21 @@ __version__ = "0.1.0"
 from .api import AutoDoc  # noqa: F401
 from .core.document import AutomergeError, Document, ROOT  # noqa: F401
 from .core.transaction import Transaction  # noqa: F401
-from .types import ActorId, Action, ObjType, ScalarValue  # noqa: F401
+from .types import (  # noqa: F401
+    Action,
+    ActorId,
+    ObjType,
+    ScalarValue,
+    get_text_encoding,
+    set_text_encoding,
+)
+
+# subsystem entry points (imported lazily by most callers):
+#   .ops        device op log + batched merge (DeviceDoc, OpLog)
+#   .functional idiomatic immutable-value API (init/change/merge)
+#   .sync       Bloom-filter sync protocol
+#   .patches    patch log / diff / materialization
+#   .testing    conflict-aware test DSL (assert_doc / map_ / list_)
+#   .errors     typed error hierarchy
+#   .capi       C ABI frontend build helpers
+#   .trace      tracing instrumentation

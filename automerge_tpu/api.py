@@ -228,6 +228,15 @@ class AutoDoc:
     def object_type(self, obj: str) -> ObjType:
         return self.doc.object_type(obj)
 
+    def map_range(self, obj: str = ROOT, start=None, end=None, heads=None):
+        return self.doc.map_range(obj, start, end, clock=self._read_clock(heads))
+
+    def list_range(self, obj: str, start: int = 0, end=None, heads=None):
+        return self.doc.list_range(obj, start, end, clock=self._read_clock(heads))
+
+    def values(self, obj: str = ROOT, heads=None):
+        return self.doc.values(obj, clock=self._read_clock(heads))
+
     def parents(self, obj: str):
         return self.doc.parents(obj)
 

@@ -79,18 +79,91 @@ def flatten_changes(changes: Sequence) -> Dict[str, object]:
     }
 
 
+def _export_via_device(stored, flat):
+    """Per-object element order from the batched device merge kernel.
+
+    The native sequential integrate degenerates exactly where the kernel
+    shines: dense concurrency (many actors inserting at the same anchors
+    turns the per-insert sibling skip scan quadratic). The kernel's
+    ``elem_index`` IS the document order, for every insert op including
+    tombstones, so it can feed the same (obj_keys, obj_off, elem_rows)
+    contract. flatten_changes and ops/oplog.py share the byte-rank id
+    packing, so rows translate with one searchsorted.
+    """
+    from ..ops import OpLog
+    from ..ops.merge import merge_columns
+
+    log = OpLog.from_changes(stored)
+    if log.n != len(flat["op_id"]):
+        raise ValueError("device export: op count mismatch with flat history")
+    res = merge_columns(
+        log.padded_columns(), fetch=("elem_index",), n_objs=log.n_objs
+    )
+    elem_index = np.asarray(res["elem_index"][: log.n])
+
+    flat_pos = np.argsort(flat["op_id"], kind="stable")
+    sorted_flat = flat["op_id"][flat_pos]
+    pos = np.searchsorted(sorted_flat, log.id_key)
+    pos = np.clip(pos, 0, max(len(sorted_flat) - 1, 0))
+    if len(sorted_flat) == 0 or not np.array_equal(sorted_flat[pos], log.id_key):
+        raise ValueError("device export: id mismatch with flat history")
+    flat_rows = flat_pos[pos]
+
+    rows = np.flatnonzero(log.insert & (elem_index >= 0))
+    order = np.lexsort((elem_index[rows], log.obj_key[rows]))
+    rows = rows[order]
+    obj_of = log.obj_key[rows]
+    bnd = (
+        np.flatnonzero(np.concatenate([[True], obj_of[1:] != obj_of[:-1]]))
+        if len(rows)
+        else np.empty(0, np.int64)
+    )
+    obj_keys = obj_of[bnd].astype(np.int64)
+    obj_off = np.concatenate([bnd, [len(rows)]]).astype(np.int64)
+    elem_rows = flat_rows[rows].astype(np.int32)
+    return obj_keys, obj_off, elem_rows
+
+
+# dense-concurrency threshold: at or past this shape the sequential RGA
+# sibling scan loses to one batched kernel pass even counting transport
+DEVICE_MIN_OPS = 20_000
+DEVICE_MIN_ACTORS = 16
+
+
 def rebuild_op_store(doc) -> None:
-    """Rebuild ``doc.ops`` from the full applied history via the native
-    integrate. Replaces the store wholesale; the document's history /
-    change graph / actor caches are untouched."""
+    """Rebuild ``doc.ops`` from the full applied history. Element order
+    comes from the native sequential integrate, or — for large dense-
+    concurrency histories — from the batched device merge kernel.
+    Replaces the store wholesale; the document's history / change graph /
+    actor caches are untouched."""
+    import os
+
     from .. import native
 
     stored = [a.stored for a in doc.history]
     flat = flatten_changes(stored)
-    obj_keys, obj_off, elem_rows = native.seq_apply_export(
-        flat["op_id"], flat["obj"], flat["elem"], flat["prop"], flat["action"],
-        flat["insert"], flat["is_counter"], flat["pred_off"], flat["pred_flat"],
-    )
+
+    engine = os.environ.get("AUTOMERGE_TPU_BULK")
+    if engine is None:
+        n_actors = len({bytes(ch.actor) for ch in stored})
+        engine = (
+            "device"
+            if len(flat["op_id"]) >= DEVICE_MIN_OPS and n_actors >= DEVICE_MIN_ACTORS
+            else "native"
+        )
+    obj_keys = None
+    if engine == "device":
+        try:
+            obj_keys, obj_off, elem_rows = _export_via_device(stored, flat)
+        except Exception:
+            if os.environ.get("AUTOMERGE_TPU_DEBUG"):
+                raise
+            obj_keys = None  # fall back to the native integrate
+    if obj_keys is None:
+        obj_keys, obj_off, elem_rows = native.seq_apply_export(
+            flat["op_id"], flat["obj"], flat["elem"], flat["prop"], flat["action"],
+            flat["insert"], flat["is_counter"], flat["pred_off"], flat["pred_flat"],
+        )
 
     # ---- build Op objects (linear pass over change ops) -------------------
     n = len(flat["op_id"])
@@ -157,7 +230,11 @@ def rebuild_op_store(doc) -> None:
     for r in make_rows:
         op = ops[int(r)]
         t = objtype_for_action(op.action)
-        data = MapObject(t) if t in (ObjType.MAP, ObjType.TABLE) else SeqObject(t)
+        data = (
+            MapObject(t)
+            if t in (ObjType.MAP, ObjType.TABLE)
+            else SeqObject(t, store.actors)
+        )
         parent_elem = op.id if op.insert else op.elem
         store.objects[op.id] = ObjInfo(data, objs_of[int(r)], op.key, parent_elem)
 

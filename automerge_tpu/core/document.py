@@ -547,6 +547,42 @@ class Document:
         out.sort(key=lambda kv: kv[0])
         return out
 
+    def map_range(
+        self, obj: str, start: Optional[str] = None, end: Optional[str] = None,
+        heads=None, clock=None,
+    ) -> List[Tuple[str, object, str]]:
+        """(key, winner value, value id) for map keys in [start, end)
+        (reference: read.rs map_range/map_range_at)."""
+        from ..utils.ranges import filter_map_range
+
+        return filter_map_range(self.map_entries(obj, heads=heads, clock=clock), start, end)
+
+    def list_range(
+        self, obj: str, start: int = 0, end: Optional[int] = None,
+        heads=None, clock=None,
+    ) -> List[Tuple[int, object, str]]:
+        """(index, winner value, value id) for indices in [start, end)
+        (reference: read.rs list_range/list_range_at). Walks only the
+        requested span — O(end-start + index seek), not O(list length)."""
+        obj_id = self.import_obj(obj)
+        clock = self._resolve_clock(heads, clock)
+        out: List[Tuple[int, object, str]] = []
+        idx = max(start, 0)
+        for _, w in self.ops.visible_elements_range(obj_id, start, end, clock):
+            out.append((idx, self._render_op(w, clock), self.export_id(w.id)))
+            idx += 1
+        return out
+
+    def values(self, obj: str, heads=None, clock=None) -> List[Tuple[object, str]]:
+        """Winner (value, id) pairs of an object, map or sequence
+        (reference: read.rs values/values_at)."""
+        info = self.ops.get_obj(self.import_obj(obj))
+        if isinstance(info.data, MapObject):
+            return [
+                (val, vid) for _, val, vid in self.map_entries(obj, heads=heads, clock=clock)
+            ]
+        return self.list_items(obj, heads=heads, clock=clock)
+
     def parents(self, obj: str) -> List[Tuple[str, object]]:
         """Path from ``obj`` up to the root: [(parent id, key-or-index), ...]."""
         obj_id = self.import_obj(obj)

@@ -128,7 +128,7 @@ class Op:
 class Element:
     """A sequence element: its defining insert op plus overwriting ops."""
 
-    __slots__ = ("op", "updates", "prev", "next", "block")
+    __slots__ = ("op", "updates", "prev", "next", "block", "_wcache")
 
     def __init__(self, op: Optional[Op]):
         self.op = op  # None only for the head sentinel
@@ -136,10 +136,18 @@ class Element:
         self.prev: Optional["Element"] = None
         self.next: Optional["Element"] = None
         self.block: Optional["Block"] = None
+        # cached current-state winner: () = dirty, (op_or_None,) = valid.
+        # Walks touch every element ~hundreds of times between visibility
+        # changes; recomputing visible_ops each time dominated the replay
+        # profile. Mutation paths call dirty_winner().
+        self._wcache = ()
 
     @property
     def elem_id(self) -> OpId:
         return self.op.id
+
+    def dirty_winner(self) -> None:
+        self._wcache = ()
 
     def run(self) -> Iterator[Op]:
         if self.op is not None:
@@ -151,6 +159,14 @@ class Element:
 
     def winner(self, clock=None) -> Optional[Op]:
         """Last visible op in Lamport order — the current value."""
+        if clock is None:
+            cached = self._wcache
+            if cached:
+                return cached[0]
+            vis = self.visible_ops(None)
+            w = vis[-1] if vis else None
+            self._wcache = (w,)
+            return w
         vis = self.visible_ops(clock)
         return vis[-1] if vis else None
 
@@ -165,12 +181,16 @@ class Block:
     query/list_state.rs:76-120), in flat-block form.
     """
 
-    __slots__ = ("els", "vis", "width")
+    __slots__ = ("els", "vis", "width", "min_key")
 
     def __init__(self):
         self.els: List[Element] = []
         self.vis = 0
         self.width = 0
+        # minimum (ctr, actor-bytes) insert-op key in this block: lets the
+        # RGA sibling skip scan jump whole blocks whose every element has a
+        # greater Lamport id (the dense-concurrency quadratic case)
+        self.min_key = None
 
 
 # block split threshold: nth costs O(#blocks + BLOCK_MAX); with ~n/128
@@ -181,6 +201,7 @@ BLOCK_MAX = 256
 class SeqObject:
     __slots__ = (
         "obj_type",
+        "actors",  # the document's actor cache (Lamport ties use bytes)
         "head",
         "tail",
         "by_id",
@@ -190,8 +211,9 @@ class SeqObject:
         "_cursor",  # (Element, list_index, text_index) of a visible element
     )
 
-    def __init__(self, obj_type: ObjType):
+    def __init__(self, obj_type: ObjType, actors=None):
         self.obj_type = obj_type
+        self.actors = actors
         self.head = Element(None)
         self.tail = self.head
         self.by_id: Dict[OpId, Element] = {}
@@ -204,6 +226,10 @@ class SeqObject:
         self._cursor = None
 
     # -- block index maintenance ------------------------------------------
+
+    def _block_key(self, el: Element):
+        opid = el.op.id
+        return (opid[0], self.actors.get(opid[1]).bytes)
 
     def block_insert_after(self, prev: Element, el: Element) -> None:
         """Register ``el`` (just linked after ``prev``) in the block index."""
@@ -220,6 +246,9 @@ class SeqObject:
         if w is not None:
             b.vis += 1
             b.width += w.text_width()
+        key = self._block_key(el)
+        if b.min_key is None or key < b.min_key:
+            b.min_key = key
         if len(b.els) > BLOCK_MAX:
             self._split_block(b)
 
@@ -236,6 +265,8 @@ class SeqObject:
                 nb.width += w.text_width()
         b.vis -= nb.vis
         b.width -= nb.width
+        b.min_key = min(map(self._block_key, b.els)) if b.els else None
+        nb.min_key = min(map(self._block_key, nb.els)) if nb.els else None
         self.blocks.insert(self.blocks.index(b) + 1, nb)
 
     def block_remove(self, el: Element) -> None:
@@ -250,6 +281,8 @@ class SeqObject:
         el.block = None
         if not b.els:
             self.blocks.remove(b)
+        elif self._block_key(el) == b.min_key:
+            b.min_key = min(map(self._block_key, b.els))
 
     def block_vis_delta(self, el: Element, dvis: int, dwidth: int) -> None:
         b = el.block
@@ -272,6 +305,9 @@ class SeqObject:
             if w is not None:
                 b.vis += 1
                 b.width += w.text_width()
+            key = self._block_key(el)
+            if b.min_key is None or key < b.min_key:
+                b.min_key = key
             el = el.next
         self.visible_len = sum(x.vis for x in self.blocks)
         self.text_width = sum(x.width for x in self.blocks)
@@ -363,7 +399,11 @@ class OpStore:
             return
         if op.id in self.objects:
             return
-        data = MapObject(t) if t in (ObjType.MAP, ObjType.TABLE) else SeqObject(t)
+        data = (
+            MapObject(t)
+            if t in (ObjType.MAP, ObjType.TABLE)
+            else SeqObject(t, self.actors)
+        )
         # For insert-created objects the element id is the make op's own id
         # (op.elem is only the RGA reference element it was inserted after).
         parent_elem = op.id if op.insert else op.elem
@@ -430,10 +470,11 @@ class OpStore:
             if ref is None:
                 raise OpStoreError(f"insert references missing element {op.elem}")
         # RGA: skip sibling elements with greater insert-op id
-        # (reference: query/opid.rs SimpleOpIdSearch).
-        after = ref.next
-        while after is not None and self.lamport_lt(op.id, after.op.id):
-            after = after.next
+        # (reference: query/opid.rs SimpleOpIdSearch). Whole blocks whose
+        # minimum id exceeds ours are skipped in O(1) via the index —
+        # without this, dense concurrency (many replicas inserting at the
+        # same anchors) makes the element-wise scan quadratic.
+        after = self._rga_skip(obj, ref.next, op.id)
         el = Element(op)
         prev = after.prev if after is not None else obj.tail
         el.prev = prev
@@ -449,6 +490,29 @@ class OpStore:
             obj.visible_len += 1
             obj.text_width += op.text_width()
 
+    def _rga_skip(self, obj: SeqObject, after, op_id: OpId):
+        """First element at/after ``after`` whose insert-op id is less than
+        ``op_id`` (Lamport); None past the end."""
+        key = self.lamport_key(op_id)
+        while after is not None:
+            b = after.block
+            if b is None:  # not indexed (shouldn't happen); element-wise
+                if not self.lamport_lt(op_id, after.op.id):
+                    return after
+                after = after.next
+                continue
+            i = b.els.index(after)
+            if i == 0 and b.min_key is not None and key < b.min_key:
+                after = b.els[-1].next  # every id in the block is greater
+                continue
+            n = len(b.els)
+            while i < n:
+                el2 = b.els[i]
+                if not self.lamport_lt(op_id, el2.op.id):
+                    return el2
+                i += 1
+            after = b.els[n - 1].next
+
     def _insert_seq_update(self, obj: SeqObject, op: Op) -> None:
         if op.elem is None:
             raise OpStoreError("seq update without element id")
@@ -456,6 +520,7 @@ class OpStore:
         if el is None:
             raise OpStoreError(f"op targets missing element {op.elem}")
         before_vis, before_w = self._elem_visibility(el)
+        el.dirty_winner()
         pred = set(op.pred)
         for existing in el.run():
             if existing.id in pred:
@@ -513,6 +578,7 @@ class OpStore:
                 el = obj.by_id.get(op.elem)
                 if el is not None:
                     before_vis, before_w = self._elem_visibility(el)
+                    el.dirty_winner()
                     for existing in el.run():
                         if existing.id in op.pred:
                             self.remove_succ(existing, op)
@@ -701,6 +767,38 @@ class OpStore:
             w = el.winner(clock)
             if w is not None:
                 yield el, w
+
+    def visible_elements_range(
+        self, obj_id: OpId, start: int, end: Optional[int] = None, clock=None
+    ) -> Iterator[Tuple[Element, Op]]:
+        """Visible (element, winner) pairs for list indices in [start, end).
+
+        Current-state reads resolve ``start`` through the block index and
+        walk only the requested span instead of rendering the whole list
+        (reference: read.rs list_range's bounded ListRange iterator)."""
+        start = max(start, 0)
+        if end is not None and end <= start:
+            return
+        if clock is not None:
+            idx = 0
+            for el, w in self.visible_elements(obj_id, clock):
+                if end is not None and idx >= end:
+                    return
+                if idx >= start:
+                    yield el, w
+                idx += 1
+            return
+        el = self.nth(obj_id, start, LIST_ENC, None)
+        idx = start
+        while el is not None:
+            if el.op is not None:
+                w = el.winner()
+                if w is not None:
+                    if end is not None and idx >= end:
+                        return
+                    yield el, w
+                    idx += 1
+            el = el.next
 
     def text(self, obj_id: OpId, clock=None) -> str:
         parts = []

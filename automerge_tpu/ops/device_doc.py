@@ -347,6 +347,71 @@ class DeviceDoc:
         vals = self.get_all(obj, prop, heads)
         return vals[-1] if vals else None
 
+    def map_range(self, obj: str = "_root", start=None, end=None, heads=None):
+        """(key, value, id) for map keys in [start, end) (read.rs map_range)."""
+        from ..utils.ranges import filter_map_range
+
+        return filter_map_range(self.map_entries(obj, heads=heads), start, end)
+
+    def list_range(self, obj: str, start: int = 0, end=None, heads=None):
+        """(index, value, id) for indices in [start, end) (read.rs list_range).
+        Renders only the requested rows of the materialized element order."""
+        view = self._view(heads)
+        ok = view.log.import_id(obj)
+        view._check_obj(ok)
+        elems = view._seq_elems(ok)
+        stop = len(elems) if end is None else min(end, len(elems))
+        return [
+            (
+                i,
+                view._render(elems[i][1]),
+                view.log.export_id(int(view.log.id_key[elems[i][1]])),
+            )
+            for i in range(max(start, 0), stop)
+        ]
+
+    def values(self, obj: str = "_root", heads=None):
+        """Winner (value, id) pairs (read.rs values)."""
+        view = self._view(heads)
+        ok = view.log.import_id(obj)
+        t = view._check_obj(ok)
+        if t in (ObjType.MAP, ObjType.TABLE):
+            return [(val, vid) for _, val, vid in view.map_entries(obj)]
+        return view.list_items(obj)
+
+    def parents(self, obj: str) -> List[Tuple[str, object]]:
+        """Path from ``obj`` up to the root (read.rs parents): walks the
+        make ops' containing objects through the log columns."""
+        log = self.log
+        key = log.import_id(obj)
+        self._check_obj(key)
+        path: List[Tuple[str, object]] = []
+        while key != 0:
+            row = log.row_of_id(key)
+            parent_key = int(log.obj_key[row])
+            parent_exid = log.export_id(parent_key)
+            p = int(log.prop[row])
+            if p >= 0:
+                path.append((parent_exid, log.props[p]))
+            else:
+                # element ordinal among VISIBLE elements (1 each, matching
+                # Document._elem_index); None when the element is invisible
+                base = self._base
+                er = row if log.insert[row] else int(log.elem_ref[row])
+                self._check_obj(parent_key)
+                idx = 0
+                found = None
+                for r in base._all_elems(parent_key):
+                    visible = int(self.winner[r]) >= 0
+                    if r == er:
+                        found = idx if visible else None
+                        break
+                    if visible:
+                        idx += 1
+                path.append((parent_exid, found))
+            key = parent_key
+        return path
+
     # -- cursors (reference: cursor.rs, automerge.rs seek_opid) -------------
 
     def get_cursor(self, obj: str, position: int, heads=None) -> str:

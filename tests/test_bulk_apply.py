@@ -178,3 +178,36 @@ def test_extract_trailing_empty_change():
     log = OpLog.from_changes(changes + [empty], fast=True)
     log2 = OpLog.from_changes(changes + [empty], fast=False)
     assert log.n == log2.n
+
+
+def test_device_bulk_engine_matches_native(monkeypatch):
+    """The device-kernel element-order export (bulk_load._export_via_device)
+    rebuilds the exact same op store as the native sequential integrate on
+    a dense-concurrency history."""
+    base = AutoDoc(actor=ActorId(bytes([1]) * 16))
+    t = base.put_object("_root", "t", ObjType.TEXT)
+    base.splice_text(t, 0, 0, "seed text for dense concurrency ")
+    base.put("_root", "votes", ScalarValue("counter", 0))
+    base.commit()
+    changes = list(base.get_changes([]))
+    for i in range(20):
+        f = base.fork(actor=ActorId(bytes([10 + i]) * 16))
+        f.splice_text(t, (i * 3) % f.length(t), 1 if i % 4 == 0 else 0, f"[{i}]")
+        f.increment("_root", "votes", i)
+        f.commit()
+        changes.extend(f.get_changes(base.get_heads()))
+
+    monkeypatch.setenv("AUTOMERGE_TPU_DEBUG", "1")
+    docs = {}
+    for engine in ("native", "device"):
+        monkeypatch.setenv("AUTOMERGE_TPU_BULK", engine)
+        d = AutoDoc(actor=ActorId(bytes([99]) * 16))
+        # force the bulk path regardless of size thresholds
+        monkeypatch.setattr(Document, "BULK_MIN_OPS", 1)
+        d.apply_changes(changes)
+        docs[engine] = d
+    assert docs["native"].hydrate() == docs["device"].hydrate()
+    assert docs["native"].get_heads() == docs["device"].get_heads()
+    assert docs["native"].text(t) == docs["device"].text(t)
+    tid = docs["native"].get("_root", "t")[0][2]
+    assert docs["native"].marks(tid) == docs["device"].marks(tid)

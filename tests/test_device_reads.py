@@ -206,3 +206,37 @@ def test_randomized_fork_merge_history_parity():
         patches = dev.diff(before, after)
         want = host.hydrate(heads=after) if after is not None else host.hydrate()
         assert apply_patches(host.hydrate(heads=before), patches) == want
+
+
+def test_range_readers_and_parents_parity():
+    """map_range/list_range/values/parents agree host vs device
+    (reference: read.rs:32-117)."""
+    doc = AutoDoc(actor=ActorId(bytes([5]) * 16))
+    for k, v in [("alpha", 1), ("beta", 2), ("gamma", 3), ("delta", 4)]:
+        doc.put("_root", k, v)
+    lst = doc.put_object("_root", "lst", ObjType.LIST)
+    for i, v in enumerate([10, 20, 30, 40]):
+        doc.insert(lst, i, v)
+    inner = doc.insert_object(lst, 2, ObjType.MAP)
+    doc.put(inner, "deep", True)
+    doc.commit()
+    dev = DeviceDoc.merge([doc])
+
+    assert doc.map_range("_root", "b", "g") == dev.map_range("_root", "b", "g")
+    assert [k for k, _, _ in doc.map_range("_root", "b", "g")] == ["beta", "delta"]
+    assert doc.list_range(lst, 1, 3) == dev.list_range(lst, 1, 3)
+    assert len(doc.list_range(lst, 1, 3)) == 2
+    # bounded-walk edge cases: end past length, start past length, open end
+    assert doc.list_range(lst, 3, 99) == dev.list_range(lst, 3, 99)
+    assert doc.list_range(lst, 99) == [] == dev.list_range(lst, 99)
+    assert doc.list_range(lst) == dev.list_range(lst)
+    assert [i for i, _, _ in doc.list_range(lst)] == [0, 1, 2, 3, 4]
+    assert doc.values("_root") == dev.values("_root")
+    assert doc.values(lst) == dev.values(lst)
+    assert doc.parents(inner) == dev.parents(inner)
+    assert dev.parents(inner) == [(lst, 2), ("_root", "lst")]
+    # historical list_range at pre-insert heads
+    heads0 = doc.get_heads()
+    doc.insert(lst, 0, 99)
+    doc.commit()
+    assert doc.list_range(lst, 0, 2, heads=heads0) == dev.list_range(lst, 0, 2)[:2]
