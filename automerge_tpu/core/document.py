@@ -96,6 +96,9 @@ class Document:
         self.deps: Set[bytes] = set()
         self.change_graph = ChangeGraph()
         self.max_op = 0
+        # exid-string -> OpId memo: actor interning is append-only, so a
+        # resolved id never changes (misses are NOT cached)
+        self._exid_cache: Dict[str, OpId] = {}
         # live manual transactions (registered by Transaction); a device
         # merge or save while one is open would silently miss its ops.
         # Weak refs: an abandoned (unreachable, never committed) transaction
@@ -122,6 +125,9 @@ class Document:
     def import_id(self, exid: str) -> OpId:
         if exid == ROOT:
             return ROOT_OBJ
+        hit = self._exid_cache.get(exid)
+        if hit is not None:
+            return hit
         try:
             ctr_s, actor_hex = exid.split("@", 1)
             ctr = int(ctr_s)
@@ -130,7 +136,9 @@ class Document:
             raise AutomergeError(f"invalid object id {exid!r}") from e
         if idx is None:
             raise AutomergeError(f"object id {exid!r} references unknown actor")
-        return (ctr, idx)
+        opid = (ctr, idx)
+        self._exid_cache[exid] = opid
+        return opid
 
     def import_obj(self, exid: str) -> OpId:
         obj = self.import_id(exid)

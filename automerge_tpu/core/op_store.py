@@ -128,7 +128,7 @@ class Op:
 class Element:
     """A sequence element: its defining insert op plus overwriting ops."""
 
-    __slots__ = ("op", "updates", "prev", "next", "block", "_wcache")
+    __slots__ = ("op", "updates", "prev", "next", "block", "_wcache", "lkey")
 
     def __init__(self, op: Optional[Op]):
         self.op = op  # None only for the head sentinel
@@ -136,6 +136,7 @@ class Element:
         self.prev: Optional["Element"] = None
         self.next: Optional["Element"] = None
         self.block: Optional["Block"] = None
+        self.lkey = None  # cached (ctr, actor-bytes) Lamport key
         # cached current-state winner: () = dirty, (op_or_None,) = valid.
         # Walks touch every element ~hundreds of times between visibility
         # changes; recomputing visible_ops each time dominated the replay
@@ -181,7 +182,7 @@ class Block:
     query/list_state.rs:76-120), in flat-block form.
     """
 
-    __slots__ = ("els", "vis", "width", "min_key")
+    __slots__ = ("els", "vis", "width", "min_key", "marks")
 
     def __init__(self):
         self.els: List[Element] = []
@@ -191,6 +192,9 @@ class Block:
         # RGA sibling skip scan jump whole blocks whose every element has a
         # greater Lamport id (the dense-concurrency quadratic case)
         self.min_key = None
+        # count of mark begin/end elements: blocks with vis == 0 and
+        # marks == 0 are skippable wholesale by insert-reference scans
+        self.marks = 0
 
 
 # block split threshold: nth costs O(#blocks + BLOCK_MAX); with ~n/128
@@ -228,8 +232,12 @@ class SeqObject:
     # -- block index maintenance ------------------------------------------
 
     def _block_key(self, el: Element):
-        opid = el.op.id
-        return (opid[0], self.actors.get(opid[1]).bytes)
+        k = el.lkey
+        if k is None:
+            opid = el.op.id
+            k = (opid[0], self.actors.get(opid[1]).bytes)
+            el.lkey = k
+        return k
 
     def block_insert_after(self, prev: Element, el: Element) -> None:
         """Register ``el`` (just linked after ``prev``) in the block index."""
@@ -246,6 +254,8 @@ class SeqObject:
         if w is not None:
             b.vis += 1
             b.width += w.text_width()
+        if el.op.is_mark:
+            b.marks += 1
         key = self._block_key(el)
         if b.min_key is None or key < b.min_key:
             b.min_key = key
@@ -263,8 +273,11 @@ class SeqObject:
             if w is not None:
                 nb.vis += 1
                 nb.width += w.text_width()
+            if el.op.is_mark:
+                nb.marks += 1
         b.vis -= nb.vis
         b.width -= nb.width
+        b.marks -= nb.marks
         b.min_key = min(map(self._block_key, b.els)) if b.els else None
         nb.min_key = min(map(self._block_key, nb.els)) if nb.els else None
         self.blocks.insert(self.blocks.index(b) + 1, nb)
@@ -277,6 +290,8 @@ class SeqObject:
         if w is not None:
             b.vis -= 1
             b.width -= w.text_width()
+        if el.op.is_mark:
+            b.marks -= 1
         b.els.remove(el)
         el.block = None
         if not b.els:
@@ -305,12 +320,31 @@ class SeqObject:
             if w is not None:
                 b.vis += 1
                 b.width += w.text_width()
+            if el.op.is_mark:
+                b.marks += 1
             key = self._block_key(el)
             if b.min_key is None or key < b.min_key:
                 b.min_key = key
             el = el.next
         self.visible_len = sum(x.vis for x in self.blocks)
         self.text_width = sum(x.width for x in self.blocks)
+
+    def next_visible_from(self, el: Optional[Element]) -> Optional[Element]:
+        """First CURRENT-STATE-visible element strictly after ``el``
+        (None = from HEAD). Whole blocks with no visible elements are
+        skipped via the index — tombstone runs cost O(#blocks crossed),
+        not O(run length) (the never_seen_puts fast path's role,
+        reference query/list_state.rs:73-97)."""
+        cur = el.next if el is not None else self.head.next
+        while cur is not None:
+            b = cur.block
+            if b is not None and b.vis == 0:
+                cur = b.els[-1].next
+                continue
+            if cur.winner() is not None:
+                return cur
+            cur = cur.next
+        return None
 
     def seed_cursor(self, el, at: int, encoding: int) -> None:
         """Re-seed the position cursor after local edits (the analogue of

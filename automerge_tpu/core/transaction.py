@@ -290,7 +290,16 @@ class Transaction:
             floor = anchor.elem_id
             cur = anchor.next
         candidates = []  # mark elements pushing the insertion point right
+        current = self.scope is None
         while cur is not None:
+            # tombstone runs: jump whole blocks with no visible and no mark
+            # elements (only valid against current state, not an isolation
+            # clock — a scoped read may see through current tombstones)
+            if current:
+                b = cur.block
+                if b is not None and b.vis == 0 and b.marks == 0:
+                    cur = b.els[-1].next
+                    continue
             if cur.winner(self.scope) is not None:
                 break  # next visible element: insert lands before it
             op = cur.op
@@ -385,6 +394,8 @@ class Transaction:
             anchor_at = obj._cursor[1 if enc == LIST_ENC else 2] if obj._cursor else None
 
         def next_visible(el):
+            if self.scope is None:
+                return obj.next_visible_from(el)
             el = el.next if el is not None else obj.head.next
             while el is not None and el.winner(self.scope) is None:
                 el = el.next
