@@ -315,9 +315,11 @@ def merge_kernel_core(c):
 #   out: one flat int32 vector, the requested per-row outputs concatenated;
 #        boolean outputs bit-packed 32/word; per-object stats truncated to
 #        a bucketed object capacity on device
-# Linearization runs on device (device_linearize) — fetching the walk
-# arrays for the native host walk would cost 12 B/op, more than the whole
-# ranking pass is worth over this link.
+# Linearization (elem_index) is computed HOST-side by host_linearize from
+# the same numpy columns, overlapped with the device kernel — element
+# order depends only on the insert forest, so it needs neither the merge
+# results nor any extra transfer. device_linearize remains for the
+# pure-device flow (multi-chip dry run, no native core).
 
 _F_ACTION = 15
 _F_INSERT = 1 << 4
@@ -512,6 +514,51 @@ def _runs_fn(fetch, obj_cap, static_key, P, Q):
     return f
 
 
+def host_linearize(cols_np) -> np.ndarray:
+    """Document-order element indices computed host-side from the numpy
+    columns, overlapping the device kernel.
+
+    Element order depends ONLY on the insert forest (elem_ref / insert /
+    obj_dense) — never on visibility (historical views of one log share
+    one element order) — so the host can rank it from the same arrays it
+    just uploaded, with zero extra device traffic: a lexsort builds the
+    sibling lists (descending Lamport = descending row,
+    reference query/insert.rs) and the native preorder walk ranks them.
+    """
+    from .. import native
+
+    action = np.asarray(cols_np["action"])
+    P = len(action)
+    insert = np.asarray(cols_np["insert"]).astype(bool) & (action != PAD_ACTION)
+    elem_ref = np.asarray(cols_np["elem_ref"])
+    obj_dense = np.asarray(cols_np["obj_dense"])
+    N = 2 * P + 3
+    S = N - 1
+    parent_row = np.where(
+        insert,
+        np.where(
+            elem_ref == ELEM_HEAD,
+            P + obj_dense,
+            np.where(elem_ref >= 0, elem_ref, S),
+        ),
+        S,
+    ).astype(np.int32)
+    er = np.flatnonzero(insert).astype(np.int32)
+    order = np.lexsort((-er, parent_row[er]))
+    sp = parent_row[er][order]
+    sr = er[order]
+    first_child = np.full(N, -1, np.int32)
+    next_sib = np.full(N, -1, np.int32)
+    if len(sr):
+        first = np.concatenate([[True], sp[1:] != sp[:-1]])
+        first_child[sp[first]] = sr[first]
+        same = np.concatenate([sp[1:] == sp[:-1], [False]])
+        nxt = np.concatenate([sr[1:], np.array([-1], np.int32)])
+        next_sib[sr] = np.where(same, nxt, -1)
+    elem_index = native.preorder_index(first_child, next_sib, parent_row, P)
+    return np.where(insert, elem_index, np.int32(-1))
+
+
 _packed_cache = {}
 
 
@@ -542,18 +589,35 @@ def _split_flat(flat, fetch, P, obj_cap):
 
 
 def _packed_merge(cols_np, fetch, n_objs):
+    from .. import native
+
     P = len(cols_np["action"])
     Q = len(cols_np["pred_src"])
     obj_cap = min(_next_pow2(max((n_objs or P) + 2, 16)), P + 2)
     fetch = tuple(fetch)
 
+    # element order never needs the device (host_linearize): computing it
+    # host-side while the kernel runs removes the two pointer-doubling
+    # gather loops (the kernel's dominant cost) AND 4 B/op of readback
+    host_elem = "elem_index" in fetch and native.preorder_available()
+    dev_fetch = (
+        tuple(k for k in fetch if k != "elem_index") if host_elem else fetch
+    )
+    if not dev_fetch:  # pure-linearization call: no device work at all
+        return {"elem_index": host_linearize(cols_np)}
+
     static_key, arrays = encode_transport(cols_np)
-    key = (fetch, obj_cap, static_key, P, Q)
+    key = (dev_fetch, obj_cap, static_key, P, Q)
     fn = _packed_cache.get(key)
     if fn is None:
-        fn = _packed_cache[key] = _runs_fn(fetch, obj_cap, static_key, P, Q)
-    flat = np.asarray(fn({k: jnp.asarray(v) for k, v in arrays.items()}))
-    return _split_flat(flat, fetch, P, obj_cap)
+        fn = _packed_cache[key] = _runs_fn(dev_fetch, obj_cap, static_key, P, Q)
+    flat_dev = fn({k: jnp.asarray(v) for k, v in arrays.items()})  # async
+    elem_index = host_linearize(cols_np) if host_elem else None
+    flat = np.asarray(flat_dev)
+    out = _split_flat(flat, dev_fetch, P, obj_cap)
+    if host_elem:
+        out["elem_index"] = elem_index
+    return out
 
 
 ALL_OUTPUTS = (
@@ -618,14 +682,10 @@ def merge_columns(cols_np, linearize: str = "auto", fetch=None, n_objs=None):
 
     if linearize == "native":
         out = merge_kernel_core(cols)
-        walk_keys = {"first_child", "next_sib", "parent_row"}
-        host = pull(out, (need - {"elem_index"}) | walk_keys)
+        host = pull(out, need - {"elem_index"})
         if "elem_index" in need:
-            # node space is [0,P) elements + [P,2P+2) roots + sentinel
-            P = (len(host["first_child"]) - 3) // 2
-            host["elem_index"] = native.preorder_index(
-                host["first_child"], host["next_sib"], host["parent_row"], P
-            )
-        return {k: v for k, v in host.items() if k in need or k in walk_keys}
+            # ranked from the host-resident columns — zero device traffic
+            host["elem_index"] = host_linearize(cols_np)
+        return host
     out = merge_kernel(cols)
     return pull(out, need)
