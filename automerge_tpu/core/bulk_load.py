@@ -35,8 +35,47 @@ def flatten_changes(changes: Sequence) -> Dict[str, object]:
 
     Ids pack as (counter << 20 | byte-sorted actor rank) so int64 order is
     lamport_cmp (types.rs:517-521). Returns the arrays am_seq_apply
-    consumes plus the rank table.
+    consumes plus the rank table. Uses the native batch column decoder
+    when every change retains its column bytes; falls back to the per-op
+    Python walk otherwise.
     """
+    import os
+
+    try:
+        return _flatten_fast(changes)
+    except Exception:
+        if os.environ.get("AUTOMERGE_TPU_DEBUG"):
+            raise
+        return _flatten_slow(changes)
+
+
+def _flatten_fast(changes: Sequence) -> Dict[str, object]:
+    """Vectorized flatten: native batch column decode + rank translation
+    via the shared ops/extract.ranked_batch helper."""
+    from ..ops.extract import ranked_batch
+
+    actor_bytes = sorted({bytes(a) for ch in changes for a in ch.actors})
+    rank_of = {a: i for i, a in enumerate(actor_bytes)}
+    if len(actor_bytes) >= (1 << ACTOR_BITS):
+        raise ValueError("too many actors for packed id encoding")
+
+    r = ranked_batch(changes, rank_of)
+    a = r["a"]
+    return {
+        "op_id": r["id_key"].astype(np.int64),
+        "obj": r["obj"].astype(np.int64),
+        "elem": r["elem"].astype(np.int64),
+        "prop": np.where(r["prop_ids"] >= 0, 0, -1).astype(np.int32),
+        "action": a["action"].astype(np.int32),
+        "insert": a["insert"].astype(np.uint8),
+        "is_counter": (a["vcode"] == 8).astype(np.uint8),
+        "pred_off": np.concatenate([[0], np.cumsum(a["pred_num"])]).astype(np.int64),
+        "pred_flat": r["pred_key"].astype(np.int64),
+        "rank_of": rank_of,
+    }
+
+
+def _flatten_slow(changes: Sequence) -> Dict[str, object]:
     actor_bytes = sorted({bytes(a) for ch in changes for a in ch.actors})
     rank_of = {a: i for i, a in enumerate(actor_bytes)}
     if len(actor_bytes) >= (1 << ACTOR_BITS):
@@ -170,7 +209,7 @@ def rebuild_op_store(doc) -> None:
     ops: List[Op] = [None] * n
     objs_of: List[Tuple[int, int]] = [None] * n  # (obj ctr, obj doc-idx)
     row = 0
-    sort_key = doc.ops.lamport_key
+    sort_key = doc._ops.lamport_key  # direct: doc.ops may be mid-rebuild
     for ch in stored:
         amap = [doc.actors.cache(ActorId(a)) for a in ch.actors]
         author = amap[0]
@@ -224,6 +263,21 @@ def rebuild_op_store(doc) -> None:
         if s.is_inc and t.is_counter:
             t.incs.append((s.id, s.value.value))
 
+    # ---- per-row current-state visibility (vectorized Op.visible) ---------
+    act = flat["action"]
+    succ_n = np.zeros(n, np.int64)
+    inc_n = np.zeros(n, np.int64)
+    if len(tgt_rows):
+        np.add.at(succ_n, tgt_rows, 1)
+        inc_edge = (act[src_rows] == int(Action.INCREMENT)) & (
+            (act[tgt_rows] == int(Action.PUT)) & (flat["is_counter"][tgt_rows] != 0)
+        )
+        if inc_edge.any():
+            np.add.at(inc_n, tgt_rows[inc_edge], 1)
+    counter_row = (act == int(Action.PUT)) & (flat["is_counter"] != 0)
+    never = np.isin(act, (int(Action.DELETE), int(Action.INCREMENT), int(Action.MARK)))
+    vis = ~never & np.where(counter_row, succ_n <= inc_n, succ_n == 0)
+
     # ---- object registry --------------------------------------------------
     store = OpStore(doc.actors)
     make_rows = np.flatnonzero(np.isin(flat["action"], (0, 2, 4, 6)))
@@ -262,8 +316,12 @@ def rebuild_op_store(doc) -> None:
         obj_data = info.data
         prev = obj_data.head
         for r in elem_rows[int(obj_off[k]) : int(obj_off[k + 1])]:
-            op = ops[int(r)]
+            r = int(r)
+            op = ops[r]
             el = Element(op)
+            # pre-seed the winner cache from the vectorized visibility —
+            # rebuild_blocks then aggregates without recomputing runs
+            el._wcache = (op,) if vis[r] else (None,)
             el.prev = prev
             prev.next = el
             prev = el
@@ -286,6 +344,8 @@ def rebuild_op_store(doc) -> None:
             if el is None:
                 raise ValueError("seq update targets missing element")
             el.updates.append(op)
+            if vis[r]:  # ascending Lamport: the last visible wins
+                el._wcache = (op,)
 
     # ---- visibility counters + block index (one sweep) ---------------------
     for info in store.objects.values():

@@ -88,7 +88,8 @@ class Document:
         self.actor = actor or ActorId()
         self.actors: IndexedCache[ActorId] = IndexedCache()
         self.props: IndexedCache[str] = IndexedCache()
-        self.ops = OpStore(self.actors)
+        self._ops = OpStore(self.actors)
+        self._ops_stale = False
         self.history: List[AppliedChange] = []
         self.history_index: Dict[bytes, int] = {}
         self.states: Dict[int, List[int]] = {}
@@ -106,6 +107,36 @@ class Document:
         import weakref
 
         self.open_transactions = weakref.WeakSet()
+
+    # -- op store (lazily materialized) ------------------------------------
+    #
+    # The change history is the document's source of truth; the op store is
+    # a materialized view of it. Bulk applies (merge / sync catch-up / fork)
+    # only mark the view stale — the first read or local edit rebuilds it
+    # once, so K consecutive bulk applies pay ONE rebuild, not K. This is
+    # the host-side mirror of the device design (op columns are derived
+    # from changes on demand); the reference has no analogue because its
+    # reads and writes share the eagerly-maintained B-tree (op_set.rs:28).
+
+    @property
+    def ops(self) -> OpStore:
+        if self._ops_stale:
+            self._materialize_ops()
+        return self._ops
+
+    @ops.setter
+    def ops(self, store: OpStore) -> None:
+        self._ops = store
+        self._ops_stale = False
+
+    def _materialize_ops(self) -> None:
+        from .bulk_load import rebuild_op_store
+
+        self._ops_stale = False  # cleared first: rebuild reads doc state
+        try:
+            rebuild_op_store(self)
+        except Exception:
+            self._rebuild_slow()
 
     # -- identity ----------------------------------------------------------
 
@@ -211,11 +242,9 @@ class Document:
         """History bookkeeping per change, one native op-store rebuild.
 
         Same causal-queue / dup-seq semantics as the incremental path; the
-        op store is rebuilt from the full history afterwards
-        (core/bulk_load.py), so per-op python apply never runs.
+        op store is marked stale and rebuilt from the full history on the
+        next read (core/bulk_load.py), so per-op python apply never runs.
         """
-        from .bulk_load import rebuild_op_store
-
         ready: List[StoredChange] = []
         pending: List[StoredChange] = []
         seen_hashes = set()
@@ -262,10 +291,8 @@ class Document:
         for change in ready:
             actor_map = [self.actors.cache(ActorId(a)) for a in change.actors]
             self._update_history(AppliedChange(change, actor_map[0], actor_map))
-        try:
-            rebuild_op_store(self)
-        except Exception:
-            self._rebuild_slow()
+        # defer the op-store rebuild to the first read/edit (see `ops`)
+        self._ops_stale = True
 
     def _rebuild_slow(self) -> None:
         """Correctness fallback: replay the whole history through the
@@ -322,6 +349,11 @@ class Document:
     def _apply_change(self, change: StoredChange) -> None:
         actor_map = [self.actors.cache(ActorId(a)) for a in change.actors]
         applied = AppliedChange(change, actor_map[0], actor_map)
+        if self._ops_stale:
+            # the store is already due a full rebuild from history — fold
+            # this change into it instead of materializing mid-apply
+            self._update_history(applied)
+            return
         ops = self._import_ops(change, actor_map)
         self._update_history(applied)
         for obj_id, op in ops:

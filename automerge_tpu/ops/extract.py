@@ -383,6 +383,79 @@ def batch_arrays(changes) -> Dict[str, object]:
     }
 
 
+ACTOR_BITS = 20  # packed id layout: counter << 20 | byte-sorted actor rank
+
+
+def ranked_batch(changes, rank_of) -> Dict[str, object]:
+    """batch_arrays + packed-id rank translation, shared by the device log
+    (ops/oplog.py) and the host bulk rebuild (core/bulk_load.py).
+
+    Returns the raw batch under ``"a"`` plus the translated columns:
+    ``id_key`` (per-op packed id), ``obj`` (0 = root), ``prop_ids``
+    (string-table id, -1 = seq key), ``elem`` (-1 = map op, 0 = HEAD,
+    else packed id), ``pred_src`` (source row per pred edge) and
+    ``pred_key`` (packed pred target). Raises ExtractError when a
+    chunk-local actor index exceeds its change's actor table.
+    """
+    a = batch_arrays(changes)
+    N = a["n"]
+    nc = len(changes)
+    cor = a["change_of_row"]
+    tab = np.asarray(
+        [rank_of[bytes(x)] for ch in changes for x in ch.actors], np.int64
+    )
+    tab_off = np.concatenate(
+        [[0], np.cumsum([len(ch.actors) for ch in changes])]
+    )[:-1].astype(np.int64)
+    row_tab = tab_off[cor]
+    author = tab[tab_off] if nc else np.empty(0, np.int64)
+    start_op = np.asarray([ch.start_op for ch in changes], np.int64)
+    tab_size = np.asarray([len(ch.actors) for ch in changes], np.int64)
+    if N and (
+        np.any(a["obj_actor"][a["obj_has"]] >= tab_size[cor][a["obj_has"]])
+        or np.any(
+            a["key_actor"][a["key_has_actor"]] >= tab_size[cor][a["key_has_actor"]]
+        )
+    ):
+        raise ExtractError("actor index out of chunk-local table range")
+
+    within = np.arange(N, dtype=np.int64) - a["row_off"][:-1][cor]
+    id_key = ((start_op[cor] + within) << ACTOR_BITS) | author[cor]
+    clip = max(len(tab) - 1, 0)
+    obj = np.where(
+        a["obj_has"],
+        (a["obj_ctr"] << ACTOR_BITS) | tab[(row_tab + a["obj_actor"]).clip(max=clip)],
+        np.int64(0),
+    )
+    prop_ids = a["key_ids"] if a["key_ids"] is not None else np.full(N, -1, np.int32)
+    elem = np.where(
+        prop_ids >= 0,
+        np.int64(-1),
+        np.where(
+            a["key_has_actor"],
+            (a["key_ctr"] << ACTOR_BITS) | tab[(row_tab + a["key_actor"]).clip(max=clip)],
+            np.int64(0),  # HEAD (ctr 0, no actor)
+        ),
+    )
+    pred_src = np.repeat(np.arange(N, dtype=np.int64), a["pred_num"])
+    per_change_preds = np.diff(a["pred_row_off"])
+    cop = np.repeat(np.arange(nc), per_change_preds)
+    if len(cop) and np.any(a["pred_actor"] >= tab_size[cop]):
+        raise ExtractError("pred actor index out of chunk-local table range")
+    pred_key = (a["pred_ctr"] << ACTOR_BITS) | tab[
+        (tab_off[cop] + a["pred_actor"]).clip(max=clip)
+    ]
+    return {
+        "a": a,
+        "id_key": id_key,
+        "obj": obj,
+        "prop_ids": prop_ids,
+        "elem": elem,
+        "pred_src": pred_src,
+        "pred_key": pred_key,
+    }
+
+
 def _padded(vals: np.ndarray, mask: np.ndarray, n: int):
     if len(vals) > n:
         raise ExtractError("column longer than op count")

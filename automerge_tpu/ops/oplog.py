@@ -245,75 +245,26 @@ class OpLog:
         The native core decodes every change's op columns in one pass per
         column kind (native/extract_batch.cpp) — including string interning
         for map keys / mark names — then actor indices are rank-translated
-        with a single table gather before the shared Lamport sort. No
-        per-change Python or FFI work at all.
+        with a single table gather (extract.ranked_batch, shared with the
+        host bulk rebuild) before the shared Lamport sort. No per-change
+        Python or FFI work at all.
         """
-        from .extract import batch_arrays
+        from .extract import ranked_batch
 
-        a = batch_arrays(deduped)
+        r = ranked_batch(deduped, rank_of)
+        a = r["a"]
         N = a["n"]
-        nc = len(deduped)
-        cor = a["change_of_row"]
-
-        # concatenated chunk-local -> global rank table, one gather per column
-        tab = np.asarray(
-            [rank_of[bytes(x)] for ch in deduped for x in ch.actors], np.int64
-        )
-        tab_off = np.concatenate(
-            [[0], np.cumsum([len(ch.actors) for ch in deduped])]
-        )[:-1].astype(np.int64)
-        row_tab = tab_off[cor]
-        author = tab[tab_off] if nc else np.empty(0, np.int64)
-        start_op = np.asarray([ch.start_op for ch in deduped], np.int64)
-
-        from .extract import ExtractError
-
-        tab_size = np.asarray([len(ch.actors) for ch in deduped], np.int64)
-        if N and (
-            np.any(a["obj_actor"][a["obj_has"]] >= tab_size[cor][a["obj_has"]])
-            or np.any(
-                a["key_actor"][a["key_has_actor"]]
-                >= tab_size[cor][a["key_has_actor"]]
-            )
-        ):
-            raise ExtractError("actor index out of chunk-local table range")
-
-        within = np.arange(N, dtype=np.int64) - a["row_off"][:-1][cor]
-        id_key = ((start_op[cor] + within) << ACTOR_BITS) | author[cor]
-        obj = np.where(
-            a["obj_has"],
-            (a["obj_ctr"] << ACTOR_BITS) | tab[(row_tab + a["obj_actor"]).clip(max=max(len(tab) - 1, 0))],
-            np.int64(0),
-        )
-        prop = a["key_ids"] if a["key_ids"] is not None else np.full(N, -1, np.int32)
-        elem = np.where(
-            prop >= 0,
-            np.int64(-1),
-            np.where(
-                a["key_has_actor"],
-                (a["key_ctr"] << ACTOR_BITS) | tab[(row_tab + a["key_actor"]).clip(max=max(len(tab) - 1, 0))],
-                np.int64(0),  # HEAD (ctr 0, no actor)
-            ),
-        )
         mark_idx = (
             a["mark_ids"] if a["mark_ids"] is not None else np.full(N, -1, np.int32)
         )
-        pred_src = np.repeat(np.arange(N, dtype=np.int64), a["pred_num"])
-        per_change_preds = np.diff(a["pred_row_off"])
-        cop = np.repeat(np.arange(nc), per_change_preds)
-        if len(cop) and np.any(a["pred_actor"] >= tab_size[cop]):
-            raise ExtractError("pred actor index out of chunk-local table range")
-        pred_key = (a["pred_ctr"] << ACTOR_BITS) | tab[
-            (tab_off[cop] + a["pred_actor"]).clip(max=max(len(tab) - 1, 0))
-        ]
         log.props = list(a["key_table"])
         log.mark_names = list(a["mark_table"])
         return cls._finalize(
             log,
-            id_key,
-            obj,
-            prop.astype(np.int32),
-            elem,
+            r["id_key"],
+            r["obj"],
+            r["prop_ids"].astype(np.int32),
+            r["elem"],
             a["action"],
             a["insert"],
             np.minimum(a["vcode"], TAG_UNKNOWN).astype(np.int32),
@@ -321,8 +272,8 @@ class OpLog:
             a["width"],
             a["expand"],
             mark_idx.astype(np.int32),
-            pred_src,
-            pred_key,
+            r["pred_src"],
+            r["pred_key"],
             (a["vcode"], a["voff"], a["vlen"], a["vraw"]),
         )
 

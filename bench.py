@@ -230,8 +230,12 @@ def main():
         rounds += 1
         if rounds > 100:
             raise RuntimeError("sync did not converge")
+    # one read inside the timed region: op-store materialization is lazy,
+    # so catch-up isn't "done" until the replica is readable
+    behind_text = behind.text(sbase.text_exid)
     t_sync = time.perf_counter() - t0
     assert behind.get_heads() == ahead.get_heads()
+    assert behind_text == ahead.text(sbase.text_exid)
     sync_rate = n_synced / t_sync
     results["sync"] = {
         "divergence_ops": n_synced,

@@ -211,3 +211,41 @@ def test_device_bulk_engine_matches_native(monkeypatch):
     assert docs["native"].text(t) == docs["device"].text(t)
     tid = docs["native"].get("_root", "t")[0][2]
     assert docs["native"].marks(tid) == docs["device"].marks(tid)
+
+
+def test_flatten_fast_matches_slow():
+    """Vectorized flatten (_flatten_fast, native batch decode) produces
+    byte-identical arrays to the per-op Python walk on a history with
+    marks, counters, deletes, and multi-actor merges."""
+    import numpy as np
+
+    from automerge_tpu.core.bulk_load import _flatten_fast, _flatten_slow
+
+    d = AutoDoc(actor=ActorId(bytes([1]) * 16))
+    t = d.put_object("_root", "t", ObjType.TEXT)
+    d.splice_text(t, 0, 0, "hello world")
+    d.put("_root", "c", ScalarValue("counter", 5))
+    d.mark(t, 0, 5, "bold", True, expand="both")
+    lst = d.put_object("_root", "l", ObjType.LIST)
+    for i in range(8):
+        d.insert(lst, i, i)
+    d.commit()
+    for i in range(6):
+        f = d.fork(actor=ActorId(bytes([10 + i]) * 16))
+        f.splice_text(t, i, 1, "XY")
+        f.increment("_root", "c", i)
+        if f.length(lst) > 0:
+            f.delete(lst, 0)
+        f.commit()
+        d.merge(f)
+    d.splice_text(t, 2, 3, "")
+    d.commit()
+    stored = [a.stored for a in d.doc.history]
+    fa = _flatten_fast(stored)
+    sl = _flatten_slow(stored)
+    for k in (
+        "op_id", "obj", "elem", "prop", "action", "insert", "is_counter",
+        "pred_off", "pred_flat",
+    ):
+        assert np.array_equal(np.asarray(fa[k]), np.asarray(sl[k])), k
+    assert fa["rank_of"] == sl["rank_of"]
