@@ -88,7 +88,15 @@ class AutoDoc:
                 self._tx = self.doc.transaction_at(self._isolation)
             else:
                 self._tx = Transaction(self.doc)
+                # autocommit transactions may route text splices through
+                # the native edit session (core/transaction.py)
+                self._tx.enable_sessions = True
         return self._tx
+
+    def _sync_reads(self) -> None:
+        # pending native-session ops drain into the store before any read
+        if self._tx is not None:
+            self._tx._drain_all()
 
     def commit(self, message: Optional[str] = None, timestamp: Optional[int] = None) -> Optional[bytes]:
         tx = self._tx
@@ -169,6 +177,10 @@ class AutoDoc:
     def splice_text(self, obj: str, pos: int, delete: int, text: str) -> None:
         self._ensure_tx().splice_text(obj, pos, delete, text)
 
+    def splice_text_many(self, obj: str, edits, clamp: bool = True) -> int:
+        """Bulk text ingest: (pos, delete, text) edits in one native pass."""
+        return self._ensure_tx().splice_text_many(obj, edits, clamp=clamp)
+
     def splice(self, obj: str, pos: int, delete: int, values) -> None:
         self._ensure_tx().splice(obj, pos, delete, values)
 
@@ -193,51 +205,71 @@ class AutoDoc:
         return None
 
     def get(self, obj: str, prop, heads=None):
+        self._sync_reads()
         return self.doc.get(obj, prop, clock=self._read_clock(heads))
 
     def get_all(self, obj: str, prop, heads=None):
+        self._sync_reads()
         return self.doc.get_all(obj, prop, clock=self._read_clock(heads))
 
     def keys(self, obj: str = ROOT, heads=None):
+        self._sync_reads()
         return self.doc.keys(obj, clock=self._read_clock(heads))
 
     def length(self, obj: str = ROOT, heads=None) -> int:
+        if heads is None and self._tx is not None and self._tx._sessions:
+            n = self._tx.session_length(self.doc.import_id(obj))
+            if n is not None:
+                return n
+        self._sync_reads()
         return self.doc.length(obj, clock=self._read_clock(heads))
 
     def text(self, obj: str, heads=None) -> str:
+        self._sync_reads()
         return self.doc.text(obj, clock=self._read_clock(heads))
 
     def list_items(self, obj: str, heads=None):
+        self._sync_reads()
         return self.doc.list_items(obj, clock=self._read_clock(heads))
 
     def map_entries(self, obj: str = ROOT, heads=None):
+        self._sync_reads()
         return self.doc.map_entries(obj, clock=self._read_clock(heads))
 
     def hydrate(self, obj: str = ROOT, heads=None):
+        self._sync_reads()
         return self.doc.hydrate(obj, clock=self._read_clock(heads))
 
     def get_cursor(self, obj: str, position: int, heads=None) -> str:
+        self._sync_reads()
         return self.doc.get_cursor(obj, position, clock=self._read_clock(heads))
 
     def get_cursor_position(self, obj: str, cursor: str, heads=None) -> int:
+        self._sync_reads()
         return self.doc.get_cursor_position(obj, cursor, clock=self._read_clock(heads))
 
     def marks(self, obj: str, heads=None):
+        self._sync_reads()
         return self.doc.marks(obj, clock=self._read_clock(heads))
 
     def object_type(self, obj: str) -> ObjType:
+        self._sync_reads()
         return self.doc.object_type(obj)
 
     def map_range(self, obj: str = ROOT, start=None, end=None, heads=None):
+        self._sync_reads()
         return self.doc.map_range(obj, start, end, clock=self._read_clock(heads))
 
     def list_range(self, obj: str, start: int = 0, end=None, heads=None):
+        self._sync_reads()
         return self.doc.list_range(obj, start, end, clock=self._read_clock(heads))
 
     def values(self, obj: str = ROOT, heads=None):
+        self._sync_reads()
         return self.doc.values(obj, clock=self._read_clock(heads))
 
     def parents(self, obj: str):
+        self._sync_reads()
         return self.doc.parents(obj)
 
     # -- history -----------------------------------------------------------

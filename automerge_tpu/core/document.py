@@ -1131,7 +1131,7 @@ def reconstruct_changes(doc: ParsedDocument, verify: bool = True) -> List[Stored
         if start_op < 1:
             raise AutomergeError("change start_op underflow")
         author = meta.actor
-        change_ops, other = chunk_local_ops(
+        change_ops, other, _ = chunk_local_ops(
             ops, author, lambda g: doc.actors[g]
         )
         deps = []

@@ -11,15 +11,17 @@ collisions (reference: automerge.rs isolate_actor).
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from ..storage.change import (
     ChangeOp,
     HEAD_STORED,
+    LazyOps,
     ROOT_STORED,
     StoredChange,
     build_change,
     chunk_local_ops,
+    encode_ops_with_tail,
 )
 from ..types import (
     Action,
@@ -61,6 +63,14 @@ class Transaction:
             scope.isolate(self.actor_idx)
         self.operations: List[Tuple[OpId, Op]] = []
         self._done = False
+        # native text-edit sessions (native/session.cpp): obj_id -> session.
+        # Enabled by AutoDoc; splice_text routes through C++ and ops are
+        # exported in bulk at commit (or drained to the python path when a
+        # non-splice access touches the document mid-transaction).
+        self.enable_sessions = False
+        self._sessions: Dict[OpId, object] = {}
+        self._session_ops = 0
+        self._had_session_ops = False
         doc.open_transactions.add(self)
 
     def __del__(self):
@@ -83,7 +93,10 @@ class Transaction:
     # -- helpers -----------------------------------------------------------
 
     def _next_id(self) -> OpId:
-        return (self.start_op + len(self.operations), self.actor_idx)
+        return (
+            self.start_op + len(self.operations) + self._session_ops,
+            self.actor_idx,
+        )
 
     def _check_open(self) -> None:
         if self._done:
@@ -105,10 +118,188 @@ class Transaction:
             [o.id for o in el.visible_ops(self.scope)]
         )
 
+    # -- native edit sessions ----------------------------------------------
+
+    _ID_RANK_BITS = 20  # packed session ids: ctr << 20 | doc actor index
+
+    def _session_for(self, obj_id: OpId, info):
+        """Existing or newly-eligible native session for ``obj_id``.
+
+        Eligible: sessions enabled (AutoDoc transactions), current-state
+        scope, native core present, TEXT object with no marks and no
+        conflicted (multi-winner) elements, all actor indices < 2^20."""
+        ent = self._sessions.get(obj_id)
+        if ent is not None:
+            return ent[0]
+        if not self.enable_sessions or self.scope is not None:
+            return None
+        from .. import native
+
+        lib = native.load()
+        if lib is None or not hasattr(lib, "am_edit_create"):
+            return None
+        if self.actor_idx >= (1 << self._ID_RANK_BITS):
+            return None
+        data = info.data
+        if any(b.marks for b in data.blocks):
+            return None
+        import numpy as np
+
+        bits = self._ID_RANK_BITS
+        lim = 1 << bits
+        elem_ids: List[int] = []
+        winner_ids: List[int] = []
+        widths: List[int] = []
+        for el in data.elements():
+            vis = el.visible_ops(None)
+            if not vis:
+                continue
+            if len(vis) > 1:
+                return None  # conflicted element: python path handles preds
+            w = vis[0]
+            if el.op.id[1] >= lim or w.id[1] >= lim:
+                return None
+            elem_ids.append((el.op.id[0] << bits) | el.op.id[1])
+            winner_ids.append((w.id[0] << bits) | w.id[1])
+            widths.append(w.text_width())
+        sess = native.EditSession(self.actor_idx)
+        sess.init(
+            np.asarray(elem_ids, np.int64),
+            np.asarray(winner_ids, np.int64),
+            np.asarray(widths, np.int32),
+        )
+        self._sessions[obj_id] = [sess, 0]  # [session, drained watermark]
+        return sess
+
+    def _drain_all(self, drop: bool = False) -> None:
+        """Materialize pending (undrained) session ops through the python
+        per-op path (id order), so the op store reflects them.
+
+        With ``drop=False`` (reads) the session stays live — its element
+        state and the store now agree, and the drained watermark prevents
+        re-materialization; ``drop=True`` (python mutations, which could
+        invalidate session state) closes sessions entirely."""
+        if not self._sessions:
+            return
+        bits = self._ID_RANK_BITS
+        mask = (1 << bits) - 1
+        rows = []  # (id_int, obj_id, export dict, row index)
+        for obj_id, ent in list(self._sessions.items()):
+            e = ent[0].export(ent[1])
+            ent[1] += len(e["id"])
+            if drop:
+                ent[0].close()
+                del self._sessions[obj_id]
+            for k in range(len(e["id"])):
+                rows.append((int(e["id"][k]), obj_id, e, k))
+        self._session_ops = 0
+        rows.sort(key=lambda r: r[0])
+        for id_int, obj_id, e, k in rows:
+            opid = (id_int >> bits, id_int & mask)
+            ref = int(e["elem_ref"][k])
+            elem = HEAD if ref == 0 else (ref >> bits, ref & mask)
+            if e["is_del"][k]:
+                p = int(e["pred"][k])
+                op = Op(
+                    id=opid,
+                    action=Action.DELETE,
+                    value=ScalarValue.null(),
+                    elem=elem,
+                    pred=[(p >> bits, p & mask)],
+                )
+            else:
+                op = Op(
+                    id=opid,
+                    action=Action.PUT,
+                    value=ScalarValue("str", chr(int(e["cp"][k]))),
+                    elem=elem,
+                    insert=True,
+                )
+            self.doc.ops.insert_op(obj_id, op)
+            self.operations.append((obj_id, op))
+
+    def session_length(self, obj_id: OpId) -> Optional[int]:
+        """Width of a session-held object without draining (AutoDoc's
+        length fast path); None when no session holds it."""
+        ent = self._sessions.get(obj_id)
+        return None if ent is None else ent[0].length()
+
+    def _export_change_session(self, obj_id: OpId, ent) -> StoredChange:
+        """Array-native commit: encode the session's undrained tail straight
+        into change columns (storage/change.encode_ops_with_tail) without
+        materializing per-op python objects. Already-drained session ops sit
+        in ``operations`` (lower ids), encoded as prefix rows."""
+        import numpy as np
+
+        doc = self.doc
+        author = self.actor_idx
+        bits = self._ID_RANK_BITS
+        mask = (1 << bits) - 1
+        e = ent[0].export(ent[1])
+        for s2 in self._sessions.values():
+            s2[0].close()
+        self._sessions.clear()
+        self._had_session_ops = True
+
+        refs = e["elem_ref"]
+        preds = e["pred"]
+        extra = set((refs[refs != 0] & mask).tolist())
+        extra |= set((preds[preds != 0] & mask).tolist())
+        extra.add(obj_id[1])
+        rows = self._change_rows()
+        ops_local, other, local = chunk_local_ops(
+            rows, author, lambda g: doc.actors.get(g).bytes,
+            extra_refs=sorted(extra),
+        )
+        lut = np.full(max(local) + 1, -1, np.int64)
+        for g, l in local.items():
+            lut[g] = l
+
+        is_del = e["is_del"]
+        cps = e["cp"]
+        ins = ~is_del
+        raw = (
+            cps[ins].astype("<u4").tobytes().decode("utf-32-le").encode("utf-8")
+            if ins.any()
+            else b""
+        )
+        u8len = (
+            1 + (cps > 0x7F) + (cps > 0x7FF) + (cps > 0xFFFF)
+        ).astype(np.int64)
+        tail = {
+            "obj_ctr": obj_id[0],
+            "obj_actor": local[obj_id[1]],
+            "elem_ctr": (refs >> bits).astype(np.int64),
+            "elem_actor": np.where(refs == 0, -1, lut[refs & mask]).astype(np.int64),
+            "insert": ins.astype(np.uint8),
+            "action": np.where(is_del, int(Action.DELETE), int(Action.PUT)).astype(np.int64),
+            "val_meta": np.where(is_del, 0, (u8len << 4) | 6).astype(np.int64),
+            "val_raw": raw,
+            "pred_ctr": np.where(preds == 0, -1, preds >> bits).astype(np.int64),
+            "pred_actor": np.where(preds == 0, 0, lut[preds & mask]).astype(np.int64),
+        }
+        cols = encode_ops_with_tail(ops_local, tail)
+        n_total = len(rows) + len(cps)
+        ts = self.timestamp if self.timestamp is not None else 0
+        stored = StoredChange(
+            dependencies=list(self.deps),
+            actor=doc.actors.get(author).bytes,
+            other_actors=[doc.actors.get(g).bytes for g in other],
+            seq=self.seq,
+            start_op=self.start_op,
+            timestamp=ts,
+            message=self.message,
+            ops=LazyOps({}, n_total),
+        )
+        built = build_change(stored, cols=cols)
+        built.ops = LazyOps(built.op_col_data, n_total)
+        return built
+
     # -- map mutations -----------------------------------------------------
 
     def put(self, obj: str, prop, value) -> None:
         self._check_open()
+        self._drain_all(drop=True)
         obj_id = self._obj(obj)
         info = self.doc.ops.get_obj(obj_id)
         sv = ScalarValue.from_py(value)
@@ -119,6 +310,7 @@ class Transaction:
 
     def put_object(self, obj: str, prop, obj_type: ObjType) -> str:
         self._check_open()
+        self._drain_all(drop=True)
         obj_id = self._obj(obj)
         info = self.doc.ops.get_obj(obj_id)
         action = action_for_objtype(obj_type)
@@ -147,6 +339,7 @@ class Transaction:
 
     def delete(self, obj: str, prop) -> None:
         self._check_open()
+        self._drain_all(drop=True)
         obj_id = self._obj(obj)
         info = self.doc.ops.get_obj(obj_id)
         if isinstance(info.data, MapObject):
@@ -180,6 +373,7 @@ class Transaction:
 
     def increment(self, obj: str, prop, by: int) -> None:
         self._check_open()
+        self._drain_all(drop=True)
         obj_id = self._obj(obj)
         info = self.doc.ops.get_obj(obj_id)
         if isinstance(info.data, MapObject):
@@ -249,11 +443,13 @@ class Transaction:
 
     def insert(self, obj: str, index: int, value) -> None:
         self._check_open()
+        self._drain_all(drop=True)
         obj_id = self._obj(obj)
         self._insert_op(obj_id, index, Action.PUT, ScalarValue.from_py(value))
 
     def insert_object(self, obj: str, index: int, obj_type: ObjType) -> str:
         self._check_open()
+        self._drain_all(drop=True)
         obj_id = self._obj(obj)
         op = self._insert_op(
             obj_id, index, action_for_objtype(obj_type), ScalarValue.null()
@@ -346,18 +542,74 @@ class Transaction:
 
     def splice_text(self, obj: str, pos: int, delete: int, text: str) -> None:
         self._check_open()
+        # hot path: an existing session needs no store access at all
+        ent = self._sessions.get(self.doc.import_id(obj)) if self._sessions else None
+        if ent is not None:
+            n = ent[0].splice(
+                self.start_op + len(self.operations) + self._session_ops,
+                pos, delete, text,
+            )
+            self._session_ops += n
+            return
         obj_id = self._obj(obj)
+        # session creation only reads obj_id's state, which no OTHER
+        # session can have touched — no drain needed yet
         info = self.doc.ops.get_obj(obj_id)
         # text splices apply only to TEXT objects (reference: InvalidOp,
         # transaction/inner.rs splice_text via automerge.rs op checks)
         if not isinstance(info.data, SeqObject) or info.data.obj_type != ObjType.TEXT:
             raise InvalidOp(msg="splice_text on a non-text object")
+        sess = self._session_for(obj_id, info)
+        if sess is not None:
+            n = sess.splice(
+                self.start_op + len(self.operations) + self._session_ops,
+                pos, delete, text,
+            )
+            self._session_ops += n
+            return
+        # python fallback: other sessions' pending ops must land in
+        # ``operations`` BEFORE this op so the encoded change stays in
+        # implicit-id order (ids derive from row position on decode)
+        self._drain_all()
         enc = self._encoding(info.data)
         values = [ScalarValue("str", ch) for ch in text]
         self._splice(obj_id, pos, delete, values, enc)
 
+    def splice_text_many(self, obj: str, edits, clamp: bool = True) -> int:
+        """Bulk text ingest: apply many (pos, delete, text) splices in one
+        native call (requires session eligibility — TEXT object, no marks,
+        no conflicts; falls back to per-edit splice_text otherwise).
+        Returns the number of ops issued."""
+        self._check_open()
+        obj_id = self._obj(obj)
+        info = self.doc.ops.get_obj(obj_id)
+        if not isinstance(info.data, SeqObject) or info.data.obj_type != ObjType.TEXT:
+            raise InvalidOp(msg="splice_text_many on a non-text object")
+        sess = self._session_for(obj_id, info)
+        if sess is None:
+            from ..types import str_width
+
+            n0 = self.pending_ops()
+            ln = self.length(obj)  # width units, like pos/ndel
+            for e in edits:
+                pos, ndel = e[0], e[1]
+                text = "".join(e[2:]) if len(e) > 2 else ""
+                if clamp:
+                    pos = min(pos, ln)
+                    ndel = min(ndel, ln - pos)
+                self.splice_text(obj, pos, ndel, text)
+                ln += str_width(text) - ndel
+            return self.pending_ops() - n0
+        n = sess.splice_batch(
+            self.start_op + len(self.operations) + self._session_ops,
+            edits, clamp=clamp,
+        )
+        self._session_ops += n
+        return n
+
     def splice(self, obj: str, pos: int, delete: int, values) -> None:
         self._check_open()
+        self._drain_all(drop=True)
         obj_id = self._obj(obj)
         info = self.doc.ops.get_obj(obj_id)
         if not isinstance(info.data, SeqObject):
@@ -456,6 +708,7 @@ class Transaction:
         The end op id is always begin id + 1 — the pairing key.
         """
         self._check_open()
+        self._drain_all(drop=True)
         obj_id = self._obj(obj)
         info = self.doc.ops.get_obj(obj_id)
         if not isinstance(info.data, SeqObject):
@@ -501,34 +754,45 @@ class Transaction:
     # -- commit / rollback -------------------------------------------------
 
     def pending_ops(self) -> int:
-        return len(self.operations)
+        return len(self.operations) + self._session_ops
 
     # -- reads (reference: Transactable is a ReadDoc, transactable.rs) -----
     #
     # Reads resolve through the transaction's scope clock: an isolated
     # transaction sees the historical state plus its own pending ops (the
     # scope pins this transaction's actor), a plain transaction sees the
-    # current state plus pending ops.
+    # current state plus pending ops. Pending native-session ops drain
+    # into the store first so reads observe them.
 
     def get(self, obj: str, prop):
+        self._drain_all()
         return self.doc.get(obj, prop, clock=self.scope)
 
     def get_all(self, obj: str, prop):
+        self._drain_all()
         return self.doc.get_all(obj, prop, clock=self.scope)
 
     def text(self, obj: str) -> str:
+        self._drain_all()
         return self.doc.text(obj, clock=self.scope)
 
     def length(self, obj: str) -> int:
+        n = self.session_length(self.doc.import_id(obj)) if self._sessions else None
+        if n is not None:
+            return n
+        self._drain_all()
         return self.doc.length(obj, clock=self.scope)
 
     def keys(self, obj: str = ROOT):
+        self._drain_all()
         return self.doc.keys(obj, clock=self.scope)
 
     def list_items(self, obj: str):
+        self._drain_all()
         return self.doc.list_items(obj, clock=self.scope)
 
     def map_entries(self, obj: str = ROOT):
+        self._drain_all()
         return self.doc.map_entries(obj, clock=self.scope)
 
     def commit(self) -> Optional[bytes]:
@@ -536,33 +800,40 @@ class Transaction:
         self._check_open()
         self._done = True
         self.doc.open_transactions.discard(self)
-        if not self.operations and self.message is None:
+        if not self.operations and not self._session_ops and self.message is None:
             return None
         from .. import trace
 
         if trace.enabled():
-            trace.event("commit", ops=len(self.operations), seq=self.seq)
+            trace.event("commit", ops=self.pending_ops(), seq=self.seq)
         change = self._export_change()
         applied = AppliedChange(
             change, self.actor_idx, self._export_actor_map(change)
         )
         self.doc._update_history(applied)
+        if self._had_session_ops:
+            # the op store never saw the session ops — it is now a stale
+            # view of history and rebuilds on the next read
+            self.doc._ops_stale = True
         return change.hash
 
     def rollback(self) -> int:
         self._check_open()
         self._done = True
         self.doc.open_transactions.discard(self)
-        n = len(self.operations)
+        n = len(self.operations) + self._session_ops
+        for ent in self._sessions.values():
+            ent[0].close()
+        self._sessions.clear()
+        self._session_ops = 0
         for obj_id, op in reversed(self.operations):
             self.doc.ops.remove_op(obj_id, op)
         self.operations = []
         return n
 
-    def _export_change(self) -> StoredChange:
+    def _change_rows(self) -> List[ChangeOp]:
         doc = self.doc
-        author = self.actor_idx
-        rows = [
+        return [
             ChangeOp(
                 obj=ROOT_STORED if obj_id == ROOT_OBJ else obj_id,
                 key=(
@@ -579,7 +850,26 @@ class Transaction:
             )
             for obj_id, op in self.operations
         ]
-        ops, other = chunk_local_ops(
+
+    def _export_change(self) -> StoredChange:
+        live = {
+            o: ent for o, ent in self._sessions.items()
+            if ent[0].op_count() > ent[1]
+        }
+        if len(live) > 1:
+            # multi-session commits interleave objects: python path
+            self._drain_all(drop=True)
+            live = {}
+        if live:
+            ((obj_id, ent),) = live.items()
+            return self._export_change_session(obj_id, ent)
+        for ent in self._sessions.values():
+            ent[0].close()
+        self._sessions.clear()
+        doc = self.doc
+        author = self.actor_idx
+        rows = self._change_rows()
+        ops, other, _ = chunk_local_ops(
             rows, author, lambda g: doc.actors.get(g).bytes
         )
         ts = self.timestamp if self.timestamp is not None else 0

@@ -29,6 +29,7 @@ _SRCS = [
     os.path.join(_HERE, "codecs.cpp"),
     os.path.join(_HERE, "apply.cpp"),
     os.path.join(_HERE, "extract_batch.cpp"),
+    os.path.join(_HERE, "session.cpp"),
 ]
 _SRC = _SRCS[0]
 
@@ -140,6 +141,23 @@ def load() -> Optional[ctypes.CDLL]:
         ("am_bool_decode_batch", [u8p, i64p, i64p, i64p, ctypes.c_int64, u8p]),
         ("am_rle_decode_batch_strtab", [u8p, i64p, i64p, i64p, ctypes.c_int64, i32p, i64p, i64p, ctypes.c_int64]),
         ("am_leb_decode_rows", [u8p, ctypes.c_int64, i64p, i64p, i32p, ctypes.c_int64, i64p]),
+    ):
+        fn = getattr(lib, name)
+        fn.restype = ctypes.c_longlong
+        fn.argtypes = argtypes
+    vp = ctypes.c_void_p
+    lib.am_edit_create.restype = vp
+    lib.am_edit_create.argtypes = [ctypes.c_int64]
+    lib.am_edit_destroy.restype = None
+    lib.am_edit_destroy.argtypes = [vp]
+    for name, argtypes in (
+        ("am_edit_init", [vp, i64p, i64p, i32p, ctypes.c_int64]),
+        ("am_edit_length", [vp]),
+        ("am_edit_op_count", [vp]),
+        ("am_edit_splice", [vp, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, i32p, i32p, ctypes.c_int64]),
+        ("am_edit_splice_batch", [vp, ctypes.c_int64, i64p, i64p, i64p, i32p, i32p, ctypes.c_int64, ctypes.c_uint8]),
+        ("am_edit_export", [vp, ctypes.c_int64, i64p, i64p, i64p, i32p, i32p, u8p]),
+        ("am_edit_order", [vp, i64p, ctypes.c_int64]),
     ):
         fn = getattr(lib, name)
         fn.restype = ctypes.c_longlong
@@ -356,3 +374,155 @@ def preorder_index(
     if r < 0:
         raise ValueError("cyclic element structure in preorder walk")
     return out
+
+
+def _cp_widths(cps: np.ndarray) -> np.ndarray:
+    """Per-codepoint text widths for the configured encoding
+    (reference: text_value.rs width-per-encoding)."""
+    from ..types import get_text_encoding
+
+    enc = get_text_encoding()
+    if enc == "utf16":
+        return np.where(cps > 0xFFFF, 2, 1).astype(np.int32)
+    if enc == "utf8":
+        return (
+            1
+            + (cps > 0x7F).astype(np.int32)
+            + (cps > 0x7FF).astype(np.int32)
+            + (cps > 0xFFFF).astype(np.int32)
+        ).astype(np.int32)
+    return np.ones(len(cps), np.int32)
+
+
+class EditSession:
+    """The native text-edit session (session.cpp): owns one text object's
+    visible-element state inside a transaction; splices resolve in C++."""
+
+    __slots__ = ("_lib", "_h", "_splice_fn", "_len_fn", "_one_cp", "_one_w", "_one_cp_p", "_one_w_p")
+
+    def __init__(self, rank: int):
+        lib = load()
+        if lib is None or not hasattr(lib, "am_edit_create"):
+            raise NativeUnavailable("native edit session not available")
+        self._lib = lib
+        # hot-path plumbing: bound function refs + a reusable 1-codepoint
+        # buffer with a precomputed ctypes pointer (typing workloads are
+        # dominated by single-character splices)
+        self._splice_fn = lib.am_edit_splice
+        self._len_fn = lib.am_edit_length
+        self._one_cp = np.empty(1, np.int32)
+        self._one_w = np.ones(1, np.int32)
+        self._one_cp_p = _i32(self._one_cp)
+        self._one_w_p = _i32(self._one_w)
+        self._h = lib.am_edit_create(rank)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.am_edit_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def init(self, elem_ids: np.ndarray, winner_ids: np.ndarray, widths: np.ndarray) -> None:
+        e = np.ascontiguousarray(elem_ids, np.int64)
+        w = np.ascontiguousarray(winner_ids, np.int64)
+        wd = np.ascontiguousarray(widths, np.int32)
+        self._lib.am_edit_init(self._h, _i64(e), _i64(w), _i32(wd), len(e))
+
+    def length(self) -> int:
+        return int(self._len_fn(self._h))
+
+    def op_count(self) -> int:
+        return int(self._lib.am_edit_op_count(self._h))
+
+    def splice(self, ctr0: int, pos: int, ndel: int, text: str) -> int:
+        """Emit ops for one splice; op ids are ctr0..ctr0+n-1. Returns the
+        number of ops emitted; raises on out-of-bounds."""
+        nt = len(text)
+        if nt == 1:
+            cp = ord(text)
+            self._one_cp[0] = cp
+            if cp > 0x7F:
+                from ..types import get_text_encoding
+
+                enc = get_text_encoding()
+                self._one_w[0] = (
+                    1 + (cp > 0x7F) + (cp > 0x7FF) + (cp > 0xFFFF)
+                    if enc == "utf8"
+                    else (2 if enc == "utf16" and cp > 0xFFFF else 1)
+                )
+            else:
+                self._one_w[0] = 1
+            n = self._splice_fn(self._h, ctr0, pos, ndel, self._one_cp_p, self._one_w_p, 1)
+        elif nt == 0:
+            n = self._splice_fn(self._h, ctr0, pos, ndel, self._one_cp_p, self._one_w_p, 0)
+        else:
+            cps = np.frombuffer(text.encode("utf-32-le"), np.uint32).astype(np.int32)
+            widths = _cp_widths(cps)
+            n = self._splice_fn(self._h, ctr0, pos, ndel, _i32(cps), _i32(widths), nt)
+        if n < 0:
+            raise ValueError(f"edit session splice out of bounds (code {n})")
+        return int(n)
+
+    def splice_batch(self, ctr0: int, edits, clamp: bool = True) -> int:
+        """Apply many (pos, ndel, text) edits in ONE native call (the
+        bulk-ingest path); with ``clamp``, positions and delete counts are
+        clamped to the live length per edit. Returns total ops emitted."""
+        n = len(edits)
+        pos = np.empty(n, np.int64)
+        ndel = np.empty(n, np.int64)
+        texts = []
+        off = np.empty(n + 1, np.int64)
+        off[0] = 0
+        for i, e in enumerate(edits):
+            pos[i] = e[0]
+            ndel[i] = e[1]
+            t = "".join(e[2:]) if len(e) > 2 else ""
+            texts.append(t)
+            off[i + 1] = off[i] + len(t)
+        all_text = "".join(texts)
+        if all_text:
+            cps = np.frombuffer(all_text.encode("utf-32-le"), np.uint32).astype(np.int32)
+            widths = _cp_widths(cps)
+        else:
+            cps = np.zeros(1, np.int32)
+            widths = np.ones(1, np.int32)
+        r = self._lib.am_edit_splice_batch(
+            self._h, ctr0, _i64(pos), _i64(ndel), _i64(off), _i32(cps),
+            _i32(widths), n, 1 if clamp else 0,
+        )
+        if r < 0:
+            raise ValueError(f"edit session batch splice failed (code {r})")
+        return int(r)
+
+    def export(self, start: int = 0):
+        """Emitted ops [start:] in id order: dict of numpy arrays."""
+        n = max(self.op_count() - start, 0)
+        ids = np.empty(max(n, 1), np.int64)
+        refs = np.empty(max(n, 1), np.int64)
+        preds = np.empty(max(n, 1), np.int64)
+        cps = np.empty(max(n, 1), np.int32)
+        widths = np.empty(max(n, 1), np.int32)
+        is_del = np.empty(max(n, 1), np.uint8)
+        self._lib.am_edit_export(
+            self._h, start, _i64(ids), _i64(refs), _i64(preds), _i32(cps),
+            _i32(widths), _u8(is_del),
+        )
+        return {
+            "id": ids[:n], "elem_ref": refs[:n], "pred": preds[:n],
+            "cp": cps[:n], "width": widths[:n], "is_del": is_del[:n].astype(bool),
+        }
+
+    def order(self) -> np.ndarray:
+        """Current visible element ids in document order."""
+        cap = 1024
+        while True:
+            out = np.empty(cap, np.int64)
+            n = int(self._lib.am_edit_order(self._h, _i64(out), cap))
+            if n <= cap:
+                return out[:n]
+            cap = n

@@ -1,5 +1,24 @@
-from .device_doc import DeviceDoc
-from .merge import merge_columns, merge_kernel
-from .oplog import OpLog
+"""Device op log + batched merge kernel.
+
+Submodules import lazily (PEP 562): ``merge`` pulls in JAX (~1s cold), and
+host-only paths (the bulk rebuild's use of ``ops.extract``) must not pay
+for it.
+"""
 
 __all__ = ["DeviceDoc", "OpLog", "merge_columns", "merge_kernel"]
+
+
+def __getattr__(name):
+    if name == "DeviceDoc":
+        from .device_doc import DeviceDoc
+
+        return DeviceDoc
+    if name == "OpLog":
+        from .oplog import OpLog
+
+        return OpLog
+    if name in ("merge_columns", "merge_kernel"):
+        from . import merge
+
+        return getattr(merge, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -182,6 +182,135 @@ def encode_change_ops(ops: Sequence[ChangeOp]) -> List[Tuple[int, bytes]]:
     ]
 
 
+def encode_ops_with_tail(prefix_ops: Sequence[ChangeOp], tail) -> List[Tuple[int, bytes]]:
+    """Encode op columns for ``prefix_ops`` (chunk-local ChangeOps) followed
+    by a numpy tail from the native edit session — identical bytes to
+    ``encode_change_ops`` over the materialized op list, at array speed.
+
+    ``tail`` fields (chunk-local actor indices, one row per op):
+      obj_ctr/obj_actor   ints (the session's single object id)
+      elem_ctr (i64), elem_actor (i64, -1 = HEAD/null)
+      insert (u8), action (i64)
+      val_meta (i64: (byte_len << 4) | type_code), val_raw (bytes)
+      pred_ctr/pred_actor (i64, -1 = no pred)
+    """
+    import numpy as np
+
+    from .. import native
+    from .values import encode_raw_value, value_meta
+
+    np_ = len(prefix_ops)
+    nt = len(tail["action"])
+    n = np_ + nt
+
+    obj_ctr = np.empty(n, np.int64)
+    obj_mask = np.empty(n, np.uint8)
+    obj_actor = np.empty(n, np.int64)
+    key_ctr = np.empty(n, np.int64)
+    key_ctr_mask = np.empty(n, np.uint8)
+    key_actor = np.empty(n, np.int64)
+    key_actor_mask = np.empty(n, np.uint8)
+    insert = np.empty(n, np.uint8)
+    action = np.empty(n, np.int64)
+    vmeta = np.empty(n, np.int64)
+    pred_num = np.empty(n, np.int64)
+
+    key_str = RleEncoder("str")
+    mark_name = RleEncoder("str")
+    expand = MaybeBooleanEncoder()
+    raw = bytearray()
+    pred_ctr_list: List[int] = []
+    pred_actor_list: List[int] = []
+
+    for i, op in enumerate(prefix_ops):
+        if is_root(op.obj):
+            obj_mask[i] = 0
+            obj_ctr[i] = 0
+            obj_actor[i] = 0
+        else:
+            obj_mask[i] = 1
+            obj_ctr[i] = op.obj[0]
+            obj_actor[i] = op.obj[1]
+        if op.key.prop is not None:
+            key_str.append_value(op.key.prop)
+            key_ctr_mask[i] = 0
+            key_ctr[i] = 0
+            key_actor_mask[i] = 0
+            key_actor[i] = 0
+        elif is_head(op.key.elem):
+            key_str.append_null()
+            key_ctr_mask[i] = 1
+            key_ctr[i] = 0
+            key_actor_mask[i] = 0
+            key_actor[i] = 0
+        else:
+            key_str.append_null()
+            key_ctr_mask[i] = 1
+            key_ctr[i] = op.key.elem[0]
+            key_actor_mask[i] = 1
+            key_actor[i] = op.key.elem[1]
+        insert[i] = 1 if op.insert else 0
+        action[i] = op.action
+        vmeta[i] = value_meta(op.value)
+        encode_raw_value(op.value, raw)
+        pred_num[i] = len(op.pred)
+        for p in op.pred:
+            pred_ctr_list.append(p[0])
+            pred_actor_list.append(p[1])
+        expand.append(op.expand)
+        if op.mark_name is None:
+            mark_name.append_null()
+        else:
+            mark_name.append_value(op.mark_name)
+
+    # tail (vectorized)
+    s = slice(np_, n)
+    obj_mask[s] = 1
+    obj_ctr[s] = int(tail["obj_ctr"])
+    obj_actor[s] = int(tail["obj_actor"])
+    t_elem_actor = tail["elem_actor"]
+    key_ctr[s] = tail["elem_ctr"]
+    key_ctr_mask[s] = 1
+    key_actor[s] = np.where(t_elem_actor >= 0, t_elem_actor, 0)
+    key_actor_mask[s] = (t_elem_actor >= 0).astype(np.uint8)
+    insert[s] = tail["insert"]
+    action[s] = tail["action"]
+    vmeta[s] = tail["val_meta"]
+    raw += tail["val_raw"]
+    t_pred_ctr = tail["pred_ctr"]
+    has_pred = t_pred_ctr >= 0
+    pred_num[s] = has_pred.astype(np.int64)
+    key_str.append_null_run(nt)
+    mark_name.append_null_run(nt)
+    expand.append_run(False, nt)
+
+    pred_ctr_all = np.concatenate(
+        [np.asarray(pred_ctr_list, np.int64), t_pred_ctr[has_pred]]
+    )
+    pred_actor_all = np.concatenate(
+        [np.asarray(pred_actor_list, np.int64), tail["pred_actor"][has_pred]]
+    )
+    ones_p = np.ones(len(pred_ctr_all), np.uint8)
+    ones = np.ones(n, np.uint8)
+
+    return [
+        (COL_OBJ_ACTOR, native.rle_encode_array(obj_actor, obj_mask, False)),
+        (COL_OBJ_CTR, native.rle_encode_array(obj_ctr, obj_mask, False)),
+        (COL_KEY_ACTOR, native.rle_encode_array(key_actor, key_actor_mask, False)),
+        (COL_KEY_CTR, native.delta_encode_array(key_ctr, key_ctr_mask)),
+        (COL_KEY_STR, key_str.finish()),
+        (COL_INSERT, native.bool_encode_array(insert)),
+        (COL_ACTION, native.rle_encode_array(action, ones, False)),
+        (COL_VAL_META, native.rle_encode_array(vmeta, ones, False)),
+        (COL_VAL_RAW, bytes(raw)),
+        (COL_PRED_GROUP, native.rle_encode_array(pred_num, ones, False)),
+        (COL_PRED_ACTOR, native.rle_encode_array(pred_actor_all, ones_p, False)),
+        (COL_PRED_CTR, native.delta_encode_array(pred_ctr_all, ones_p)),
+        (COL_EXPAND, expand.finish()),
+        (COL_MARK_NAME, mark_name.finish()),
+    ]
+
+
 def decode_change_ops(col_data: dict[int, bytes]) -> List[ChangeOp]:
     """Decode op columns from a dict of normalized spec -> bytes."""
 
@@ -260,8 +389,42 @@ def _pad(lst: list, n: int) -> list:
     return lst
 
 
-def build_change(change: StoredChange) -> StoredChange:
-    """Encode ``change`` into chunk bytes, filling ``hash``/``raw_bytes``."""
+class LazyOps:
+    """List-like view over a change's ops, decoded from the retained column
+    bytes on first element access. ``len`` is always O(1); the hot paths
+    (bulk rebuild, device extraction) read ``op_col_data`` directly and
+    never materialize ChangeOp objects."""
+
+    __slots__ = ("_col_data", "_n", "_ops")
+
+    def __init__(self, col_data: dict, n: int):
+        self._col_data = col_data
+        self._n = n
+        self._ops = None
+
+    def _mat(self) -> List[ChangeOp]:
+        if self._ops is None:
+            self._ops = decode_change_ops(self._col_data)
+        return self._ops
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __bool__(self) -> bool:
+        return self._n > 0
+
+    def __iter__(self):
+        return iter(self._mat())
+
+    def __getitem__(self, i):
+        return self._mat()[i]
+
+
+def build_change(change: StoredChange, cols=None) -> StoredChange:
+    """Encode ``change`` into chunk bytes, filling ``hash``/``raw_bytes``.
+
+    ``cols`` supplies precomputed op columns (the array-native commit
+    path); when given, ``change.ops`` may be a LazyOps placeholder."""
     data = bytearray()
     deps = sorted(change.dependencies)
     change.dependencies = deps
@@ -284,7 +447,8 @@ def build_change(change: StoredChange) -> StoredChange:
     for a in change.other_actors:
         encode_uleb(len(a), data)
         data += a
-    cols = encode_change_ops(change.ops)
+    if cols is None:
+        cols = encode_change_ops(change.ops)
     C.write_columns(cols, data)
     data += change.extra_bytes
     raw = write_chunk(CHUNK_CHANGE, bytes(data))
@@ -379,14 +543,16 @@ def parse_change(buf: bytes, pos: int = 0) -> tuple[StoredChange, int]:
     return change, end
 
 
-def chunk_local_ops(rows, author, actor_bytes_of):
+def chunk_local_ops(rows, author, actor_bytes_of, extra_refs=()):
     """Translate ops with *global* actor indices into chunk-local ChangeOps.
 
     Builds the chunk-local actor table — author first, remaining referenced
     actors sorted by their bytes (reference: change/change_actors.rs) — and
     rewrites obj / elem / pred references through it. ``rows`` are ChangeOp-
     shaped records whose OpIds carry global indices; ``actor_bytes_of`` maps
-    a global index to actor bytes. Returns (chunk_ops, other_global_indices).
+    a global index to actor bytes; ``extra_refs`` adds global indices
+    referenced outside ``rows`` (the native-session tail) to the table.
+    Returns (chunk_ops, other_global_indices, local_of_global).
 
     This is the single encoder shared by transaction commit and document
     save/reconstruct so both always produce byte-identical change chunks for
@@ -405,6 +571,11 @@ def chunk_local_ops(rows, author, actor_bytes_of):
             if a not in seen:
                 seen.add(a)
                 other.append(a)
+    for a in extra_refs:
+        a = int(a)
+        if a not in seen:
+            seen.add(a)
+            other.append(a)
     other.sort(key=actor_bytes_of)
     local = {author: 0}
     for j, g in enumerate(other):
@@ -433,4 +604,4 @@ def chunk_local_ops(rows, author, actor_bytes_of):
                 mark_name=r.mark_name,
             )
         )
-    return ops, other
+    return ops, other, local

@@ -130,6 +130,14 @@ class RleEncoder:
             self._lits = []
             self._state, self._count = _NULLS, 1
 
+    def append_null_run(self, n: int) -> None:
+        """Append ``n`` nulls in O(1) (bulk columns with long null tails)."""
+        if n <= 0:
+            return
+        self.append_null()
+        if self._state in (_INITIAL_NULLS, _NULLS):
+            self._count += n - 1
+
     def append_value(self, value) -> None:
         st = self._state
         if st == _EMPTY:
@@ -273,6 +281,13 @@ class BooleanEncoder:
             self._last = value
             self._count = 1
 
+    def append_run(self, value: bool, n: int) -> None:
+        """Append ``n`` equal values in O(1)."""
+        if n <= 0:
+            return
+        self.append(value)
+        self._count += n - 1
+
     def finish(self) -> bytes:
         if self._count > 0:
             encode_uleb(self._count, self.out)
@@ -312,6 +327,11 @@ class MaybeBooleanEncoder:
         if value:
             self._all_false = False
         self._inner.append(value)
+
+    def append_run(self, value: bool, n: int) -> None:
+        if value and n > 0:
+            self._all_false = False
+        self._inner.append_run(value, n)
 
     def finish(self) -> bytes:
         if self._all_false:

@@ -67,15 +67,25 @@ def main():
     n_ops = W.apply_edits(doc, tobj, trace[:n_replay])
     doc.commit()
     t_replay = time.perf_counter() - t0
+    # bulk-ingest variant: the same edits through splice_text_many (the
+    # whole replay loop runs in the native edit session)
+    doc_b = AutoDoc(actor=ActorId(bytes([8]) * 16))
+    tobj_b = doc_b.put_object("_root", "text", ObjType.TEXT)
+    t0 = time.perf_counter()
+    n_b = doc_b.splice_text_many(tobj_b, trace[:n_replay])
+    doc_b.commit()
+    t_batch = time.perf_counter() - t0
     results["replay"] = {
         "edits": n_replay,
         "ops": n_ops,
         "seconds": round(t_replay, 3),
         "ops_per_sec": round(n_ops / t_replay, 1),
         "vs_baseline": round(n_ops / t_replay / RUST_PIN_REPLAY, 4),
+        "batch_ops_per_sec": round(n_b / t_batch, 1),
+        "batch_vs_baseline": round(n_b / t_batch / RUST_PIN_REPLAY, 4),
     }
     note(f"replay: {results['replay']}")
-    del doc
+    del doc, doc_b
 
     # ---- config 2: N-way fan-in merge (primary) ----------------------------
     base_edits = env_int("BENCH_BASE_EDITS", 120_000)

@@ -1,0 +1,281 @@
+"""Native text-edit session: the local-transaction hot path.
+
+The session (native/session.cpp) owns one text object's visible-element
+state inside an AutoDoc transaction; splices resolve in C++ and commit
+encodes straight from arrays (storage/change.encode_ops_with_tail).
+These tests pin the invariant that the session path is BYTE-IDENTICAL
+to the python transaction path — same ops, same change chunks, same
+hashes — across drains, mixed transactions, rollbacks, unicode widths,
+and fallback conditions (reference semantics: transaction/inner.rs
+inner_splice).
+"""
+
+import random
+
+import pytest
+
+from automerge_tpu import native
+from automerge_tpu.api import AutoDoc
+from automerge_tpu.core.marks import Mark
+from automerge_tpu.types import ActorId, ObjType
+
+pytestmark = pytest.mark.skipif(
+    not native.available() or not hasattr(native.load() or object, "am_edit_create"),
+    reason="native edit session unavailable",
+)
+
+
+def actor(i):
+    return ActorId(bytes([i]) * 16)
+
+
+def two_docs():
+    """Two fresh docs with a text object; doc b has sessions disabled."""
+    a = AutoDoc(actor=actor(1))
+    ta = a.put_object("_root", "t", ObjType.TEXT)
+    b = AutoDoc(actor=actor(1))
+    tb = b.put_object("_root", "t", ObjType.TEXT)
+    tx = b._ensure_tx()
+    tx.enable_sessions = False
+    return a, ta, b, tb
+
+
+def assert_same_changes(a, b):
+    ca = a.get_changes([])
+    cb = b.get_changes([])
+    assert len(ca) == len(cb)
+    for x, y in zip(ca, cb):
+        assert x.raw_bytes == y.raw_bytes
+
+
+def test_session_matches_python_randomized():
+    rng = random.Random(7)
+    a, ta, b, tb = two_docs()
+    edits = []
+    ln = 0
+    for _ in range(400):
+        if ln == 0 or rng.random() < 0.7:
+            pos = rng.randint(0, ln)
+            txt = chr(rng.randint(97, 122)) * rng.randint(1, 3)
+            edits.append((pos, 0, txt))
+            ln += len(txt)
+        else:
+            pos = rng.randint(0, ln - 1)
+            nd = min(rng.randint(1, 3), ln - pos)
+            edits.append((pos, nd, ""))
+            ln -= nd
+    for pos, nd, txt in edits:
+        a.splice_text(ta, pos, nd, txt)
+        b.splice_text(tb, pos, nd, txt)
+    a.commit()
+    b.commit()
+    assert a.text(ta) == b.text(tb)
+    assert_same_changes(a, b)
+
+
+def test_mid_transaction_read_drains():
+    a, ta, b, tb = two_docs()
+    for d, t in ((a, ta), (b, tb)):
+        d.splice_text(t, 0, 0, "hello")
+        assert d.text(t) == "hello"  # read mid-tx drains the session
+        d.splice_text(t, 5, 0, " world")
+        d.commit()
+    assert a.text(ta) == "hello world"
+    assert_same_changes(a, b)
+
+
+def test_mixed_ops_same_transaction():
+    a, ta, b, tb = two_docs()
+    for d, t in ((a, ta), (b, tb)):
+        d.splice_text(t, 0, 0, "abc")
+        d.put("_root", "k", 1)  # python op: forces drain
+        d.splice_text(t, 2, 1, "XY")
+        d.commit()
+    assert a.text(ta) == "abXY"
+    assert a.hydrate() == b.hydrate()
+    assert_same_changes(a, b)
+
+
+def test_length_fast_path_and_clamping():
+    a = AutoDoc(actor=actor(1))
+    t = a.put_object("_root", "t", ObjType.TEXT)
+    a.splice_text(t, 0, 0, "abcdef")
+    assert a.length(t) == 6  # served from the live session
+    a.splice_text(t, 2, 2, "")
+    assert a.length(t) == 4
+    a.commit()
+    assert a.text(t) == "abef"
+
+
+def test_unicode_widths_utf16():
+    from automerge_tpu.types import set_text_encoding
+
+    set_text_encoding("utf16")
+    try:
+        a, ta, b, tb = two_docs()
+        for d, t in ((a, ta), (b, tb)):
+            d.splice_text(t, 0, 0, "a\U0001F600b")  # emoji width 2
+            assert d.length(t) == 4
+            d.splice_text(t, 1, 2, "X")  # deletes the emoji (width 2)
+            d.commit()
+        assert a.text(ta) == "aXb"
+        assert_same_changes(a, b)
+    finally:
+        set_text_encoding("unicode")
+
+
+def test_marked_object_falls_back():
+    a = AutoDoc(actor=actor(1))
+    t = a.put_object("_root", "t", ObjType.TEXT)
+    a.splice_text(t, 0, 0, "hello world")
+    a.mark(t, 0, 5, "bold", True)
+    a.commit()
+    # marked object: session ineligible, python path keeps mark semantics
+    a.splice_text(t, 5, 0, "!")
+    a.commit()
+    assert a._tx is None
+    assert a.marks(t) == [Mark(0, 6, "bold", True)]
+
+
+def test_conflicted_element_falls_back():
+    a = AutoDoc(actor=actor(1))
+    t = a.put_object("_root", "lst", ObjType.TEXT)
+    a.splice_text(t, 0, 0, "x")
+    a.commit()
+    f = a.fork(actor=actor(2))
+    # concurrent puts at index 0 -> conflicted element (multiple winners)
+    a.put(t, 0, "A")
+    f.put(t, 0, "B")
+    a.commit()
+    f.commit()
+    a.merge(f)
+    assert len(a.get_all(t, 0)) == 2
+    a.splice_text(t, 1, 0, "z")  # falls back (conflict) but must work
+    a.commit()
+    assert a.length(t) == 2
+
+
+def test_rollback_discards_session_ops():
+    a = AutoDoc(actor=actor(1))
+    t = a.put_object("_root", "t", ObjType.TEXT)
+    a.splice_text(t, 0, 0, "keep")
+    a.commit()
+    a.splice_text(t, 4, 0, " DISCARD")
+    assert a.rollback() == 8
+    assert a.text(t) == "keep"
+    assert a.doc.max_op == 5  # make op + 4 chars
+
+
+def test_batch_ingest_matches_per_edit():
+    rng = random.Random(11)
+    edits = []
+    ln = 0
+    for _ in range(500):
+        if ln == 0 or rng.random() < 0.8:
+            pos = rng.randint(0, ln + 2)  # may exceed: clamped
+            edits.append([pos, 0, chr(rng.randint(97, 122))])
+            ln += 1
+        else:
+            edits.append([rng.randint(0, ln), 2])  # may overrun: clamped
+            ln = max(ln - 2, 0)
+    a = AutoDoc(actor=actor(1))
+    ta = a.put_object("_root", "t", ObjType.TEXT)
+    from automerge_tpu import bench as W
+
+    W.apply_edits(a, ta, edits)
+    a.commit()
+    b = AutoDoc(actor=actor(1))
+    tb = b.put_object("_root", "t", ObjType.TEXT)
+    b.splice_text_many(tb, edits)
+    b.commit()
+    assert a.text(ta) == b.text(tb)
+    assert_same_changes(a, b)
+
+
+def test_session_change_loads_and_merges():
+    """Changes committed via the array-native path interop like any other:
+    save/load roundtrip, head verification, merge into a python-path doc."""
+    a = AutoDoc(actor=actor(1))
+    t = a.put_object("_root", "t", ObjType.TEXT)
+    a.splice_text(t, 0, 0, "the quick fox")
+    a.splice_text(t, 4, 5, "slow")
+    a.commit()
+    data = a.save()
+    b = AutoDoc.load(data)
+    assert b.text(t) == "the slow fox"
+    c = b.fork(actor=actor(3))
+    c.splice_text(t, 0, 3, "one")
+    c.commit()
+    a.merge(c)
+    assert a.text(t) == c.text(t)
+
+
+def test_mixed_session_and_ineligible_object_ordering():
+    """A python-path splice on an ineligible object while another object's
+    session holds pending ops must not reorder implicit op ids (the change
+    format derives ids from row position): the saved bytes must reload."""
+    a = AutoDoc(actor=actor(1))
+    ta = a.put_object("_root", "a", ObjType.TEXT)
+    tb = a.put_object("_root", "b", ObjType.TEXT)
+    a.splice_text(tb, 0, 0, "ze")
+    a.mark(tb, 0, 1, "bold", True)  # marks make b session-ineligible
+    a.commit()
+    a.splice_text(ta, 0, 0, "hello")  # session on a
+    a.splice_text(tb, 1, 0, "Q")      # python path on b
+    a.splice_text(ta, 5, 0, "!")      # back to the session
+    a.commit()
+    assert a.text(ta) == "hello!"
+    assert a.text(tb) == "zQe"
+    b = AutoDoc.load(a.save())
+    assert b.text(ta) == "hello!"
+    assert b.text(tb) == "zQe"
+    assert b.get_heads() == a.get_heads()
+
+
+def test_batch_fallback_width_clamping_utf16():
+    """splice_text_many's python fallback clamps in width units, matching
+    the native path (astral chars are width 2 under utf16)."""
+    from automerge_tpu.types import set_text_encoding
+
+    set_text_encoding("utf16")
+    try:
+        edits = [
+            (0, 0, "\U0001F389" * 3),
+            (6, 0, "end"),
+            (2, 4, ""),
+            (5, 9, "tail"),
+        ]
+        a = AutoDoc(actor=actor(1))
+        ta = a.put_object("_root", "t", ObjType.TEXT)
+        na = a.splice_text_many(ta, edits)  # native session path
+        a.commit()
+        b = AutoDoc(actor=actor(1))
+        tbx = b.put_object("_root", "t", ObjType.TEXT)
+        tx = b._ensure_tx()
+        tx.enable_sessions = False  # force the python fallback
+        nb = b.splice_text_many(tbx, edits)
+        b.commit()
+        assert a.text(ta) == b.text(tbx)
+        assert na == nb
+        assert_same_changes(a, b)
+    finally:
+        set_text_encoding("unicode")
+
+
+def test_session_survives_reads():
+    """Reads drain pending ops but keep the session alive (watermark), so
+    alternating splice/read editor loops stay on the native path."""
+    a = AutoDoc(actor=actor(1))
+    t = a.put_object("_root", "t", ObjType.TEXT)
+    for i in range(20):
+        a.splice_text(t, i, 0, "x")
+        assert a.text(t) == "x" * (i + 1)  # read drains (keeps session)
+    tx = a._tx
+    assert tx is not None and len(tx._sessions) == 1  # still live
+    ent = next(iter(tx._sessions.values()))
+    assert ent[0].op_count() == 20 and ent[1] == 20  # all drained
+    a.splice_text(t, 0, 5, "Y")
+    a.commit()
+    assert a.text(t) == "Y" + "x" * 15
+    b = AutoDoc.load(a.save())
+    assert b.text(t) == a.text(t)
