@@ -61,6 +61,11 @@ from .op_store import (
 
 ROOT = "_root"
 
+
+class FastSaveUnavailable(ValueError):
+    """Expected fast-save fallback (not a bug): the array-native encoder
+    cannot serve this document; the per-op python path takes over."""
+
 # the typed hierarchy lives in automerge_tpu.errors (error.rs analogue);
 # re-exported here because this module historically defined it
 from ..errors import AutomergeError, DuplicateSeqNumber  # noqa: E402,F401
@@ -851,13 +856,34 @@ class Document:
         return data
 
     def _save_document(self, deflate: bool = True) -> bytes:
+        import os
+
+        from .. import native
+
         sorted_idx = self.actors.sorted_order()  # sorted position -> global idx
         remap = [0] * len(sorted_idx)  # global idx -> sorted position
         for pos, g in enumerate(sorted_idx):
             remap[g] = pos
         actors = [self.actors.get(g).bytes for g in sorted_idx]
 
-        doc_ops = self._doc_ops(remap)
+        op_cols = None
+        if native.available():
+            try:
+                op_cols = self._doc_op_cols_fast(remap)
+            except FastSaveUnavailable:
+                pass  # documented fallback (empty doc, no column bytes, ...)
+            except Exception as e:
+                if os.environ.get("AUTOMERGE_TPU_DEBUG"):
+                    raise
+                import warnings
+
+                warnings.warn(
+                    f"array-native save failed unexpectedly ({e!r}); "
+                    "falling back to the per-op encoder",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+        doc_ops = self._doc_ops(remap) if op_cols is None else []
         changes = [
             DocChangeMeta(
                 actor=remap[c.actor_idx],
@@ -871,7 +897,143 @@ class Document:
             for c in self.history
         ]
         heads = [(h, self.history_index[h]) for h in self.get_heads()]
-        return build_document(actors, heads, doc_ops, changes, deflate)
+        return build_document(actors, heads, doc_ops, changes, deflate, op_cols=op_cols)
+
+    def _doc_op_cols_fast(self, remap: List[int]):
+        """Array-native doc-op columns straight from change history.
+
+        The change history (not the python op store) is the source: the
+        native batch decoder flattens it into Lamport-ordered columns
+        (ops/oplog.py), the native preorder walk ranks element order
+        (host_linearize), and the document-order permutation + succ lists
+        are numpy joins — byte-identical output to the per-op
+        ``_doc_ops`` + ``encode_doc_ops`` path, without materializing a
+        single python op. Raises on anything unusual (slow value heap,
+        unresolved refs); the caller falls back to the python path.
+        """
+        import numpy as np
+
+        from ..ops.extract import LazyValues
+        from ..ops.oplog import (
+            ACTOR_BITS,
+            ACTOR_MASK,
+            ELEM_HEAD,
+            ELEM_MISSING,
+            OpLog,
+            host_linearize,
+        )
+        from ..storage.document import encode_doc_ops_arrays
+
+        log = OpLog.from_changes([a.stored for a in self.history])
+        n = log.n
+        if n == 0 or not isinstance(log.values, LazyValues):
+            raise FastSaveUnavailable("needs a non-empty lazy value heap")
+        if np.any(log.elem_ref == ELEM_MISSING):
+            raise FastSaveUnavailable("unresolved element reference in history")
+        ids = log.id_key
+        action = log.action.astype(np.int64)
+        insert = log.insert
+        rank_to_save = np.asarray(
+            [remap[self.actors.lookup(a)] for a in log.actors], np.int64
+        )
+
+        # document-order permutation: objects by packed id (root first),
+        # map keys by string, sequence runs by element order, then Lamport
+        elem_index = host_linearize(
+            {
+                "action": log.action,
+                "insert": log.insert,
+                "elem_ref": log.elem_ref,
+                "obj_dense": log.obj_dense,
+            }
+        ).astype(np.int64)
+        is_map = log.prop >= 0
+        if log.props:
+            order_p = sorted(range(len(log.props)), key=lambda i: log.props[i])
+            str_rank = np.empty(len(log.props), np.int64)
+            for r, i in enumerate(order_p):
+                str_rank[i] = r
+        else:
+            str_rank = np.zeros(1, np.int64)
+        rows_all = np.arange(n, dtype=np.int64)
+        run_row = np.where(
+            insert, rows_all, np.where(log.elem_ref >= 0, log.elem_ref, 0)
+        )
+        sec = np.where(
+            is_map, str_rank[np.clip(log.prop, 0, None)], elem_index[run_row]
+        )
+        rows = np.flatnonzero(action != int(Action.DELETE))
+        perm = rows[np.lexsort((ids[rows], sec[rows], log.obj_key[rows]))]
+        m = len(perm)
+
+        ok = log.obj_key[perm]
+        kr = log.elem_ref[perm].astype(np.int64)
+        seq_m = ~is_map[perm]
+        head_m = seq_m & (kr == ELEM_HEAD)
+        elem_m = seq_m & (kr >= 0)
+        src_ids = ids[np.clip(kr, 0, n - 1)]
+        lv = log.values
+        code = lv.code[perm].astype(np.int64)
+        ln = lv.ln[perm].astype(np.int64)
+        off = lv.off[perm].astype(np.int64)
+        total = int(ln.sum())
+        if total:
+            run_start = np.concatenate([[0], np.cumsum(ln)[:-1]])
+            pos = np.repeat(off, ln) + (
+                np.arange(total, dtype=np.int64) - np.repeat(run_start, ln)
+            )
+            val_raw = np.frombuffer(lv.raw, np.uint8)[pos].tobytes()
+        else:
+            val_raw = b""
+
+        # succ lists: pred edges reversed, grouped by doc position of the
+        # target, ascending source id (op_store add_succ order)
+        pos_of = np.full(n, -1, np.int64)
+        pos_of[perm] = np.arange(m, dtype=np.int64)
+        et = log.pred_tgt.astype(np.int64)
+        es = log.pred_src.astype(np.int64)
+        ev = et >= 0
+        if ev.any():
+            tp = pos_of[et[ev]]
+            if np.any(tp < 0):
+                raise FastSaveUnavailable("succ targets a non-stored row")
+            sid = ids[es[ev]]
+            eorder = np.lexsort((sid, tp))
+            sid = sid[eorder]
+            succ_ctr = (sid >> ACTOR_BITS).astype(np.int64)
+            succ_actor = rank_to_save[sid & ACTOR_MASK]
+            succ_num = np.bincount(tp, minlength=m).astype(np.int64)
+        else:
+            succ_ctr = np.empty(0, np.int64)
+            succ_actor = np.empty(0, np.int64)
+            succ_num = np.zeros(m, np.int64)
+
+        pid = ids[perm]
+        return encode_doc_ops_arrays(
+            {
+                "obj_mask": (ok != 0).astype(np.uint8),
+                "obj_ctr": (ok >> ACTOR_BITS).astype(np.int64),
+                "obj_actor": np.where(ok != 0, rank_to_save[ok & ACTOR_MASK], 0),
+                "key_str_ids": np.where(is_map[perm], log.prop[perm], -1).astype(np.int64),
+                "key_str_table": log.props,
+                "key_ctr": np.where(elem_m, src_ids >> ACTOR_BITS, 0).astype(np.int64),
+                "key_ctr_mask": (head_m | elem_m).astype(np.uint8),
+                "key_actor": np.where(elem_m, rank_to_save[src_ids & ACTOR_MASK], 0),
+                "key_actor_mask": elem_m.astype(np.uint8),
+                "id_ctr": (pid >> ACTOR_BITS).astype(np.int64),
+                "id_actor": rank_to_save[pid & ACTOR_MASK],
+                "insert": insert[perm].astype(np.uint8),
+                "action": action[perm],
+                "val_meta": ((ln << 4) | code).astype(np.int64),
+                "val_raw": val_raw,
+                "succ_num": succ_num,
+                "succ_ctr": succ_ctr,
+                "succ_actor": succ_actor,
+                "expand": log.expand[perm].astype(np.uint8),
+                "mark_ids": log.mark_name_idx[perm].astype(np.int64),
+                "mark_table": log.mark_names,
+            }
+        )
 
     def _doc_ops(self, remap: List[int]) -> List[DocOp]:
         """All stored ops in document order with save-time actor indices."""

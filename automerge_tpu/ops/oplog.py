@@ -468,3 +468,48 @@ def _pad(a: np.ndarray, size: int, fill) -> np.ndarray:
     out = np.full(size, fill, dtype=a.dtype)
     out[: len(a)] = a
     return out
+
+
+def host_linearize(cols_np) -> np.ndarray:
+    """Document-order element indices computed host-side from the numpy
+    columns, overlapping the device kernel.
+
+    Element order depends ONLY on the insert forest (elem_ref / insert /
+    obj_dense) — never on visibility (historical views of one log share
+    one element order) — so the host can rank it from the same arrays it
+    just uploaded, with zero extra device traffic: a lexsort builds the
+    sibling lists (descending Lamport = descending row,
+    reference query/insert.rs) and the native preorder walk ranks them.
+    """
+    from .. import native
+
+    action = np.asarray(cols_np["action"])
+    P = len(action)
+    insert = np.asarray(cols_np["insert"]).astype(bool) & (action != PAD_ACTION)
+    elem_ref = np.asarray(cols_np["elem_ref"])
+    obj_dense = np.asarray(cols_np["obj_dense"])
+    N = 2 * P + 3
+    S = N - 1
+    parent_row = np.where(
+        insert,
+        np.where(
+            elem_ref == ELEM_HEAD,
+            P + obj_dense,
+            np.where(elem_ref >= 0, elem_ref, S),
+        ),
+        S,
+    ).astype(np.int32)
+    er = np.flatnonzero(insert).astype(np.int32)
+    order = np.lexsort((-er, parent_row[er]))
+    sp = parent_row[er][order]
+    sr = er[order]
+    first_child = np.full(N, -1, np.int32)
+    next_sib = np.full(N, -1, np.int32)
+    if len(sr):
+        first = np.concatenate([[True], sp[1:] != sp[:-1]])
+        first_child[sp[first]] = sr[first]
+        same = np.concatenate([sp[1:] == sp[:-1], [False]])
+        nxt = np.concatenate([sr[1:], np.array([-1], np.int32)])
+        next_sib[sr] = np.where(same, nxt, -1)
+    elem_index = native.preorder_index(first_child, next_sib, parent_row, P)
+    return np.where(insert, elem_index, np.int32(-1))

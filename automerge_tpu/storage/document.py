@@ -187,6 +187,75 @@ def encode_doc_ops(ops: List[DocOp]) -> List[Tuple[int, bytes]]:
     ]
 
 
+def _run_bounds(arr):
+    """[(start, end)] of equal-value runs in ``arr``."""
+    import numpy as np
+
+    n = len(arr)
+    if not n:
+        return []
+    b = np.flatnonzero(np.diff(arr)) + 1
+    starts = np.concatenate([[0], b])
+    ends = np.concatenate([b, [n]])
+    return zip(starts.tolist(), ends.tolist())
+
+
+def _str_runs_col(ids, table, enc) -> bytes:
+    """Drive a string RleEncoder from an int-id column (-1 = null) using
+    vectorized run boundaries + O(1) bulk appends."""
+    for s, e in _run_bounds(ids):
+        v = int(ids[s])
+        if v < 0:
+            enc.append_null_run(e - s)
+        else:
+            enc.append_value_run(table[v], e - s)
+    return enc.finish()
+
+
+def _bool_runs_col(vals, enc) -> bytes:
+    for s, e in _run_bounds(vals):
+        enc.append_run(bool(vals[s]), e - s)
+    return enc.finish()
+
+
+def encode_doc_ops_arrays(a) -> List[Tuple[int, bytes]]:
+    """Array-native doc-op column encode: byte-identical to
+    ``encode_doc_ops`` over the materialized DocOp list, built from numpy
+    columns (the fast save path, core/document._doc_op_cols_fast).
+
+    ``a`` fields, all length n in document order with save-time actor
+    indices: obj_ctr/obj_actor/obj_mask, key_str_ids (+key_str_table),
+    key_ctr/key_ctr_mask/key_actor/key_actor_mask, id_ctr/id_actor,
+    insert (u8), action, val_meta, val_raw (bytes), succ_num,
+    succ_ctr/succ_actor (flat), expand (u8), mark_ids (+mark_table).
+    """
+    import numpy as np
+
+    from .. import native
+
+    n = len(a["action"])
+    ones = np.ones(n, np.uint8)
+    ones_s = np.ones(len(a["succ_ctr"]), np.uint8)
+    return [
+        (OP_OBJ_ACTOR, native.rle_encode_array(a["obj_actor"], a["obj_mask"], False)),
+        (OP_OBJ_CTR, native.rle_encode_array(a["obj_ctr"], a["obj_mask"], False)),
+        (OP_KEY_ACTOR, native.rle_encode_array(a["key_actor"], a["key_actor_mask"], False)),
+        (OP_KEY_CTR, native.delta_encode_array(a["key_ctr"], a["key_ctr_mask"])),
+        (OP_KEY_STR, _str_runs_col(a["key_str_ids"], a["key_str_table"], RleEncoder("str"))),
+        (OP_ID_ACTOR, native.rle_encode_array(a["id_actor"], ones, False)),
+        (OP_ID_CTR, native.delta_encode_array(a["id_ctr"], ones)),
+        (OP_INSERT, native.bool_encode_array(a["insert"])),
+        (OP_ACTION, native.rle_encode_array(a["action"], ones, False)),
+        (OP_VAL_META, native.rle_encode_array(a["val_meta"], ones, False)),
+        (OP_VAL_RAW, a["val_raw"]),
+        (OP_SUCC_GROUP, native.rle_encode_array(a["succ_num"], ones, False)),
+        (OP_SUCC_ACTOR, native.rle_encode_array(a["succ_actor"], ones_s, False)),
+        (OP_SUCC_CTR, native.delta_encode_array(a["succ_ctr"], ones_s)),
+        (OP_EXPAND, _bool_runs_col(a["expand"], MaybeBooleanEncoder())),
+        (OP_MARK_NAME, _str_runs_col(a["mark_ids"], a["mark_table"], RleEncoder("str"))),
+    ]
+
+
 def decode_doc_ops(col_data: dict[int, bytes]) -> List[DocOp]:
     def col(s):
         return col_data.get(s, b"")
@@ -350,6 +419,7 @@ def build_document(
     ops: List[DocOp],
     changes: List[DocChangeMeta],
     deflate: bool = True,
+    op_cols: Optional[List[Tuple[int, bytes]]] = None,
 ) -> bytes:
     """Encode a document chunk. ``actors`` must already be sorted."""
     if sorted(actors) != list(actors):
@@ -366,7 +436,8 @@ def build_document(
         data += h
 
     change_cols = encode_doc_changes(changes)
-    op_cols = encode_doc_ops(ops)
+    if op_cols is None:
+        op_cols = encode_doc_ops(ops)
     threshold = DEFLATE_MIN_SIZE if deflate else None
     # Metadata for both column groups precedes both data blocks, so encode
     # them to scratch buffers first.

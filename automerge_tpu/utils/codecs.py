@@ -138,6 +138,16 @@ class RleEncoder:
         if self._state in (_INITIAL_NULLS, _NULLS):
             self._count += n - 1
 
+    def append_value_run(self, value, n: int) -> None:
+        """Append ``n`` equal values in O(1) (bulk run-encoded columns)."""
+        if n <= 0:
+            return
+        self.append_value(value)
+        if n == 1:
+            return
+        self.append_value(value)  # any state + same value twice -> _RUN
+        self._count += n - 2
+
     def append_value(self, value) -> None:
         st = self._state
         if st == _EMPTY:

@@ -84,6 +84,31 @@ def main():
         "batch_ops_per_sec": round(n_b / t_batch, 1),
         "batch_vs_baseline": round(n_b / t_batch / RUST_PIN_REPLAY, 4),
     }
+    if os.environ.get("BENCH_PHASES"):
+        # the reference edit-trace binary's phase report
+        # (rust/edit-trace/src/main.rs:23-55): save / load / fork_at / text
+        t0 = time.perf_counter()
+        saved = doc_b.save()
+        t_save = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        loaded = AutoDoc.load(saved)
+        t_load = time.perf_counter() - t0
+        heads = doc_b.get_heads()
+        t0 = time.perf_counter()
+        forked = doc_b.fork_at(heads)
+        t_fork = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        txt = loaded.text(tobj_b)
+        t_text = time.perf_counter() - t0
+        assert forked.get_heads() == heads
+        results["replay"]["phases_ms"] = {
+            "save": round(t_save * 1000, 1),
+            "load": round(t_load * 1000, 1),
+            "fork_at": round(t_fork * 1000, 1),
+            "text": round(t_text * 1000, 1),
+            "save_bytes": len(saved),
+            "text_len": len(txt),
+        }
     note(f"replay: {results['replay']}")
     del doc, doc_b
 

@@ -185,3 +185,53 @@ class TestChangeChunk:
         )
         parsed, _ = parse_change(change.raw_bytes)
         assert [op.value for op in parsed.ops] == kinds
+
+
+def test_fast_save_columns_match_python_path():
+    """The array-native doc-op encoder (_doc_op_cols_fast +
+    encode_doc_ops_arrays) produces byte-identical columns to the per-op
+    python path on a doc with marks, counters, conflicts, nested objects,
+    deletes, and multi-actor merges."""
+    from automerge_tpu.api import AutoDoc
+    from automerge_tpu.storage.document import encode_doc_ops
+    from automerge_tpu.types import ActorId, ObjType, ScalarValue
+
+    d = AutoDoc(actor=ActorId(bytes([1]) * 16))
+    t = d.put_object("_root", "t", ObjType.TEXT)
+    d.splice_text(t, 0, 0, "hello world")
+    d.put("_root", "c", ScalarValue("counter", 5))
+    d.put("_root", "n", None)
+    d.put("_root", "f", 1.5)
+    d.put("_root", "b", True)
+    d.mark(t, 0, 5, "bold", True, expand="both")
+    lst = d.put_object("_root", "l", ObjType.LIST)
+    for i in range(6):
+        d.insert(lst, i, i)
+    m = d.insert_object(lst, 2, ObjType.MAP)
+    d.put(m, "deep", "x")
+    d.commit()
+    for i in range(5):
+        f = d.fork(actor=ActorId(bytes([10 + i]) * 16))
+        f.splice_text(t, i, 1, "XY")
+        f.increment("_root", "c", i)
+        if f.length(lst) > 1:
+            f.delete(lst, 0)
+        f.put(m, "deep", f"v{i}")
+        f.commit()
+        d.merge(f)
+    d.splice_text(t, 2, 3, "")
+    d.commit()
+
+    doc = d.doc
+    sorted_idx = doc.actors.sorted_order()
+    remap = [0] * len(sorted_idx)
+    for p, g in enumerate(sorted_idx):
+        remap[g] = p
+    fast_cols = doc._doc_op_cols_fast(remap)
+    slow_cols = encode_doc_ops(doc._doc_ops(remap))
+    assert [s for s, _ in fast_cols] == [s for s, _ in slow_cols]
+    for (s, a), (_, b) in zip(fast_cols, slow_cols):
+        assert a == b, f"column {s} diverged"
+    d2 = AutoDoc.load(d.save())
+    assert d2.hydrate() == d.hydrate()
+    assert d2.save() == d.save()
