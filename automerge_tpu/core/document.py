@@ -1141,7 +1141,7 @@ class Document:
                 chunk_type = data[pos + 8]
                 if chunk_type == CHUNK_DOCUMENT:
                     parsed, pos = parse_document(data, pos)
-                    changes = reconstruct_changes(parsed, verify=verify)
+                    changes = _reconstruct(parsed, verify)
                 else:
                     change, pos = parse_change(data, pos)
                     changes = [change]
@@ -1152,6 +1152,28 @@ class Document:
             self.apply_changes(changes)
             applied += 1
         return applied
+
+
+def _reconstruct(parsed: ParsedDocument, verify: bool) -> List[StoredChange]:
+    """Fast vectorized reconstruction when the native core is present;
+    per-op python path otherwise (and as the precise-error fallback)."""
+    import os
+
+    from .. import native
+
+    from ..ops.extract import ExtractError
+
+    if native.available():
+        try:
+            return reconstruct_changes_fast(parsed, verify=verify)
+        except ExtractError:
+            pass  # irregular input shape: the python path decides
+        except AutomergeError:
+            raise  # real validation failures carry over as-is
+        except Exception:
+            if os.environ.get("AUTOMERGE_TPU_DEBUG"):
+                raise
+    return reconstruct_changes(parsed, verify=verify)
 
 
 class _ReOp:
@@ -1169,6 +1191,300 @@ class _ReOp:
         self.pred = pred
         self.expand = expand
         self.mark_name = mark_name
+
+
+def reconstruct_changes_fast(doc: ParsedDocument, verify: bool = True) -> List[StoredChange]:
+    """Vectorized change reconstruction from a document chunk.
+
+    The array mirror of ``reconstruct_changes`` (reference:
+    storage/load/reconstruct_document.rs, load/change_collector.rs):
+    native column decode, numpy pred-from-succ + delete synthesis +
+    change assignment, array-native per-change column re-encode for head
+    hashing. Raises ExtractError (or any decode error) on irregular
+    input — the caller falls back to the per-op python path, which
+    reports precise errors for genuinely malformed files.
+    """
+    import numpy as np
+
+    from ..ops.extract import ExtractError, doc_op_arrays, validate_doc_arrays
+    from ..storage.change import LazyOps, encode_change_cols_arrays
+
+    a = getattr(doc, "op_arrays", None)
+    if a is None:
+        a = doc_op_arrays(doc.op_col_data or {})
+        validate_doc_arrays(a, len(doc.actors))
+    n = a["n"]
+    n_actors = len(doc.actors)
+    B = 20
+    if n_actors >= (1 << B):
+        raise ExtractError("too many actors for the packed fast path")
+
+    rid = (a["id_ctr"] << B) | a["id_actor"]
+    okey = np.where(a["obj_mask"], (a["obj_ctr"] << B) | a["obj_actor"], 0)
+
+    # object segments (doc ops are object-grouped, objects ascending)
+    if n:
+        bnd = np.concatenate([[True], okey[1:] != okey[:-1]])
+        seg_first = np.flatnonzero(bnd)
+        seg_keys = okey[seg_first]
+        if len(seg_keys) > 1 and np.any(np.diff(seg_keys) <= 0):
+            raise AutomergeError("document ops out of object order")
+        seg = (np.cumsum(bnd) - 1).astype(np.int64)
+        n_segs = len(seg_first)
+    else:
+        seg = np.zeros(0, np.int64)
+        seg_keys = np.zeros(0, np.int64)
+        n_segs = 0
+
+    # succ edges -> stored targets or synthesized deletes
+    er = np.repeat(np.arange(n, dtype=np.int64), a["succ_num"])
+    eid = (a["succ_ctr"] << B) | a["succ_actor"]
+    eseg = seg[er] if len(er) else np.zeros(0, np.int64)
+    order = np.lexsort((rid, seg)) if n else np.zeros(0, np.int64)
+    srid = rid[order] if n else rid
+    sseg = seg[order] if n else seg
+    seg_start = np.searchsorted(sseg, np.arange(n_segs))
+    seg_end = np.searchsorted(sseg, np.arange(n_segs), side="right")
+    etgt = np.full(len(er), -1, np.int64)
+    if len(er):
+        # eseg is non-decreasing (er ascending, seg non-decreasing): each
+        # segment's edges are one contiguous slice — O(E log) total
+        e_lo = np.searchsorted(eseg, np.arange(n_segs))
+        e_hi = np.searchsorted(eseg, np.arange(n_segs), side="right")
+        for s in range(n_segs):
+            lo, hi = int(e_lo[s]), int(e_hi[s])
+            if lo == hi:
+                continue
+            idxs = np.arange(lo, hi)
+            s0, s1 = int(seg_start[s]), int(seg_end[s])
+            block = srid[s0:s1]
+            p = np.searchsorted(block, eid[idxs])
+            pc = np.clip(p, 0, max(len(block) - 1, 0))
+            hit = (p < len(block)) & (block[pc] == eid[idxs]) if len(block) else np.zeros(len(idxs), bool)
+            etgt[idxs[hit]] = order[s0 + p[hit]]
+
+    # synthesized delete ops: one per unique dangling (segment, succ id)
+    miss = np.flatnonzero(etgt < 0)
+    if len(miss):
+        dkey = np.stack([eseg[miss], eid[miss]], axis=1)
+        uniq, inv = np.unique(dkey, axis=0, return_inverse=True)
+        d = len(uniq)
+        del_seg = uniq[:, 0]
+        del_id = uniq[:, 1]
+        # the min-id pred source carries the key the delete targets
+        src_id_miss = rid[er[miss]]
+        min_src_row = np.full(d, -1, np.int64)
+        ordm = np.lexsort((src_id_miss, inv))
+        first = np.concatenate([[True], inv[ordm][1:] != inv[ordm][:-1]])
+        min_src_row[inv[ordm][first]] = er[miss][ordm][first]
+        src_act = a["action"][min_src_row]
+        if not np.all(np.isin(src_act, (0, 1, 2, 4, 6))):
+            raise AutomergeError("no set op found for delete")
+    else:
+        d = 0
+        del_seg = np.zeros(0, np.int64)
+        del_id = np.zeros(0, np.int64)
+        min_src_row = np.zeros(0, np.int64)
+        inv = np.zeros(0, np.int64)
+
+    # combined op table: stored rows [0, n) + deletes [n, n + d)
+    N = n + d
+    c_id = np.concatenate([rid, del_id])
+    c_obj = np.concatenate([okey, seg_keys[del_seg] if d else np.zeros(0, np.int64)])
+    c_action = np.concatenate([a["action"], np.full(d, int(Action.DELETE), np.int64)])
+    c_insert = np.concatenate([a["insert"], np.zeros(d, np.uint8)])
+    c_expand = np.concatenate([a["expand"], np.zeros(d, np.uint8)])
+    c_mark = np.concatenate([a["mark_ids"], np.full(d, -1, np.int32)])
+    # delete keys inherit the min source's key (set_keys in the python path):
+    # its map key id, or its element (own id when insert, else its key elem)
+    ms = min_src_row
+    d_key_ids = a["key_ids"][ms] if d else np.zeros(0, np.int32)
+    ms_ins = a["insert"][ms].astype(bool) if d else np.zeros(0, bool)
+    d_elem_from_key = (a["key_ctr"][ms] << B) | a["key_actor"][ms] if d else np.zeros(0, np.int64)
+    d_elem_head = ~ms_ins & (a["key_ctr"][ms] == 0) & ~a["key_actor_mask"][ms] if d else np.zeros(0, bool)
+    d_elem = np.where(ms_ins, rid[ms] if d else 0, d_elem_from_key) if d else np.zeros(0, np.int64)
+    d_seqkey = d_key_ids < 0
+    c_key_ids = np.concatenate([a["key_ids"], d_key_ids])
+    # element key per combined op: ctr/actor/masks
+    s_head = a["key_ctr_mask"] & (a["key_ctr"] == 0) & ~a["key_actor_mask"]
+    s_elem_m = a["key_ctr_mask"] & a["key_actor_mask"]
+    bad_key = (a["key_ids"] < 0) & ~s_head & ~s_elem_m
+    if bad_key.any():
+        raise AutomergeError("neither map key nor elem id present")
+    c_key_ctr = np.concatenate([
+        np.where(s_head, 0, a["key_ctr"]),
+        np.where(d_seqkey & ~d_elem_head, d_elem >> B, 0),
+    ])
+    c_key_ctr_m = np.concatenate([
+        (s_head | s_elem_m).astype(np.uint8),
+        (d_seqkey).astype(np.uint8),
+    ])
+    c_key_actor = np.concatenate([
+        np.where(s_elem_m, a["key_actor"], 0),
+        np.where(d_seqkey & ~d_elem_head, d_elem & ((1 << B) - 1), 0),
+    ])
+    c_key_actor_m = np.concatenate([
+        s_elem_m.astype(np.uint8),
+        (d_seqkey & ~d_elem_head).astype(np.uint8),
+    ])
+    c_vlen = np.concatenate([a["vlen"], np.zeros(d, np.int64)])
+    c_voff = np.concatenate([a["voff"], np.zeros(d, np.int64)])
+    c_vcode = np.concatenate([a["vcode"].astype(np.int64), np.zeros(d, np.int64)])
+
+    # pred lists: every succ edge reversed; per combined op, ascending src id
+    if len(er):
+        e_tgt_all = np.where(etgt >= 0, etgt, n + inv_full(miss, inv, len(er)))
+    else:
+        e_tgt_all = np.zeros(0, np.int64)
+    e_src_id = rid[er] if len(er) else np.zeros(0, np.int64)
+    eo = np.lexsort((e_src_id, e_tgt_all)) if len(er) else np.zeros(0, np.int64)
+    pred_tgt_sorted = e_tgt_all[eo]
+    pred_src_sorted = e_src_id[eo]
+    pred_num_c = np.bincount(e_tgt_all, minlength=N).astype(np.int64) if len(er) else np.zeros(N, np.int64)
+    pred_off_c = np.concatenate([[0], np.cumsum(pred_num_c)]).astype(np.int64)
+
+    # change assignment: per actor, first change with max_op >= op counter
+    metas = doc.changes
+    by_actor: Dict[int, List[int]] = {}
+    for i, ch in enumerate(metas):
+        by_actor.setdefault(ch.actor, []).append(i)
+    for lst in by_actor.values():
+        prev = -1
+        for i in lst:
+            if metas[i].max_op < prev:
+                raise AutomergeError("document changes out of order")
+            prev = metas[i].max_op
+    c_actor = (c_id & ((1 << B) - 1)).astype(np.int64)
+    c_ctr = (c_id >> B).astype(np.int64)
+    change_of = np.full(N, -1, np.int64)
+    for act in np.unique(c_actor) if N else []:
+        lst = by_actor.get(int(act))
+        rows_a = np.flatnonzero(c_actor == act)
+        if not lst:
+            raise AutomergeError(f"op has no owning change (actor {act})")
+        maxops = np.asarray([metas[i].max_op for i in lst], np.int64)
+        pos = np.searchsorted(maxops, c_ctr[rows_a], side="left")
+        if np.any(pos == len(lst)):
+            raise AutomergeError("op beyond last change of its actor")
+        change_of[rows_a] = np.asarray(lst, np.int64)[pos]
+
+    # per-change chunk build (ops ascending by id within a change)
+    actor_bytes = doc.actors
+    rawbuf = np.frombuffer(a["vraw"], np.uint8) if len(a["vraw"]) else np.zeros(0, np.uint8)
+    changes_out: List[StoredChange] = []
+    hash_by_index: Dict[int, bytes] = {}
+    derived_heads: Set[bytes] = set()
+    order_c = np.lexsort((c_id, change_of)) if N else np.zeros(0, np.int64)
+    co_sorted = change_of[order_c] if N else change_of
+    starts = np.searchsorted(co_sorted, np.arange(len(metas)))
+    ends = np.searchsorted(co_sorted, np.arange(len(metas)), side="right")
+    for idx, meta in enumerate(metas):
+        rows_c = order_c[int(starts[idx]) : int(ends[idx])]
+        num_ops = len(rows_c)
+        if num_ops > meta.max_op:
+            raise AutomergeError("incorrect max_op in document change")
+        start_op = meta.max_op - num_ops + 1
+        if start_op < 1:
+            raise AutomergeError("change start_op underflow")
+        author = meta.actor
+        # ragged pred slice for these ops
+        pn = pred_num_c[rows_c]
+        tp = int(pn.sum())
+        if tp:
+            rs = np.concatenate([[0], np.cumsum(pn)[:-1]])
+            pidx = np.repeat(pred_off_c[rows_c], pn) + (
+                np.arange(tp, dtype=np.int64) - np.repeat(rs, pn)
+            )
+            p_ids = pred_src_sorted[pidx]
+        else:
+            p_ids = np.zeros(0, np.int64)
+        # chunk-local actor table: author first, referenced sorted by bytes
+        refs = set()
+        ob = c_obj[rows_c]
+        refs.update((ob[ob != 0] & ((1 << B) - 1)).tolist())
+        kam = c_key_actor_m[rows_c].astype(bool)
+        refs.update(c_key_actor[rows_c][kam].tolist())
+        refs.update((p_ids & ((1 << B) - 1)).tolist())
+        refs.discard(author)
+        other = sorted(refs, key=lambda g: actor_bytes[g])
+        lut = np.full(n_actors, -1, np.int64)
+        lut[author] = 0
+        for j, g in enumerate(other):
+            lut[g] = j + 1
+        # value raw gather
+        vl = c_vlen[rows_c]
+        tv = int(vl.sum())
+        if tv:
+            rs2 = np.concatenate([[0], np.cumsum(vl)[:-1]])
+            vpos = np.repeat(c_voff[rows_c], vl) + (
+                np.arange(tv, dtype=np.int64) - np.repeat(rs2, vl)
+            )
+            val_raw = rawbuf[vpos].tobytes()
+        else:
+            val_raw = b""
+        cols = encode_change_cols_arrays(
+            {
+                "obj_mask": (ob != 0).astype(np.uint8),
+                "obj_ctr": (ob >> B).astype(np.int64),
+                "obj_actor": np.where(ob != 0, lut[ob & ((1 << B) - 1)], 0),
+                "key_str_ids": c_key_ids[rows_c],
+                "key_str_table": a["key_table"],
+                "key_ctr": c_key_ctr[rows_c],
+                "key_ctr_mask": c_key_ctr_m[rows_c],
+                "key_actor": np.where(kam, lut[c_key_actor[rows_c]], 0),
+                "key_actor_mask": c_key_actor_m[rows_c],
+                "insert": c_insert[rows_c],
+                "action": c_action[rows_c],
+                "val_meta": ((vl << 4) | c_vcode[rows_c]).astype(np.int64),
+                "val_raw": val_raw,
+                "pred_num": pn.astype(np.int64),
+                "pred_ctr": (p_ids >> B).astype(np.int64),
+                "pred_actor": lut[p_ids & ((1 << B) - 1)],
+                "expand": c_expand[rows_c],
+                "mark_ids": c_mark[rows_c],
+                "mark_table": a["mark_table"],
+            }
+        )
+        deps = []
+        for dd in meta.deps:
+            if dd not in hash_by_index:
+                raise AutomergeError(f"change {idx} depends on later change {dd}")
+            deps.append(hash_by_index[dd])
+        stored = StoredChange(
+            dependencies=deps,
+            actor=actor_bytes[author],
+            other_actors=[actor_bytes[g] for g in other],
+            seq=meta.seq,
+            start_op=start_op,
+            timestamp=meta.timestamp,
+            message=meta.message,
+            ops=LazyOps({}, num_ops),
+            extra_bytes=meta.extra,
+        )
+        change = build_change(stored, cols=cols)
+        change.ops = LazyOps(change.op_col_data, num_ops)
+        hash_by_index[idx] = change.hash
+        for dd in deps:
+            derived_heads.discard(dd)
+        derived_heads.add(change.hash)
+        changes_out.append(change)
+
+    if verify and derived_heads != set(doc.heads):
+        raise AutomergeError(
+            "mismatching heads: derived "
+            f"{sorted(h.hex()[:8] for h in derived_heads)} vs stored "
+            f"{sorted(h.hex()[:8] for h in doc.heads)}"
+        )
+    return changes_out
+
+
+def inv_full(miss_idx, inv, n_edges):
+    """Scatter the unique-delete inverse back onto the full edge array."""
+    import numpy as np
+
+    out = np.zeros(n_edges, np.int64)
+    out[miss_idx] = inv
+    return out
 
 
 def reconstruct_changes(doc: ParsedDocument, verify: bool = True) -> List[StoredChange]:

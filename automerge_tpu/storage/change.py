@@ -311,6 +311,43 @@ def encode_ops_with_tail(prefix_ops: Sequence[ChangeOp], tail) -> List[Tuple[int
     ]
 
 
+def encode_change_cols_arrays(a) -> List[Tuple[int, bytes]]:
+    """Full-array change-op column encode — byte-identical to
+    ``encode_change_ops`` over the materialized ChangeOp list (the fast
+    document-load path re-encoding reconstructed changes for hashing).
+
+    ``a`` fields, all length n in op-id order with chunk-local actor
+    indices: obj_ctr/obj_actor/obj_mask, key_str_ids (+key_str_table),
+    key_ctr/key_ctr_mask/key_actor/key_actor_mask, insert (u8), action,
+    val_meta, val_raw (bytes), pred_num, pred_ctr/pred_actor (flat),
+    expand (u8), mark_ids (+mark_table).
+    """
+    import numpy as np
+
+    from .. import native
+    from ..utils.codecs import _bool_runs_col, _str_runs_col
+
+    n = len(a["action"])
+    ones = np.ones(n, np.uint8)
+    ones_p = np.ones(len(a["pred_ctr"]), np.uint8)
+    return [
+        (COL_OBJ_ACTOR, native.rle_encode_array(a["obj_actor"], a["obj_mask"], False)),
+        (COL_OBJ_CTR, native.rle_encode_array(a["obj_ctr"], a["obj_mask"], False)),
+        (COL_KEY_ACTOR, native.rle_encode_array(a["key_actor"], a["key_actor_mask"], False)),
+        (COL_KEY_CTR, native.delta_encode_array(a["key_ctr"], a["key_ctr_mask"])),
+        (COL_KEY_STR, _str_runs_col(a["key_str_ids"], a["key_str_table"], RleEncoder("str"))),
+        (COL_INSERT, native.bool_encode_array(a["insert"])),
+        (COL_ACTION, native.rle_encode_array(a["action"], ones, False)),
+        (COL_VAL_META, native.rle_encode_array(a["val_meta"], ones, False)),
+        (COL_VAL_RAW, a["val_raw"]),
+        (COL_PRED_GROUP, native.rle_encode_array(a["pred_num"], ones, False)),
+        (COL_PRED_ACTOR, native.rle_encode_array(a["pred_actor"], ones_p, False)),
+        (COL_PRED_CTR, native.delta_encode_array(a["pred_ctr"], ones_p)),
+        (COL_EXPAND, _bool_runs_col(a["expand"], MaybeBooleanEncoder())),
+        (COL_MARK_NAME, _str_runs_col(a["mark_ids"], a["mark_table"], RleEncoder("str"))),
+    ]
+
+
 def decode_change_ops(col_data: dict[int, bytes]) -> List[ChangeOp]:
     """Decode op columns from a dict of normalized spec -> bytes."""
 

@@ -25,7 +25,9 @@ from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
 from ..types import Key, OpId, ScalarValue
-from ..utils.codecs import (
+from ..utils.codecs import (  # noqa: F401
+    _bool_runs_col,
+    _str_runs_col,
     BooleanEncoder,
     DeltaEncoder,
     MaybeBooleanEncoder,
@@ -103,14 +105,37 @@ class DocChangeMeta:
     extra: bytes = b""
 
 
-@dataclass
 class ParsedDocument:
-    actors: List[bytes]
-    heads: List[bytes]
-    ops: List[DocOp]
-    changes: List[DocChangeMeta]
-    head_indices: List[int]
-    checksum_valid: bool
+    """A parsed document chunk. ``ops`` decodes lazily from the retained
+    column bytes — the fast load path (doc_op_arrays) reads
+    ``op_col_data`` directly and never materializes DocOp objects."""
+
+    __slots__ = (
+        "actors", "heads", "changes", "head_indices", "checksum_valid",
+        "op_col_data", "op_arrays", "_ops",
+    )
+
+    def __init__(
+        self, actors, heads, changes, head_indices, checksum_valid,
+        op_col_data=None, ops=None,
+    ):
+        self.actors = actors
+        self.heads = heads
+        self.changes = changes
+        self.head_indices = head_indices
+        self.checksum_valid = checksum_valid
+        self.op_col_data = op_col_data
+        self.op_arrays = None  # retained native column arrays (fast load)
+        self._ops = ops
+
+    @property
+    def ops(self) -> List[DocOp]:
+        if self._ops is None:
+            ops = decode_doc_ops(self.op_col_data or {})
+            for i, op in enumerate(ops):
+                _check_doc_actor_bounds(op, i, len(self.actors))
+            self._ops = ops
+        return self._ops
 
 
 def encode_doc_ops(ops: List[DocOp]) -> List[Tuple[int, bytes]]:
@@ -185,37 +210,6 @@ def encode_doc_ops(ops: List[DocOp]) -> List[Tuple[int, bytes]]:
         (OP_EXPAND, expand.finish()),
         (OP_MARK_NAME, mark_name.finish()),
     ]
-
-
-def _run_bounds(arr):
-    """[(start, end)] of equal-value runs in ``arr``."""
-    import numpy as np
-
-    n = len(arr)
-    if not n:
-        return []
-    b = np.flatnonzero(np.diff(arr)) + 1
-    starts = np.concatenate([[0], b])
-    ends = np.concatenate([b, [n]])
-    return zip(starts.tolist(), ends.tolist())
-
-
-def _str_runs_col(ids, table, enc) -> bytes:
-    """Drive a string RleEncoder from an int-id column (-1 = null) using
-    vectorized run boundaries + O(1) bulk appends."""
-    for s, e in _run_bounds(ids):
-        v = int(ids[s])
-        if v < 0:
-            enc.append_null_run(e - s)
-        else:
-            enc.append_value_run(table[v], e - s)
-    return enc.finish()
-
-
-def _bool_runs_col(vals, enc) -> bytes:
-    for s, e in _run_bounds(vals):
-        enc.append_run(bool(vals[s]), e - s)
-    return enc.finish()
 
 
 def encode_doc_ops_arrays(a) -> List[Tuple[int, bytes]]:
@@ -502,23 +496,36 @@ def parse_document(buf: bytes, pos: int = 0) -> tuple[ParsedDocument, int]:
             head_indices.append(idx)
 
     changes = decode_doc_changes(change_data)
-    ops = decode_doc_ops(op_data)
-    for i, op in enumerate(ops):
-        _check_doc_actor_bounds(op, i, nactors)
     for i, ch in enumerate(changes):
         if ch.actor >= nactors:
             raise ValueError(f"doc change {i} references missing actor {ch.actor}")
-    return (
-        ParsedDocument(
-            actors=actors,
-            heads=heads,
-            ops=ops,
-            changes=changes,
-            head_indices=head_indices,
-            checksum_valid=chunk.checksum_valid,
-        ),
-        end,
+    parsed = ParsedDocument(
+        actors=actors,
+        heads=heads,
+        changes=changes,
+        head_indices=head_indices,
+        checksum_valid=chunk.checksum_valid,
+        op_col_data=dict(op_data),
     )
+    # op-column validation: native array decode when available (arrays are
+    # retained for the fast reconstruction); per-op python decode otherwise.
+    # Either way malformed op columns are rejected HERE, as before.
+    from .. import native as _native
+
+    validated = False
+    if _native.available():
+        from ..ops.extract import ExtractError, doc_op_arrays, validate_doc_arrays
+
+        try:
+            arrs = doc_op_arrays(parsed.op_col_data)
+            validate_doc_arrays(arrs, nactors)
+            parsed.op_arrays = arrs
+            validated = True
+        except ExtractError:
+            pass  # irregular shape: the python decoder is the authority
+    if not validated:
+        parsed.ops  # noqa: B018 — decode + per-op bounds checks, may raise
+    return (parsed, end)
 
 
 def _check_doc_actor_bounds(op: DocOp, i: int, n_actors: int) -> None:

@@ -347,3 +347,34 @@ class MaybeBooleanEncoder:
         if self._all_false:
             return b""
         return self._inner.finish()
+
+
+def _run_bounds(arr):
+    """[(start, end)] of equal-value runs in ``arr``."""
+    import numpy as np
+
+    n = len(arr)
+    if not n:
+        return []
+    b = np.flatnonzero(np.diff(arr)) + 1
+    starts = np.concatenate([[0], b])
+    ends = np.concatenate([b, [n]])
+    return zip(starts.tolist(), ends.tolist())
+
+
+def _str_runs_col(ids, table, enc) -> bytes:
+    """Drive a string RleEncoder from an int-id column (-1 = null) using
+    vectorized run boundaries + O(1) bulk appends."""
+    for s, e in _run_bounds(ids):
+        v = int(ids[s])
+        if v < 0:
+            enc.append_null_run(e - s)
+        else:
+            enc.append_value_run(table[v], e - s)
+    return enc.finish()
+
+
+def _bool_runs_col(vals, enc) -> bytes:
+    for s, e in _run_bounds(vals):
+        enc.append_run(bool(vals[s]), e - s)
+    return enc.finish()

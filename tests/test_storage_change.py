@@ -235,3 +235,44 @@ def test_fast_save_columns_match_python_path():
     d2 = AutoDoc.load(d.save())
     assert d2.hydrate() == d.hydrate()
     assert d2.save() == d.save()
+
+
+def test_fast_reconstruct_matches_python_path():
+    """reconstruct_changes_fast rebuilds byte-identical change chunks to
+    the per-op python path on a doc with deletes (succ synthesis), marks,
+    counters, conflicts, and multi-actor merges."""
+    from automerge_tpu.api import AutoDoc
+    from automerge_tpu.core.document import (
+        reconstruct_changes,
+        reconstruct_changes_fast,
+    )
+    from automerge_tpu.storage.document import parse_document
+    from automerge_tpu.types import ActorId, ObjType, ScalarValue
+
+    d = AutoDoc(actor=ActorId(bytes([1]) * 16))
+    t = d.put_object("_root", "t", ObjType.TEXT)
+    d.splice_text(t, 0, 0, "reconstruct me")
+    d.put("_root", "c", ScalarValue("counter", 1))
+    d.mark(t, 0, 5, "bold", True)
+    lst = d.put_object("_root", "l", ObjType.LIST)
+    for i in range(5):
+        d.insert(lst, i, i)
+    d.commit()
+    for i in range(4):
+        f = d.fork(actor=ActorId(bytes([20 + i]) * 16))
+        f.splice_text(t, i * 2, 1, "AB")
+        f.increment("_root", "c", i)
+        f.put("_root", "k", i)  # concurrent map conflict
+        if f.length(lst) > 0:
+            f.delete(lst, 0)
+        f.commit()
+        d.merge(f)
+    d.commit()
+    data = d.save()
+    parsed, _ = parse_document(data)
+    fast = reconstruct_changes_fast(parsed, verify=True)
+    slow = reconstruct_changes(parsed, verify=True)
+    assert len(fast) == len(slow)
+    for x, y in zip(fast, slow):
+        assert x.raw_bytes == y.raw_bytes
+        assert x.hash == y.hash
