@@ -24,7 +24,7 @@ from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
 
-from ..types import Action, ActorId, ObjType, is_make_action, objtype_for_action
+from ..types import Action, ActorId, ObjType, ScalarValue, is_make_action, objtype_for_action
 from .op_store import Element, MapObject, ObjInfo, Op, OpStore, SeqObject
 
 ACTOR_BITS = 20  # shared packing with ops/oplog.py
@@ -72,6 +72,9 @@ def _flatten_fast(changes: Sequence) -> Dict[str, object]:
         "pred_off": np.concatenate([[0], np.cumsum(a["pred_num"])]).astype(np.int64),
         "pred_flat": r["pred_key"].astype(np.int64),
         "rank_of": rank_of,
+        # full ranked batch: lets the rebuild construct store Ops straight
+        # from arrays instead of materializing ChangeOp objects
+        "rb": r,
     }
 
 
@@ -163,6 +166,145 @@ def _export_via_device(stored, flat):
     return obj_keys, obj_off, elem_rows
 
 
+def _build_ops_from_changes(doc, stored, ops, objs_of, sort_key) -> None:
+    """Per-ChangeOp store-Op construction (fallback when the batch column
+    decode is unavailable)."""
+    row = 0
+    for ch in stored:
+        amap = [doc.actors.cache(ActorId(a)) for a in ch.actors]
+        author = amap[0]
+        start = ch.start_op
+        for i, cop in enumerate(ch.ops):
+            key = doc.props.cache(cop.key.prop) if cop.key.prop is not None else None
+            if key is None:
+                e = cop.key.elem
+                elem = (0, 0) if e[0] == 0 else (e[0], amap[e[1]])
+            else:
+                elem = None
+            pred = [(p[0], amap[p[1]]) for p in cop.pred]
+            if len(pred) > 1:
+                pred.sort(key=sort_key)
+            op = Op(
+                id=(start + i, author),
+                action=cop.action,
+                value=cop.value,
+                key=key,
+                elem=elem,
+                insert=cop.insert,
+                pred=pred,
+                mark_name=cop.mark_name,
+                expand=cop.expand,
+            )
+            ops[row] = op
+            o = cop.obj
+            objs_of[row] = (0, 0) if o[0] == 0 else (o[0], amap[o[1]])
+            row += 1
+
+
+_INT_TAG = {3: "uint", 4: "int", 8: "counter", 9: "timestamp"}
+
+
+def _build_ops_from_arrays(doc, flat, ops, objs_of, sort_key) -> None:
+    """Array-driven store-Op construction: straight from the ranked batch
+    columns (no ChangeOp materialization). Value semantics match
+    storage/values decoding exactly — common codes inline, everything
+    else through _decode_one."""
+    from ..storage.values import _decode_one
+
+    rb = flat["rb"]
+    a = rb["a"]
+    n = len(flat["op_id"])
+    mask = (1 << ACTOR_BITS) - 1
+    rank_of = flat["rank_of"]
+    rank_bytes = sorted(rank_of, key=rank_of.get)
+    r2g = [doc.actors.cache(ActorId(b)) for b in rank_bytes]
+    key_g = [doc.props.cache(s) for s in a["key_table"]]
+    mark_tab = a["mark_table"]
+
+    op_id = flat["op_id"]
+    id_ctr = (op_id >> ACTOR_BITS).tolist()
+    id_a = [r2g[x] for x in (op_id & mask).tolist()]
+    obj_l = flat["obj"].tolist()
+    elem_l = flat["elem"].tolist()
+    prop_l = rb["prop_ids"].tolist()
+    action_l = flat["action"].tolist()
+    insert_l = a["insert"].tolist()
+    expand_l = a["expand"].tolist()
+    mark_l = (
+        a["mark_ids"].tolist() if a["mark_ids"] is not None else [-1] * n
+    )
+    vcode_l = a["vcode"].tolist()
+    voff_l = a["voff"].tolist()
+    vlen_l = a["vlen"].tolist()
+    vint_l = a["value_int"].tolist()
+    raw = a["vraw"]
+    pred_num = a["pred_num"].tolist()
+    pf = flat["pred_flat"]
+    pf_ctr = (pf >> ACTOR_BITS).tolist()
+    pf_a = [r2g[x] for x in (pf & mask).tolist()]
+
+    NULL_V = ScalarValue("null")
+    TRUE_V = ScalarValue("bool", True)
+    FALSE_V = ScalarValue("bool", False)
+    HEAD_T = (0, 0)
+    ROOT_T = (0, 0)
+    _new = Op.__new__
+    pv = 0
+    for i in range(n):
+        code = vcode_l[i]
+        if code == 6:
+            o = voff_l[i]
+            v = ScalarValue("str", raw[o : o + vlen_l[i]].decode("utf-8"))
+        elif code == 0:
+            v = NULL_V
+        elif code == 3 or code == 4 or code == 8 or code == 9:
+            # the native decoder wraps values outside i64 (uint >= 2^63,
+            # overlong LEBs): re-decode those few through the exact path
+            if (code == 3 and vint_l[i] < 0) or vlen_l[i] >= 10:
+                o = voff_l[i]
+                v = _decode_one(code, raw[o : o + vlen_l[i]])
+            else:
+                v = ScalarValue(_INT_TAG[code], vint_l[i])
+        elif code == 2:
+            v = TRUE_V
+        elif code == 1:
+            v = FALSE_V
+        else:
+            o = voff_l[i]
+            v = _decode_one(code, raw[o : o + vlen_l[i]])
+        op = _new(Op)
+        op.id = (id_ctr[i], id_a[i])
+        op.action = action_l[i]
+        p = prop_l[i]
+        if p >= 0:
+            op.key = key_g[p]
+            op.elem = None
+        else:
+            op.key = None
+            e = elem_l[i]
+            op.elem = HEAD_T if e == 0 else (e >> ACTOR_BITS, r2g[e & mask])
+        op.insert = insert_l[i]
+        op.value = v
+        k = pred_num[i]
+        if k == 0:
+            op.pred = []
+        elif k == 1:
+            op.pred = [(pf_ctr[pv], pf_a[pv])]
+        else:
+            pr = [(pf_ctr[pv + j], pf_a[pv + j]) for j in range(k)]
+            pr.sort(key=sort_key)
+            op.pred = pr
+        pv += k
+        op.succ = []
+        op.incs = []
+        m = mark_l[i]
+        op.mark_name = mark_tab[m] if m >= 0 else None
+        op.expand = expand_l[i]
+        ops[i] = op
+        ob = obj_l[i]
+        objs_of[i] = ROOT_T if ob == 0 else (ob >> ACTOR_BITS, r2g[ob & mask])
+
+
 # dense-concurrency threshold: at or past this shape the sequential RGA
 # sibling scan loses to one batched kernel pass even counting transport
 DEVICE_MIN_OPS = 20_000
@@ -208,37 +350,11 @@ def rebuild_op_store(doc) -> None:
     n = len(flat["op_id"])
     ops: List[Op] = [None] * n
     objs_of: List[Tuple[int, int]] = [None] * n  # (obj ctr, obj doc-idx)
-    row = 0
     sort_key = doc._ops.lamport_key  # direct: doc.ops may be mid-rebuild
-    for ch in stored:
-        amap = [doc.actors.cache(ActorId(a)) for a in ch.actors]
-        author = amap[0]
-        start = ch.start_op
-        for i, cop in enumerate(ch.ops):
-            key = doc.props.cache(cop.key.prop) if cop.key.prop is not None else None
-            if key is None:
-                e = cop.key.elem
-                elem = (0, 0) if e[0] == 0 else (e[0], amap[e[1]])
-            else:
-                elem = None
-            pred = [(p[0], amap[p[1]]) for p in cop.pred]
-            if len(pred) > 1:
-                pred.sort(key=sort_key)
-            op = Op(
-                id=(start + i, author),
-                action=cop.action,
-                value=cop.value,
-                key=key,
-                elem=elem,
-                insert=cop.insert,
-                pred=pred,
-                mark_name=cop.mark_name,
-                expand=cop.expand,
-            )
-            ops[row] = op
-            o = cop.obj
-            objs_of[row] = (0, 0) if o[0] == 0 else (o[0], amap[o[1]])
-            row += 1
+    if flat.get("rb") is not None:
+        _build_ops_from_arrays(doc, flat, ops, objs_of, sort_key)
+    else:
+        _build_ops_from_changes(doc, stored, ops, objs_of, sort_key)
 
     ids = flat["op_id"]
     order = np.argsort(ids, kind="stable")

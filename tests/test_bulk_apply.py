@@ -249,3 +249,20 @@ def test_flatten_fast_matches_slow():
     ):
         assert np.array_equal(np.asarray(fa[k]), np.asarray(sl[k])), k
     assert fa["rank_of"] == sl["rank_of"]
+
+
+def test_array_rebuild_preserves_out_of_i64_uint(monkeypatch):
+    """uint values >= 2^63 wrap in the native int64 decode; the array
+    rebuild must reroute them through the exact python decoder."""
+    monkeypatch.setenv("AUTOMERGE_TPU_DEBUG", "1")
+    big = 2**63 + 5
+    d = AutoDoc(actor=ActorId(bytes([1]) * 16))
+    d.put("_root", "big", ScalarValue("uint", big))
+    t = d.put_object("_root", "t", ObjType.TEXT)
+    d.splice_text(t, 0, 0, "x")
+    d.commit()
+    changes = list(d.get_changes([]))
+    e = AutoDoc(actor=ActorId(bytes([2]) * 16))
+    monkeypatch.setattr(Document, "BULK_MIN_OPS", 1)
+    e.apply_changes(changes)
+    assert e.get("_root", "big")[0] == ("scalar", ScalarValue("uint", big))
