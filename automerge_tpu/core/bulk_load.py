@@ -316,7 +316,26 @@ def rebuild_op_store(doc) -> None:
     comes from the native sequential integrate, or — for large dense-
     concurrency histories — from the batched device merge kernel.
     Replaces the store wholesale; the document's history / change graph /
-    actor caches are untouched."""
+    actor caches are untouched.
+
+    Cyclic GC is paused for the build: it allocates millions of small
+    objects and a generational collection mid-build walks every live one
+    (measured ~2.4x on a 260k-op rebuild). Nothing in here creates
+    garbage cycles — the element list's cycles stay live in the store.
+    """
+    import gc
+
+    gc_was = gc.isenabled()
+    if gc_was:
+        gc.disable()
+    try:
+        _rebuild_op_store(doc)
+    finally:
+        if gc_was:
+            gc.enable()
+
+
+def _rebuild_op_store(doc) -> None:
     import os
 
     from .. import native
