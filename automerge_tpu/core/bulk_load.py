@@ -27,7 +27,7 @@ import numpy as np
 from ..types import Action, ActorId, ObjType, ScalarValue, is_make_action, objtype_for_action
 from .op_store import Element, MapObject, ObjInfo, Op, OpStore, SeqObject
 
-ACTOR_BITS = 20  # shared packing with ops/oplog.py
+from ..types import ACTOR_BITS  # shared packed-id layout
 
 
 def flatten_changes(changes: Sequence) -> Dict[str, object]:
@@ -426,6 +426,32 @@ def _rebuild_op_store(doc) -> None:
         )
         parent_elem = op.id if op.insert else op.elem
         store.objects[op.id] = ObjInfo(data, objs_of[int(r)], op.key, parent_elem)
+
+    # ---- structural validation (vectorized) -------------------------------
+    # A map-keyed op must target a map object and a seq-keyed op a sequence
+    # — the per-op path raises OpStoreError for these; the bulk rebuild must
+    # fail loudly too, never silently drop the op (kind mismatch would
+    # otherwise diverge from replicas applying the same change per-op).
+    obj_arr = flat["obj"]
+    kind_is_map = {0: True}  # packed obj key -> is-map (root is a map)
+    for r in make_rows:
+        t = objtype_for_action(int(flat["action"][r]))
+        kind_is_map[int(flat["op_id"][r])] = t in (ObjType.MAP, ObjType.TABLE)
+    is_map_key = flat["prop"] == 0
+    kkeys = np.fromiter(kind_is_map.keys(), np.int64, len(kind_is_map))
+    kvals = np.fromiter(
+        (1 if v else 0 for v in kind_is_map.values()), np.int8, len(kind_is_map)
+    )
+    korder = np.argsort(kkeys)
+    kkeys, kvals = kkeys[korder], kvals[korder]
+    pos = np.clip(np.searchsorted(kkeys, obj_arr), 0, len(kkeys) - 1)
+    if not np.array_equal(kkeys[pos], obj_arr):
+        raise ValueError("op targets unknown object")
+    obj_map = kvals[pos].astype(bool)
+    if np.any(obj_map & ~is_map_key):
+        raise ValueError("sequence-keyed op on a map object")
+    if np.any(~obj_map & is_map_key):
+        raise ValueError("map-keyed op on a sequence object")
 
     # ---- map runs (ascending lamport per (obj, prop)) ---------------------
     is_map_op = flat["prop"] == 0

@@ -141,7 +141,13 @@ class Document:
         try:
             rebuild_op_store(self)
         except Exception:
-            self._rebuild_slow()
+            try:
+                self._rebuild_slow()
+            except Exception:
+                # a half-built store must never serve reads: keep the view
+                # stale so EVERY read raises, not just the first
+                self._ops_stale = True
+                raise
 
     # -- identity ----------------------------------------------------------
 
@@ -249,6 +255,11 @@ class Document:
         Same causal-queue / dup-seq semantics as the incremental path; the
         op store is marked stale and rebuilt from the full history on the
         next read (core/bulk_load.py), so per-op python apply never runs.
+
+        Structural validation of op payloads is deferred with the rebuild:
+        a malformed change accepted here raises on every subsequent read
+        (fail-loud; the store is never partially served), where the per-op
+        path would have raised at apply time.
         """
         ready: List[StoredChange] = []
         pending: List[StoredChange] = []
@@ -301,14 +312,16 @@ class Document:
 
     def _rebuild_slow(self) -> None:
         """Correctness fallback: replay the whole history through the
-        per-op apply path into a fresh store."""
+        per-op apply path into a fresh store — installed only on success,
+        so a mid-replay failure never leaves a partial store behind."""
         from .op_store import OpStore
 
-        self.ops = OpStore(self.actors)
+        store = OpStore(self.actors)
         for applied in self.history:
             actor_map = applied.actor_map
             for obj_id, op in self._import_ops(applied.stored, actor_map):
-                self.ops.insert_op(obj_id, op)
+                store.insert_op(obj_id, op)
+        self.ops = store
 
     def _drain_queue(self) -> None:
         applied = True
@@ -1215,7 +1228,7 @@ def reconstruct_changes_fast(doc: ParsedDocument, verify: bool = True) -> List[S
         validate_doc_arrays(a, len(doc.actors))
     n = a["n"]
     n_actors = len(doc.actors)
-    B = 20
+    from ..types import ACTOR_BITS as B
     if n_actors >= (1 << B):
         raise ExtractError("too many actors for the packed fast path")
 

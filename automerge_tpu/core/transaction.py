@@ -120,7 +120,7 @@ class Transaction:
 
     # -- native edit sessions ----------------------------------------------
 
-    _ID_RANK_BITS = 20  # packed session ids: ctr << 20 | doc actor index
+    from ..types import ACTOR_BITS as _ID_RANK_BITS  # ctr << bits | doc actor idx
 
     def _session_for(self, obj_id: OpId, info):
         """Existing or newly-eligible native session for ``obj_id``.
@@ -851,13 +851,20 @@ class Transaction:
             for obj_id, op in self.operations
         ]
 
+    # session tails at or below this drain through the per-op path at
+    # commit: the store stays live (no full-history rebuild on next read),
+    # which keeps the commit-per-keystroke pattern O(tail) instead of O(doc)
+    SMALL_TAIL_OPS = 256
+
     def _export_change(self) -> StoredChange:
         live = {
             o: ent for o, ent in self._sessions.items()
             if ent[0].op_count() > ent[1]
         }
-        if len(live) > 1:
-            # multi-session commits interleave objects: python path
+        undrained = sum(ent[0].op_count() - ent[1] for ent in live.values())
+        if live and (len(live) > 1 or undrained <= self.SMALL_TAIL_OPS):
+            # multi-session commits interleave objects; small tails are
+            # cheaper applied incrementally than via a stale-store rebuild
             self._drain_all(drop=True)
             live = {}
         if live:

@@ -376,6 +376,16 @@ def preorder_index(
     return out
 
 
+def _splice_error(code: int):
+    """Session splice failures carry the same typed error and wording as
+    the python transaction path (errors.AutomergeError)."""
+    from ..errors import AutomergeError
+
+    if code == -2:
+        return AutomergeError("splice: delete past end of sequence")
+    return AutomergeError("splice: index out of bounds")
+
+
 def _cp_widths(cps: np.ndarray) -> np.ndarray:
     """Per-codepoint text widths for the configured encoding
     (reference: text_value.rs width-per-encoding)."""
@@ -465,7 +475,7 @@ class EditSession:
             widths = _cp_widths(cps)
             n = self._splice_fn(self._h, ctr0, pos, ndel, _i32(cps), _i32(widths), nt)
         if n < 0:
-            raise ValueError(f"edit session splice out of bounds (code {n})")
+            raise _splice_error(int(n))
         return int(n)
 
     def splice_batch(self, ctr0: int, edits, clamp: bool = True) -> int:
@@ -496,7 +506,7 @@ class EditSession:
             _i32(widths), n, 1 if clamp else 0,
         )
         if r < 0:
-            raise ValueError(f"edit session batch splice failed (code {r})")
+            raise _splice_error(int(r))
         return int(r)
 
     def export(self, start: int = 0):

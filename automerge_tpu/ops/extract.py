@@ -390,7 +390,7 @@ def batch_arrays(changes) -> Dict[str, object]:
     }
 
 
-ACTOR_BITS = 20  # packed id layout: counter << 20 | byte-sorted actor rank
+from ..types import ACTOR_BITS  # packed id layout: ctr << bits | actor rank
 
 
 def ranked_batch(changes, rank_of) -> Dict[str, object]:

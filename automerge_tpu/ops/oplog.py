@@ -27,8 +27,9 @@ import numpy as np
 from ..storage.change import StoredChange
 from ..types import ActorId, ScalarValue, str_width
 
-# Up to 2^20 distinct actors per merged log; counters up to 2^43.
-ACTOR_BITS = 20
+# Up to 2^20 distinct actors per merged log; counters up to 2^43
+# (single authority: types.ACTOR_BITS).
+from ..types import ACTOR_BITS  # noqa: E402
 ACTOR_MASK = (1 << ACTOR_BITS) - 1
 PAD_ACTION = 15
 

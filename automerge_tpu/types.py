@@ -82,6 +82,12 @@ _CONCURRENCY_MAGIC = bytes([0x12, 0x36, 0x34, 0x42])
 OpId = Tuple[int, int]
 
 ROOT: OpId = (0, 0)  # the root object id sentinel
+
+# Packed op-id layout shared by the device log, bulk rebuild, storage fast
+# paths, and the native edit session (session.cpp hard-codes the same 20):
+# id = counter << ACTOR_BITS | actor index/rank. Counters < 2^43.
+ACTOR_BITS = 20
+
 HEAD: OpId = (0, 0)  # list HEAD element sentinel (counter 0 never collides)
 
 

@@ -266,3 +266,36 @@ def test_array_rebuild_preserves_out_of_i64_uint(monkeypatch):
     monkeypatch.setattr(Document, "BULK_MIN_OPS", 1)
     e.apply_changes(changes)
     assert e.get("_root", "big")[0] == ("scalar", ScalarValue("uint", big))
+
+
+def test_malformed_bulk_change_fails_loud_on_every_read(monkeypatch):
+    """A structurally-invalid change (seq key targeting a map object) that
+    enters via the deferred bulk path must raise on EVERY read — never
+    silently drop the op or serve a half-built store."""
+    from automerge_tpu.storage.change import (
+        ChangeOp,
+        Key,
+        ROOT_STORED,
+        StoredChange,
+        build_change,
+    )
+
+    bad = build_change(
+        StoredChange(
+            dependencies=[], actor=bytes([5]) * 16, other_actors=[],
+            seq=1, start_op=1, timestamp=0, message=None,
+            ops=[ChangeOp(
+                obj=ROOT_STORED, key=Key.seq((999, 0)), insert=True,
+                action=1, value=ScalarValue("str", "x"), pred=[],
+            )],
+        )
+    )
+    d = AutoDoc(actor=ActorId(bytes([3]) * 16))
+    monkeypatch.setattr(Document, "BULK_MIN_OPS", 1)
+    try:
+        d.apply_changes([bad])
+    except Exception:
+        return  # rejected at apply: also acceptable
+    for _ in range(2):
+        with pytest.raises(Exception):
+            d.keys()
