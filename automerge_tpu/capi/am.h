@@ -120,6 +120,27 @@ AMresult *am_list_get(AMdoc *doc, const char *obj, size_t index);
 AMresult *am_keys(AMdoc *doc, const char *obj);   /* items: STR */
 AMresult *am_length(AMdoc *doc, const char *obj); /* item: UINT */
 
+/* -- marks / cursors ------------------------------------------------------- */
+/* expand: "none" | "before" | "after" | "both" (reference ExpandMark). */
+AMresult *am_mark_str(AMdoc *doc, const char *obj, size_t start, size_t end,
+                      const char *name, const char *value, const char *expand);
+AMresult *am_mark_bool(AMdoc *doc, const char *obj, size_t start, size_t end,
+                       const char *name, int value, const char *expand);
+AMresult *am_unmark(AMdoc *doc, const char *obj, size_t start, size_t end,
+                    const char *name);
+/* items per mark: UINT start, UINT end, STR name, then the value item */
+AMresult *am_marks(AMdoc *doc, const char *obj);
+AMresult *am_get_cursor(AMdoc *doc, const char *obj, size_t pos); /* item: STR */
+AMresult *am_get_cursor_position(AMdoc *doc, const char *obj,
+                                 const char *cursor); /* item: UINT */
+
+/* -- history exchange ------------------------------------------------------ */
+/* Apply raw change/document chunk bytes (a peer's save_incremental output). */
+AMresult *am_apply_changes(AMdoc *doc, const uint8_t *data, size_t len);
+/* Change chunks not covered by the given 32-byte head hashes (concatenated
+ * AMresult BYTES items from am_get_heads); item: BYTES. */
+AMresult *am_save_incremental(AMdoc *doc, const uint8_t *heads, size_t n_heads);
+
 /* -- sync ------------------------------------------------------------------ */
 AMsyncState *am_sync_state_new(void);
 void am_sync_state_free(AMsyncState *s);

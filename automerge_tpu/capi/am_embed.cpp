@@ -562,6 +562,70 @@ extern "C" void am_sync_state_free(AMsyncState *s) {
   delete s;
 }
 
+/* -- marks / cursors -------------------------------------------------------*/
+
+extern "C" AMresult *am_mark_str(AMdoc *d, const char *o, size_t start, size_t end,
+                                 const char *name, const char *value,
+                                 const char *expand) {
+  if (value == NULL) {
+    /* a NULL value means a null-valued mark: clears the name (Peritext) */
+    AM_ARGS("(Lsnnss)", (long long)d->handle, o, (Py_ssize_t)start,
+            (Py_ssize_t)end, name, expand ? expand : "after");
+    return dispatch("mark_null", args);
+  }
+  AM_ARGS("(Lsnnsss)", (long long)d->handle, o, (Py_ssize_t)start,
+          (Py_ssize_t)end, name, value, expand ? expand : "after");
+  return dispatch("mark_str", args);
+}
+
+extern "C" AMresult *am_mark_bool(AMdoc *d, const char *o, size_t start, size_t end,
+                                  const char *name, int value,
+                                  const char *expand) {
+  AM_ARGS("(Lsnnsis)", (long long)d->handle, o, (Py_ssize_t)start,
+          (Py_ssize_t)end, name, value, expand ? expand : "after");
+  return dispatch("mark_bool", args);
+}
+
+extern "C" AMresult *am_unmark(AMdoc *d, const char *o, size_t start, size_t end,
+                               const char *name) {
+  AM_ARGS("(Lsnns)", (long long)d->handle, o, (Py_ssize_t)start,
+          (Py_ssize_t)end, name);
+  return dispatch("unmark", args);
+}
+
+extern "C" AMresult *am_marks(AMdoc *d, const char *o) {
+  AM_ARGS("(Ls)", (long long)d->handle, o);
+  return dispatch("marks", args);
+}
+
+extern "C" AMresult *am_get_cursor(AMdoc *d, const char *o, size_t pos) {
+  AM_ARGS("(Lsn)", (long long)d->handle, o, (Py_ssize_t)pos);
+  return dispatch("get_cursor", args);
+}
+
+extern "C" AMresult *am_get_cursor_position(AMdoc *d, const char *o,
+                                            const char *cursor) {
+  AM_ARGS("(Lss)", (long long)d->handle, o, cursor);
+  return dispatch("get_cursor_position", args);
+}
+
+/* -- history exchange ------------------------------------------------------*/
+
+extern "C" AMresult *am_apply_changes(AMdoc *d, const uint8_t *data, size_t len) {
+  AM_ARGS("(Ly#)", (long long)d->handle, (const char *)data, (Py_ssize_t)len);
+  return dispatch("apply_change_bytes", args);
+}
+
+extern "C" AMresult *am_save_incremental(AMdoc *d, const uint8_t *heads,
+                                         size_t n_heads) {
+  /* NULL/0 means "everything": full change history */
+  static const uint8_t empty[1] = {0};
+  const uint8_t *p = (heads && n_heads) ? heads : empty;
+  size_t len = heads ? n_heads * 32 : 0;
+  AM_ARGS("(Ly#)", (long long)d->handle, (const char *)p, (Py_ssize_t)len);
+  return dispatch("save_incremental", args);
+}
+
 extern "C" AMresult *am_generate_sync_message(AMdoc *d, AMsyncState *s) {
   AM_ARGS("(LL)", (long long)d->handle, (long long)s->handle);
   return dispatch("generate_sync_message", args);

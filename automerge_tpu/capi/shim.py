@@ -219,6 +219,78 @@ def actor_id(h: int) -> List[Item]:
     return [(BYTES, _doc(h).get_actor().bytes)]
 
 
+_EXPANDS = ("none", "before", "after", "both")
+
+
+def _check_expand(expand: str) -> str:
+    if expand not in _EXPANDS:
+        raise ValueError(f"expand must be one of {_EXPANDS}, got {expand!r}")
+    return expand
+
+
+def mark_str(h: int, obj: str, start: int, end: int, name: str, value: str, expand: str) -> List[Item]:
+    _doc(h).mark(obj, start, end, name, value, expand=_check_expand(expand))
+    return []
+
+
+def mark_null(h: int, obj: str, start: int, end: int, name: str, expand: str) -> List[Item]:
+    # a null-valued mark clears ``name`` over the span (Peritext unmark)
+    _doc(h).mark(obj, start, end, name, None, expand=_check_expand(expand))
+    return []
+
+
+def mark_bool(h: int, obj: str, start: int, end: int, name: str, value: int, expand: str) -> List[Item]:
+    _doc(h).mark(obj, start, end, name, bool(value), expand=_check_expand(expand))
+    return []
+
+
+def unmark(h: int, obj: str, start: int, end: int, name: str) -> List[Item]:
+    _doc(h).unmark(obj, start, end, name)
+    return []
+
+
+def marks(h: int, obj: str) -> List[Item]:
+    out: List[Item] = []
+    for m in _doc(h).marks(obj):
+        out.append((UINT, m.start))
+        out.append((UINT, m.end))
+        out.append((STR, m.name))
+        v = m.value
+        if isinstance(v, bool):
+            out.append((BOOL, 1 if v else 0))
+        elif isinstance(v, int):
+            out.append((INT, v))
+        elif isinstance(v, float):
+            out.append((F64, v))
+        elif isinstance(v, (bytes, bytearray)):
+            out.append((BYTES, bytes(v)))
+        elif v is None:
+            out.append((NULL, 0))
+        else:
+            out.append((STR, str(v)))
+    return out
+
+
+def get_cursor(h: int, obj: str, pos: int) -> List[Item]:
+    return [(STR, _doc(h).get_cursor(obj, pos))]
+
+
+def get_cursor_position(h: int, obj: str, cursor: str) -> List[Item]:
+    return [(UINT, _doc(h).get_cursor_position(obj, cursor))]
+
+
+def apply_change_bytes(h: int, data: bytes) -> List[Item]:
+    _doc(h).load_incremental(data, on_partial="error")
+    return []
+
+
+def save_incremental(h: int, heads_blob: bytes) -> List[Item]:
+    if len(heads_blob) % 32:
+        raise ValueError("heads blob must be a multiple of 32 bytes")
+    heads = [heads_blob[i : i + 32] for i in range(0, len(heads_blob), 32)]
+    return [(BYTES, _doc(h).save_incremental_after(heads))]
+
+
 def sync_state_new() -> List[Item]:
     return [(HANDLE, _register(_syncs, SyncState()))]
 

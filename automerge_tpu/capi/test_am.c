@@ -183,6 +183,93 @@ int main(void) {
   am_result_free(pt);
   am_result_free(mt);
 
+  /* -- marks + cursors (reference: automerge-c marks/cursor surface) -- */
+  AMdoc *md = am_create(NULL, 0);
+  assert(md != NULL);
+  r = ok(am_map_put_object(md, AM_ROOT, "note", AM_OBJ_TEXT));
+  char note_id[64];
+  strncpy(note_id, am_item_str(r, 0), sizeof(note_id) - 1);
+  note_id[sizeof(note_id) - 1] = 0;
+  am_result_free(r);
+  am_result_free(ok(am_splice_text(md, note_id, 0, 0, "mark me up")));
+  am_result_free(ok(am_mark_bool(md, note_id, 0, 4, "bold", 1, "both")));
+  am_result_free(ok(am_mark_str(md, note_id, 5, 7, "link", "https://x", "none")));
+  r = ok(am_marks(md, note_id));
+  assert(am_result_size(r) == 8); /* 2 marks x (start, end, name, value) */
+  assert(am_item_int(r, 0) == 0 && am_item_int(r, 1) == 4);
+  assert(strcmp(am_item_str(r, 2), "bold") == 0);
+  assert(am_item_type(r, 3) == AM_VAL_BOOL && am_item_int(r, 3) == 1);
+  assert(strcmp(am_item_str(r, 6), "link") == 0);
+  assert(strcmp(am_item_str(r, 7), "https://x") == 0);
+  am_result_free(r);
+  am_result_free(ok(am_unmark(md, note_id, 0, 4, "bold")));
+  r = ok(am_marks(md, note_id));
+  assert(am_result_size(r) == 4); /* only the link span remains */
+  am_result_free(r);
+  /* NULL value = null mark (clears the name over the span) */
+  am_result_free(ok(am_mark_str(md, note_id, 5, 7, "link", NULL, "none")));
+  r = ok(am_marks(md, note_id));
+  assert(am_result_size(r) == 0);
+  am_result_free(r);
+  expect_error(am_mark_bool(md, note_id, 0, 2, "b", 1, "sideways"),
+               "invalid expand policy");
+  r = ok(am_get_cursor(md, note_id, 5));
+  char cursor[64];
+  strncpy(cursor, am_item_str(r, 0), sizeof(cursor) - 1);
+  cursor[sizeof(cursor) - 1] = 0;
+  am_result_free(r);
+  am_result_free(ok(am_splice_text(md, note_id, 0, 0, ">> ")));
+  r = ok(am_get_cursor_position(md, note_id, cursor));
+  assert(am_item_int(r, 0) == 8); /* cursor tracked the insertion */
+  am_result_free(r);
+
+  /* -- incremental history exchange -- */
+  am_result_free(ok(am_commit(md, NULL)));
+  AMresult *heads0 = ok(am_get_heads(md));
+  size_t nh = am_result_size(heads0);
+  uint8_t heads_blob[8 * 32];
+  assert(nh <= 8);
+  for (size_t i = 0; i < nh; i++) {
+    size_t hl;
+    const uint8_t *hb = am_item_bytes(heads0, i, &hl);
+    assert(hl == 32);
+    memcpy(heads_blob + i * 32, hb, 32);
+  }
+  am_result_free(heads0);
+  AMdoc *mirror = am_create(NULL, 0);
+  r = ok(am_save(md));
+  {
+    size_t sl;
+    const uint8_t *sb = am_item_bytes(r, 0, &sl);
+    am_result_free(ok(am_apply_changes(mirror, sb, sl)));
+  }
+  am_result_free(r);
+  am_result_free(ok(am_splice_text(md, note_id, 0, 0, "A")));
+  am_result_free(ok(am_commit(md, NULL)));
+  /* NULL heads = the full history */
+  r = ok(am_save_incremental(md, NULL, 0));
+  {
+    size_t fl;
+    am_item_bytes(r, 0, &fl);
+    assert(fl > 0);
+  }
+  am_result_free(r);
+  r = ok(am_save_incremental(md, heads_blob, nh));
+  {
+    size_t il;
+    const uint8_t *ib = am_item_bytes(r, 0, &il);
+    assert(il > 0);
+    am_result_free(ok(am_apply_changes(mirror, ib, il)));
+  }
+  am_result_free(r);
+  AMresult *mdt = ok(am_text(md, note_id));
+  AMresult *mrt = ok(am_text(mirror, note_id));
+  assert(strcmp(am_item_str(mdt, 0), am_item_str(mrt, 0)) == 0);
+  am_result_free(mdt);
+  am_result_free(mrt);
+  am_doc_free(mirror);
+  am_doc_free(md);
+
   /* -- error paths -- */
   expect_error(am_map_get(doc1, "7@deadbeef", "x"), "get on unknown object");
   expect_error(am_map_increment(doc1, AM_ROOT, "title", 1),
