@@ -268,9 +268,9 @@ class AutoDoc:
         self._sync_reads()
         return self.doc.values(obj, clock=self._read_clock(heads))
 
-    def parents(self, obj: str):
+    def parents(self, obj: str, heads=None):
         self._sync_reads()
-        return self.doc.parents(obj)
+        return self.doc.parents(obj, clock=self._read_clock(heads))
 
     # -- history -----------------------------------------------------------
 

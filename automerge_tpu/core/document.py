@@ -641,9 +641,12 @@ class Document:
             ]
         return self.list_items(obj, heads=heads, clock=clock)
 
-    def parents(self, obj: str) -> List[Tuple[str, object]]:
-        """Path from ``obj`` up to the root: [(parent id, key-or-index), ...]."""
+    def parents(self, obj: str, heads=None, clock=None) -> List[Tuple[str, object]]:
+        """Path from ``obj`` up to the root: [(parent id, key-or-index), ...]
+        (reference: read.rs parents/parents_at — sequence indices resolve
+        at the given heads)."""
         obj_id = self.import_obj(obj)
+        clock = self._resolve_clock(heads, clock)
         path = []
         while obj_id != ROOT_OBJ:
             info = self.ops.get_obj(obj_id)
@@ -651,14 +654,22 @@ class Document:
             if info.parent_key is not None:
                 path.append((self.export_id(parent), self.props.get(info.parent_key)))
             else:
-                # resolve the element's current index in the parent sequence
-                idx = self._elem_index(parent, info.parent_elem)
+                # resolve the element's index in the parent sequence at the
+                # read clock (None when invisible there)
+                idx = self._elem_index(parent, info.parent_elem, clock)
                 path.append((self.export_id(parent), idx))
             obj_id = parent
         return path
 
-    def _elem_index(self, parent: OpId, elem: OpId) -> Optional[int]:
-        for i, (el, _) in enumerate(self.ops.visible_elements(parent)):
+    def _elem_index(self, parent: OpId, elem: OpId, clock=None) -> Optional[int]:
+        if clock is None:
+            # current state: O(sqrt n) via the block order-statistics index
+            info = self.ops.get_obj(parent)
+            el = info.data.by_id.get(elem)
+            if el is None or el.winner() is None:
+                return None
+            return self.ops.position_of(parent, el)
+        for i, (el, _) in enumerate(self.ops.visible_elements(parent, clock)):
             if el.elem_id == elem:
                 return i
         return None

@@ -379,12 +379,14 @@ class DeviceDoc:
             return [(val, vid) for _, val, vid in view.map_entries(obj)]
         return view.list_items(obj)
 
-    def parents(self, obj: str) -> List[Tuple[str, object]]:
-        """Path from ``obj`` up to the root (read.rs parents): walks the
-        make ops' containing objects through the log columns."""
-        log = self.log
+    def parents(self, obj: str, heads=None) -> List[Tuple[str, object]]:
+        """Path from ``obj`` up to the root (read.rs parents/parents_at):
+        walks the make ops' containing objects through the log columns,
+        resolving sequence indices at the given heads."""
+        view = self._view(heads)
+        log = view.log
         key = log.import_id(obj)
-        self._check_obj(key)
+        view._check_obj(key)
         path: List[Tuple[str, object]] = []
         while key != 0:
             row = log.row_of_id(key)
@@ -396,13 +398,13 @@ class DeviceDoc:
             else:
                 # element ordinal among VISIBLE elements (1 each, matching
                 # Document._elem_index); None when the element is invisible
-                base = self._base
+                base = view._base
                 er = row if log.insert[row] else int(log.elem_ref[row])
-                self._check_obj(parent_key)
+                view._check_obj(parent_key)
                 idx = 0
                 found = None
                 for r in base._all_elems(parent_key):
-                    visible = int(self.winner[r]) >= 0
+                    visible = int(view.winner[r]) >= 0
                     if r == er:
                         found = idx if visible else None
                         break

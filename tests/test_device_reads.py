@@ -240,3 +240,30 @@ def test_range_readers_and_parents_parity():
     doc.insert(lst, 0, 99)
     doc.commit()
     assert doc.list_range(lst, 0, 2, heads=heads0) == dev.list_range(lst, 0, 2)[:2]
+
+
+def test_parents_at_historical_heads():
+    """parents resolves sequence indices at the given heads
+    (reference: read.rs parents_at)."""
+    doc = AutoDoc(actor=ActorId(bytes([6]) * 16))
+    lst = doc.put_object("_root", "lst", ObjType.LIST)
+    for i, v in enumerate([1, 2, 3]):
+        doc.insert(lst, i, v)
+    inner = doc.insert_object(lst, 2, ObjType.MAP)
+    doc.put(inner, "x", 1)
+    doc.commit()
+    heads0 = doc.get_heads()
+    assert doc.parents(inner) == [(lst, 2), ("_root", "lst")]
+    doc.insert(lst, 0, 99)  # shifts the element right
+    doc.insert(lst, 0, 98)
+    doc.commit()
+    assert doc.parents(inner) == [(lst, 4), ("_root", "lst")]
+    assert doc.parents(inner, heads=heads0) == [(lst, 2), ("_root", "lst")]
+    # element deleted at current heads: index resolves at the old heads only
+    doc.delete(lst, 4)
+    doc.commit()
+    assert doc.parents(inner)[0][1] is None
+    assert doc.parents(inner, heads=heads0) == [(lst, 2), ("_root", "lst")]
+    dev = DeviceDoc.merge([doc])
+    assert dev.parents(inner) == doc.parents(inner)
+    assert dev.parents(inner, heads=heads0) == doc.parents(inner, heads=heads0)
