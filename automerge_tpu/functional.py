@@ -28,9 +28,14 @@ __all__ = [
     "Counter",
     "Doc",
     "Text",
+    "apply_changes",
     "change",
     "change_at",
     "clone",
+    "diff",
+    "get_changes",
+    "get_last_local_change",
+    "marks",
     "fork",
     "from_dict",
     "get_actor",
@@ -152,6 +157,40 @@ def merge(doc: Doc, other: Doc) -> Doc:
     merged = doc._auto.fork(actor=doc._auto.get_actor())
     merged.merge(other._auto)
     return Doc(merged)
+
+
+def get_changes(doc: Doc, have_deps: List[bytes] = ()) -> List[bytes]:
+    """Raw change chunks not covered by ``have_deps`` (the JS wrapper's
+    getChanges, stable.ts getChanges)."""
+    return [c.raw_bytes for c in doc._auto.get_changes(list(have_deps))]
+
+
+def get_last_local_change(doc: Doc) -> Optional[bytes]:
+    c = doc._auto.get_last_local_change()
+    return c.raw_bytes if c is not None else None
+
+
+def apply_changes(doc: Doc, changes) -> Doc:
+    """A new value with the raw change chunks applied (stable.ts
+    applyChanges)."""
+    out = doc._auto.fork(actor=doc._auto.get_actor())
+    out.load_incremental(b"".join(changes), on_partial="error")
+    return Doc(out)
+
+
+def diff(doc: Doc, before: List[bytes], after: List[bytes]):
+    """Patches transforming the view at ``before`` into the view at
+    ``after`` (stable.ts diff)."""
+    return doc._auto.diff(list(before), list(after))
+
+
+def marks(doc: Doc, key: str):
+    """Mark spans of a text field: ``doc[key].marks()`` (next.ts marks).
+    Nested texts are reached through the proxies: ``doc["a"]["b"].marks()``."""
+    v = doc[key]
+    if not isinstance(v, TextProxy):
+        raise ValueError(f"{key!r} is not a text field")
+    return v.marks()
 
 
 def _take(doc: Doc) -> AutoDoc:
@@ -402,6 +441,9 @@ class TextProxy:
 
     def unmark(self, start: int, end: int, name: str, expand="none"):
         self._auto.unmark(self._obj, start, end, name, expand)
+
+    def marks(self):
+        return self._auto.marks(self._obj)
 
     def to_py(self) -> str:
         return str(self)

@@ -135,3 +135,36 @@ def test_functional_docs_feed_device_merge():
     for other in docs[1:]:
         host = am.merge(host, other)
     assert dev.hydrate() == host.to_py()
+
+
+def test_history_level_functions():
+    """getChanges/applyChanges/diff/getLastLocalChange analogues
+    (reference: javascript/src/stable.ts:194-1183)."""
+    import automerge_tpu.functional as am
+
+    d1 = am.from_dict({"notes": am.Text("hi"), "n": 1})
+    h0 = am.get_heads(d1)
+    d2 = am.change(d1, lambda d: d["notes"].append(" there"))
+    d2 = am.change(d2, lambda d: d["notes"].mark(0, 2, "bold", True))
+
+    raw = am.get_changes(d2, h0)
+    assert raw and all(isinstance(c, bytes) for c in raw)
+    last = am.get_last_local_change(d2)
+    assert last == raw[-1]
+
+    # a peer at h0 catches up by applying the raw chunks
+    d1b = am.clone(d1, actor=b"\x07" * 16)
+    d3 = am.apply_changes(d1b, raw)
+    assert str(d3["notes"]) == "hi there"
+    assert [m.name for m in am.marks(d3, "notes")] == ["bold"]
+
+    patches = am.diff(d2, h0, am.get_heads(d2))
+    assert patches
+
+
+def test_marks_on_nested_text():
+    import automerge_tpu.functional as am
+
+    d = am.from_dict({"a": {"b": am.Text("nested")}})
+    d = am.change(d, lambda r: r["a"]["b"].mark(0, 3, "em", True))
+    assert [m.name for m in d["a"]["b"].marks()] == ["em"]
