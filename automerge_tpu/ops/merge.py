@@ -82,7 +82,7 @@ def succ_resolution(c):
     return acc[:, 0], acc[:, 1], acc[:, 2]
 
 
-def resolve_state(c, succ_count, inc_count, counter_inc):
+def resolve_state(c, succ_count, inc_count, counter_inc, obj_cap=None):
     """Phases 2-4: visibility, per-key winners, RGA linearization.
 
     Returns a dict of device arrays (all int32/bool, per-row unless noted):
@@ -128,18 +128,34 @@ def resolve_state(c, succ_count, inc_count, counter_inc):
     g_obj = jnp.where(valid, obj_dense, jnp.int32(P))
     g_kind = is_map.astype(jnp.int32)
     g_key = jnp.where(is_map, c["prop"], run_key)
-    # one multi-key sort pass (lexsort would run one full sort per key)
-    g_obj_s, g_kind_s, g_key_s, sort_idx = jax.lax.sort(
-        (g_obj, g_kind, g_key, rows), num_keys=3, is_stable=True
-    )
-    newseg = jnp.concatenate(
-        [
-            jnp.array([True]),
-            (g_obj_s[1:] != g_obj_s[:-1])
-            | (g_kind_s[1:] != g_kind_s[:-1])
-            | (g_key_s[1:] != g_key_s[:-1]),
-        ]
-    )
+    # the three group keys pack into ONE int32 when the object table is
+    # small (obj_cap is static on the packed-transport path): a single-key
+    # sort moves half the data of the 3-key + payload variant
+    key_bits = _ceil_log2(P + 5)
+    if obj_cap is not None and ((2 * (obj_cap + 2)) << key_bits) < (1 << 31):
+        # invalid rows take the sentinel obj_cap+1 (> every valid obj_dense)
+        g_obj_p = jnp.where(valid, obj_dense, jnp.int32(min(P, obj_cap + 1)))
+        packed = (
+            ((g_obj_p * 2 + g_kind) << key_bits)
+            | (g_key + 4)  # run_key sentinels reach -3; offset keeps it positive
+        )
+        packed_s, sort_idx = jax.lax.sort((packed, rows), num_keys=1, is_stable=True)
+        newseg = jnp.concatenate(
+            [jnp.array([True]), packed_s[1:] != packed_s[:-1]]
+        )
+    else:
+        # one multi-key sort pass (lexsort would run one full sort per key)
+        g_obj_s, g_kind_s, g_key_s, sort_idx = jax.lax.sort(
+            (g_obj, g_kind, g_key, rows), num_keys=3, is_stable=True
+        )
+        newseg = jnp.concatenate(
+            [
+                jnp.array([True]),
+                (g_obj_s[1:] != g_obj_s[:-1])
+                | (g_kind_s[1:] != g_kind_s[:-1])
+                | (g_key_s[1:] != g_key_s[:-1]),
+            ]
+        )
     seg = (jnp.cumsum(newseg) - 1).astype(jnp.int32)
     vis_s = visible[sort_idx]
     cand = jnp.where(vis_s, jnp.arange(P, dtype=jnp.int32), NONE32)
@@ -506,7 +522,7 @@ def _runs_fn(fetch, obj_cap, static_key, P, Q):
     @jax.jit
     def f(arrays):
         c = _unpack_transport(static_key, arrays, P, Q)
-        core = resolve_state(c, *succ_resolution(c))
+        core = resolve_state(c, *succ_resolution(c), obj_cap=obj_cap)
         if "elem_index" in fetch:
             core["elem_index"] = device_linearize(c, core)
         return _emit(core, fetch, obj_cap)
