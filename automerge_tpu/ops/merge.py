@@ -43,7 +43,9 @@ _INCREMENT = 5
 _MARK = 7
 _PUT = 1
 
-NONE32 = jnp.int32(-1)
+# plain int (weakly-typed in jax): a module-level jnp scalar would compile
+# a kernel on the default backend at IMPORT time (~0.6s over the tunnel)
+NONE32 = -1
 
 
 def _ceil_log2(n: int) -> int:
@@ -573,12 +575,15 @@ def _packed_merge(cols_np, fetch, n_objs):
     # element order never needs the device (host_linearize): computing it
     # host-side while the kernel runs removes the two pointer-doubling
     # gather loops (the kernel's dominant cost) AND 4 B/op of readback
-    host_elem = "elem_index" in fetch and native.preorder_available()
+    # keep elem_index on device when it is the ONLY fetch (an explicitly
+    # forced packed transport should exercise the device); otherwise rank
+    # it host-side overlapped with the kernel
+    host_elem = (
+        "elem_index" in fetch and len(fetch) > 1 and native.preorder_available()
+    )
     dev_fetch = (
         tuple(k for k in fetch if k != "elem_index") if host_elem else fetch
     )
-    if not dev_fetch:  # pure-linearization call: no device work at all
-        return {"elem_index": host_linearize(cols_np)}
 
     static_key, arrays = encode_transport(cols_np)
     key = (dev_fetch, obj_cap, static_key, P, Q)
@@ -627,6 +632,18 @@ def merge_columns(cols_np, linearize: str = "auto", fetch=None, n_objs=None):
     import os
 
     from .. import native
+
+    # pure-linearization calls never need a device at all (element order is
+    # a host computation); shortcut before anything touches the jax backend.
+    # An explicit linearize="device" pin (the pure-device/dry-run flow)
+    # still runs on chip.
+    if (
+        fetch is not None
+        and set(fetch) == {"elem_index"}
+        and linearize in ("auto", "native")
+        and native.preorder_available()
+    ):
+        return {"elem_index": host_linearize(cols_np)}
 
     transport = os.environ.get("AUTOMERGE_TPU_TRANSPORT")
     if transport is None:
