@@ -113,6 +113,24 @@ class Document:
 
         self.open_transactions = weakref.WeakSet()
 
+    def _live_transaction(self):
+        """The live (un-done) manual transaction, if any."""
+        for live in self.open_transactions:
+            if not getattr(live, "_done", True):
+                return live
+        return None
+
+    def _check_no_pending_tx(self, what: str) -> None:
+        """Exports built from history (save / incremental save / change
+        export) silently miss a live transaction's eagerly-applied ops —
+        refuse rather than emit bytes that diverge from local reads."""
+        live = self._live_transaction()
+        if live is not None and live.pending_ops():
+            raise AutomergeError(
+                f"cannot {what} while a transaction with pending ops is "
+                "open; commit or roll it back first"
+            )
+
     # -- op store (lazily materialized) ------------------------------------
     #
     # The change history is the document's source of truth; the op store is
@@ -484,6 +502,7 @@ class Document:
 
     def get_changes(self, have_deps: List[bytes]) -> List[StoredChange]:
         """Changes not reachable from ``have_deps``, in causal order."""
+        self._check_no_pending_tx("export changes")
         known = self.change_graph.ancestor_hashes(have_deps)
         return [c.stored for c in self.history if c.hash not in known]
 
@@ -499,16 +518,19 @@ class Document:
         ]
 
     def merge(self, other: "Document") -> List[bytes]:
+        other._check_no_pending_tx("merge from")  # exports other's history
         changes = self.get_changes_added(other)
         self.apply_changes(changes)
         return self.get_heads()
 
     def fork(self, actor: Optional[ActorId] = None) -> "Document":
+        self._check_no_pending_tx("fork")
         doc = Document(actor or ActorId())
         doc.apply_changes(c.stored for c in self.history)
         return doc
 
     def fork_at(self, heads: List[bytes], actor: Optional[ActorId] = None) -> "Document":
+        self._check_no_pending_tx("fork_at")
         keep = self.change_graph.ancestor_hashes(heads)
         missing = [h for h in heads if h not in self.history_index]
         if missing:
@@ -864,6 +886,7 @@ class Document:
         unless ``retain_orphans=False``."""
         from .. import trace
 
+        self._check_no_pending_tx("save")
         with trace.span("save"):
             data = self._save_document(deflate)
         if retain_orphans:
@@ -1113,6 +1136,7 @@ class Document:
 
     def save_incremental_after(self, heads: List[bytes]) -> bytes:
         """Concatenated change chunks for everything not covered by ``heads``."""
+        self._check_no_pending_tx("save_incremental_after")
         out = bytearray()
         for c in self.get_changes(heads):
             out += c.raw_bytes

@@ -50,6 +50,16 @@ class Transaction:
         scope: Optional[Clock] = None,
         actor: Optional[ActorId] = None,
     ):
+        # the reference prevents two live transactions statically via the
+        # &mut borrow on Automerge (manual_transaction.rs); here the check
+        # is dynamic: a second concurrent transaction would mint colliding
+        # op ids from the same doc.max_op and produce a document that
+        # fails to reload ("incorrect max_op in document change").
+        if doc._live_transaction() is not None:
+            raise AutomergeError(
+                "a transaction is already open on this document; "
+                "commit or roll it back first"
+            )
         self.doc = doc
         self.message = message
         self.timestamp = timestamp
@@ -85,8 +95,17 @@ class Transaction:
                 if self.doc.max_op == self.start_op - 1:
                     self.rollback()
                 else:
+                    # can't surgically remove our ops from under later
+                    # commits — mark the materialized view stale instead so
+                    # the next read rebuilds the store from history, which
+                    # erases the uncommitted ops (history is the source of
+                    # truth; see Document._materialize_ops).
                     self._done = True
                     self.doc.open_transactions.discard(self)
+                    for ent in self._sessions.values():
+                        ent[0].close()
+                    self._sessions.clear()
+                    self.doc._ops_stale = True
             except Exception:
                 pass
 

@@ -151,11 +151,17 @@ def get_actor(doc: Doc) -> bytes:
 
 
 def merge(doc: Doc, other: Doc) -> Doc:
-    """A new value containing both histories; inputs stay readable (merge
-    creates no new changes, so the shared actor cannot mint colliding
-    seqs)."""
-    merged = doc._auto.fork(actor=doc._auto.get_actor())
-    merged.merge(other._auto)
+    """A new value containing both histories; the local input is consumed
+    (reference: stable.ts:750-763 progressDocument). Merge itself creates
+    no changes, but the new value continues the same actor/seq line — a
+    later change() on both the pre- and post-merge values would mint two
+    different changes with one (actor, seq), splitting the history."""
+    merged = _take(doc)
+    try:
+        merged.merge(other._auto)
+    except BaseException:
+        _untake(doc)
+        raise
     return Doc(merged)
 
 
@@ -171,10 +177,14 @@ def get_last_local_change(doc: Doc) -> Optional[bytes]:
 
 
 def apply_changes(doc: Doc, changes) -> Doc:
-    """A new value with the raw change chunks applied (stable.ts
-    applyChanges)."""
-    out = doc._auto.fork(actor=doc._auto.get_actor())
-    out.load_incremental(b"".join(changes), on_partial="error")
+    """A new value with the raw change chunks applied; the input is
+    consumed like merge() (stable.ts applyChanges via progressDocument)."""
+    out = _take(doc)
+    try:
+        out.load_incremental(b"".join(changes), on_partial="error")
+    except BaseException:
+        _untake(doc)
+        raise
     return Doc(out)
 
 
@@ -202,8 +212,17 @@ def _take(doc: Doc) -> AutoDoc:
         raise RuntimeError(
             "attempting to change an outdated document; clone() it first"
         )
+    # mark consumed BEFORE the operation runs so a reentrant take (e.g. a
+    # change() callback calling change() on the same value, or a concurrent
+    # thread) can't mint two changes with one (actor, seq); _untake() rolls
+    # the flag back if the operation fails — the fork never touches
+    # doc._auto, so no (actor, seq) was consumed and the value stays usable.
     object.__setattr__(doc, "_superseded", True)
     return doc._auto.fork(actor=doc._auto.get_actor())
+
+
+def _untake(doc: Doc) -> None:
+    object.__setattr__(doc, "_superseded", False)
 
 
 def change(doc: Doc, fn_or_message, fn: Callable = None) -> Doc:
@@ -214,8 +233,12 @@ def change(doc: Doc, fn_or_message, fn: Callable = None) -> Doc:
     else:
         message = fn_or_message
     auto = _take(doc)
-    fn(MapProxy(auto, "_root"))
-    auto.commit(message=message)
+    try:
+        fn(MapProxy(auto, "_root"))
+        auto.commit(message=message)
+    except BaseException:
+        _untake(doc)
+        raise
     return Doc(auto)
 
 
@@ -223,10 +246,14 @@ def change_at(doc: Doc, heads: List[bytes], fn: Callable) -> Doc:
     """Change the document as of ``heads`` — the edit lands concurrent with
     everything since (reference: stable.ts changeAt / isolation)."""
     auto = _take(doc)
-    auto.isolate(list(heads))
-    fn(MapProxy(auto, "_root"))
-    auto.integrate()
-    auto.commit()
+    try:
+        auto.isolate(list(heads))
+        fn(MapProxy(auto, "_root"))
+        auto.integrate()
+        auto.commit()
+    except BaseException:
+        _untake(doc)
+        raise
     return Doc(auto)
 
 

@@ -1,0 +1,222 @@
+"""Transaction/document safety guards (round-3 advisor findings).
+
+The reference enforces all of these statically through Rust's &mut borrow
+on Automerge (rust/automerge/src/transaction/manual_transaction.rs); a
+dynamic-language frontend has to enforce them at runtime.
+"""
+
+import gc
+
+import pytest
+
+from automerge_tpu import functional as F
+from automerge_tpu.api import AutoDoc
+from automerge_tpu.core.document import AutomergeError, Document
+from automerge_tpu.core.transaction import Transaction
+
+
+def test_second_concurrent_manual_transaction_raises():
+    doc = Document()
+    tx = Transaction(doc)
+    tx.put("_root", "a", 1)
+    with pytest.raises(AutomergeError):
+        Transaction(doc)
+    tx.commit()
+    # after commit a new transaction opens fine
+    tx2 = Transaction(doc)
+    tx2.put("_root", "b", 2)
+    tx2.commit()
+    data = doc.save()
+    loaded = Document.load(data)
+    assert loaded.get_heads() == doc.get_heads()
+
+
+def test_second_transaction_allowed_after_rollback():
+    doc = Document()
+    tx = Transaction(doc)
+    tx.put("_root", "a", 1)
+    tx.rollback()
+    tx2 = Transaction(doc)
+    tx2.put("_root", "b", 2)
+    tx2.commit()
+    assert doc.hydrate() == {"b": 2}
+
+
+def test_autodoc_transaction_guard_still_works():
+    d = AutoDoc()
+    tx = d.transaction()
+    tx.put("_root", "k", "v")
+    with pytest.raises(AutomergeError):
+        d.put("_root", "other", 1)
+    tx.commit()
+    d.put("_root", "other", 1)
+    d.commit()
+    assert d.hydrate() == {"k": "v", "other": 1}
+
+
+def test_save_with_pending_transaction_ops_raises():
+    doc = Document()
+    tx = Transaction(doc)
+    tx.put("_root", "a", 1)
+    with pytest.raises(AutomergeError):
+        doc.save()
+    tx.commit()
+    data = doc.save()
+    assert Document.load(data).hydrate() == {"a": 1}
+
+
+def test_save_with_open_empty_transaction_ok():
+    doc = Document()
+    tx = Transaction(doc)
+    tx.put("_root", "a", 1)
+    tx.commit()
+    tx2 = Transaction(doc)  # open but no pending ops
+    data = doc.save()
+    assert Document.load(data).hydrate() == {"a": 1}
+    tx2.rollback()
+
+
+def test_abandoned_transaction_after_later_commit_is_erased():
+    # an abandoned (never committed) transaction whose rollback window was
+    # closed by a later commit must not leave its ops readable: the op
+    # store is rebuilt from history on the next read.
+    doc = Document()
+    tx = Transaction(doc)
+    tx.put("_root", "ghost", 1)
+    # simulate the "doc advanced underneath" branch of __del__: another
+    # actor's change lands before the abandoned tx is collected
+    other = Document()
+    otx = Transaction(other)
+    otx.put("_root", "real", 2)
+    otx.commit()
+    # drop the live tx reference, forcing __del__'s non-rollback branch
+    doc.max_op += 1  # make max_op differ from tx.start_op - 1
+    del tx
+    gc.collect()
+    doc.max_op -= 1
+    doc.merge(other)
+    state = doc.hydrate()
+    assert "ghost" not in state
+    assert state == {"real": 2}
+    # and save/load agrees with reads
+    reloaded = Document.load(doc.save())
+    assert reloaded.hydrate() == state
+
+
+def test_functional_merge_supersedes_input():
+    a = F.init(b"aaaa")
+    b = F.init(b"bbbb")
+    a = F.change(a, lambda d: d.__setitem__("x", 1))
+    b = F.change(b, lambda d: d.__setitem__("y", 2))
+    merged = F.merge(a, b)
+    assert dict(merged) == {"x": 1, "y": 2}
+    # the pre-merge value is consumed: changing it again would mint a
+    # duplicate (actor, seq)
+    with pytest.raises(RuntimeError):
+        F.change(a, lambda d: d.__setitem__("z", 3))
+    # the merged value still works
+    merged2 = F.change(merged, lambda d: d.__setitem__("z", 3))
+    assert dict(merged2)["z"] == 3
+
+
+def test_functional_apply_changes_supersedes_input():
+    a = F.init(b"aaaa")
+    b = F.init(b"bbbb")
+    b2 = F.change(b, lambda d: d.__setitem__("y", 2))
+    chs = F.get_changes(b2)
+    a2 = F.apply_changes(a, chs)
+    assert dict(a2) == {"y": 2}
+    with pytest.raises(RuntimeError):
+        F.change(a, lambda d: d.__setitem__("z", 3))
+
+
+def test_functional_failed_apply_does_not_brick_doc():
+    # a malformed chunk must not consume the input value: no (actor, seq)
+    # was spent, so the doc stays usable (superseding happens only after
+    # the operation succeeds).
+    d = F.change(F.init(b"aaaa"), lambda x: x.__setitem__("x", 1))
+    with pytest.raises(Exception):
+        F.apply_changes(d, [b"not a change chunk"])
+    d2 = F.change(d, lambda x: x.__setitem__("y", 2))
+    assert dict(d2) == {"x": 1, "y": 2}
+
+
+def test_functional_failed_change_fn_does_not_brick_doc():
+    d = F.change(F.init(b"aaaa"), lambda x: x.__setitem__("x", 1))
+    with pytest.raises(ValueError):
+        F.change(d, lambda x: (_ for _ in ()).throw(ValueError("boom")))
+    d2 = F.change(d, lambda x: x.__setitem__("y", 2))
+    assert dict(d2) == {"x": 1, "y": 2}
+
+
+def test_save_incremental_after_with_pending_tx_raises():
+    doc = Document()
+    tx = Transaction(doc)
+    tx.put("_root", "a", 1)
+    tx.commit()
+    heads = doc.get_heads()
+    tx2 = Transaction(doc)
+    tx2.put("_root", "b", 2)
+    with pytest.raises(AutomergeError):
+        doc.save_incremental_after(heads)
+    tx2.commit()
+    blob = doc.save_incremental_after(heads)
+    assert blob  # the committed change is exported
+
+
+def test_functional_reentrant_change_raises():
+    # a change() callback taking the same value again must not mint a
+    # second change with the same (actor, seq)
+    d = F.change(F.init(b"aaaa"), lambda x: x.__setitem__("x", 1))
+    captured = {}
+
+    def reenter(x):
+        x["y"] = 2
+        captured["inner"] = None
+        F.change(d, lambda z: z.__setitem__("evil", True))
+
+    with pytest.raises(RuntimeError):
+        F.change(d, reenter)
+    assert "inner" in captured  # we got as far as the reentrant call
+    # the failed outer change released the value
+    d2 = F.change(d, lambda x: x.__setitem__("ok", True))
+    assert dict(d2) == {"x": 1, "ok": True}
+
+
+def test_merge_from_doc_with_pending_tx_raises():
+    src = Document()
+    tx = Transaction(src)
+    tx.put("_root", "a", 1)
+    dst = Document()
+    with pytest.raises(AutomergeError):
+        dst.merge(src)
+    tx.commit()
+    dst.merge(src)
+    assert dst.hydrate() == {"a": 1}
+
+
+def test_fork_with_pending_tx_raises():
+    doc = Document()
+    tx = Transaction(doc)
+    tx.put("_root", "a", 1)
+    with pytest.raises(AutomergeError):
+        doc.fork()
+    tx.commit()
+    assert doc.fork().hydrate() == {"a": 1}
+
+
+def test_functional_merge_no_split_brain():
+    # the advisor's probe scenario: change() on pre- and post-merge values
+    # must not both succeed (one history line per actor).
+    a = F.init(b"aaaa")
+    b = F.init(b"bbbb")
+    a = F.change(a, lambda d: d.__setitem__("x", 1))
+    b = F.change(b, lambda d: d.__setitem__("y", 2))
+    merged = F.merge(a, b)
+    with pytest.raises(RuntimeError):
+        F.change(a, lambda d: d.__setitem__("from_old", True))
+    after = F.change(merged, lambda d: d.__setitem__("from_new", True))
+    # both branches exchange cleanly with a third peer
+    c = F.init(b"cccc")
+    c = F.apply_changes(c, F.get_changes(after))
+    assert dict(c) == dict(after)
