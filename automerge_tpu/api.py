@@ -30,6 +30,9 @@ class AutoDoc:
         self._tx: Optional[Transaction] = None
         self._manual: Optional[Transaction] = None
         self._isolation: Optional[List[bytes]] = None
+        # (obj, tx, closure) memo for the per-edit splice hot path; valid
+        # only while the same autocommit transaction is live
+        self._splice_cache = None
         self._diff_cursor: List[bytes] = []
         # persistent observer log (reference: autocommit.rs owns a PatchLog);
         # inactive until an observer is attached so the hot path pays nothing
@@ -101,6 +104,7 @@ class AutoDoc:
     def commit(self, message: Optional[str] = None, timestamp: Optional[int] = None) -> Optional[bytes]:
         tx = self._tx
         self._tx = None
+        self._splice_cache = None  # the closure retains the whole tx
         if tx is None:
             return None
         if message is not None:
@@ -119,6 +123,7 @@ class AutoDoc:
     def rollback(self) -> int:
         tx = self._tx
         self._tx = None
+        self._splice_cache = None
         return tx.rollback() if tx is not None else 0
 
     def pending_ops(self) -> int:
@@ -175,7 +180,15 @@ class AutoDoc:
         self._ensure_tx().increment(obj, prop, by)
 
     def splice_text(self, obj: str, pos: int, delete: int, text: str) -> None:
-        self._ensure_tx().splice_text(obj, pos, delete, text)
+        c = self._splice_cache
+        if c is not None and c[0] == obj and c[1] is self._tx:
+            if c[2](pos, delete, text):
+                return
+            self._splice_cache = None  # session gone; rebuild below
+        tx = self._ensure_tx()
+        tx.splice_text(obj, pos, delete, text)
+        fn = tx.fast_splice_fn(obj)
+        self._splice_cache = (obj, tx, fn) if fn is not None else None
 
     def splice_text_many(self, obj: str, edits, clamp: bool = True) -> int:
         """Bulk text ingest: (pos, delete, text) edits in one native pass."""

@@ -61,14 +61,27 @@ def load_trace(limit: Optional[int] = None) -> list:
 
 
 def apply_edits(doc: AutoDoc, text_obj: str, edits: Iterable) -> int:
-    """Replay trace edits; returns the number of ops issued."""
+    """Replay trace edits; returns the number of ops issued.
+
+    Mirrors the reference replay loop (rust/edit-trace/src/main.rs:23-31):
+    one splice_text call per edit, no per-edit length query — the length
+    used for clamping synthetic traces is tracked arithmetically."""
+    from .types import str_width
+
     n = 0
+    ln = doc.length(text_obj)
+    splice = doc.splice_text
     for e in edits:
-        ln = doc.length(text_obj)
-        pos = min(e[0], ln)
-        ndel = min(e[1], ln - pos)
+        pos = e[0]
+        if pos > ln:
+            pos = ln
+        ndel = e[1]
+        if ndel > ln - pos:
+            ndel = ln - pos
         text = "".join(e[2:])
-        doc.splice_text(text_obj, pos, ndel, text)
+        splice(text_obj, pos, ndel, text)
+        w = str_width(text)
+        ln += w - ndel
         n += ndel + len(text)
     return n
 

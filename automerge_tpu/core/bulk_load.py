@@ -335,13 +335,13 @@ def rebuild_op_store(doc) -> None:
             gc.enable()
 
 
-def _rebuild_op_store(doc) -> None:
+def _seq_export(stored, flat):
+    """(obj_keys, obj_off, elem_rows): every sequence object's element
+    order, via the batched device kernel (dense concurrency) or the native
+    sequential integrate — the rebuild's engine choice."""
     import os
 
     from .. import native
-
-    stored = [a.stored for a in doc.history]
-    flat = flatten_changes(stored)
 
     engine = os.environ.get("AUTOMERGE_TPU_BULK")
     if engine is None:
@@ -351,54 +351,41 @@ def _rebuild_op_store(doc) -> None:
             if len(flat["op_id"]) >= DEVICE_MIN_OPS and n_actors >= DEVICE_MIN_ACTORS
             else "native"
         )
-    obj_keys = None
     if engine == "device":
         try:
-            obj_keys, obj_off, elem_rows = _export_via_device(stored, flat)
+            return _export_via_device(stored, flat)
         except Exception:
             if os.environ.get("AUTOMERGE_TPU_DEBUG"):
                 raise
-            obj_keys = None  # fall back to the native integrate
-    if obj_keys is None:
-        obj_keys, obj_off, elem_rows = native.seq_apply_export(
-            flat["op_id"], flat["obj"], flat["elem"], flat["prop"], flat["action"],
-            flat["insert"], flat["is_counter"], flat["pred_off"], flat["pred_flat"],
-        )
+    return native.seq_apply_export(
+        flat["op_id"], flat["obj"], flat["elem"], flat["prop"], flat["action"],
+        flat["insert"], flat["is_counter"], flat["pred_off"], flat["pred_flat"],
+    )
 
-    # ---- build Op objects (linear pass over change ops) -------------------
-    n = len(flat["op_id"])
-    ops: List[Op] = [None] * n
-    objs_of: List[Tuple[int, int]] = [None] * n  # (obj ctr, obj doc-idx)
-    sort_key = doc._ops.lamport_key  # direct: doc.ops may be mid-rebuild
-    if flat.get("rb") is not None:
-        _build_ops_from_arrays(doc, flat, ops, objs_of, sort_key)
-    else:
-        _build_ops_from_changes(doc, stored, ops, objs_of, sort_key)
 
+def _row_visibility(flat):
+    """Vectorized per-row current-state visibility (Op.visible batched).
+
+    Returns (vis, src_rows, tgt_rows): vis[i] = row i is a visible winner
+    candidate; src/tgt are the resolved pred-edge endpoints (source op row,
+    predecessor-target op row) for succ-list construction."""
     ids = flat["op_id"]
+    n = len(ids)
     order = np.argsort(ids, kind="stable")
     sorted_ids = ids[order]
 
-    def rows_of(keys: np.ndarray) -> np.ndarray:
-        pos = np.searchsorted(sorted_ids, keys)
-        posc = np.clip(pos, 0, max(n - 1, 0))
-        hit = sorted_ids[posc] == keys if n else np.zeros(len(keys), bool)
-        return np.where(hit, order[posc], -1)
-
-    # ---- succ lists / counter incs (vectorized edge resolution) -----------
     pred_counts = np.diff(flat["pred_off"])
     src_rows = np.repeat(np.arange(n, dtype=np.int64), pred_counts)
-    tgt_rows = rows_of(flat["pred_flat"]) if len(flat["pred_flat"]) else np.empty(0, np.int64)
+    if len(flat["pred_flat"]):
+        pos = np.searchsorted(sorted_ids, flat["pred_flat"])
+        posc = np.clip(pos, 0, max(n - 1, 0))
+        hit = sorted_ids[posc] == flat["pred_flat"] if n else np.zeros(0, bool)
+        tgt_rows = np.where(hit, order[posc], -1)
+    else:
+        tgt_rows = np.empty(0, np.int64)
     okm = tgt_rows >= 0
     src_rows, tgt_rows = src_rows[okm], tgt_rows[okm]
-    edge_order = np.lexsort((ids[src_rows], tgt_rows))
-    for k in edge_order:
-        s, t = ops[int(src_rows[k])], ops[int(tgt_rows[k])]
-        t.succ.append(s.id)
-        if s.is_inc and t.is_counter:
-            t.incs.append((s.id, s.value.value))
 
-    # ---- per-row current-state visibility (vectorized Op.visible) ---------
     act = flat["action"]
     succ_n = np.zeros(n, np.int64)
     inc_n = np.zeros(n, np.int64)
@@ -412,6 +399,117 @@ def _rebuild_op_store(doc) -> None:
     counter_row = (act == int(Action.PUT)) & (flat["is_counter"] != 0)
     never = np.isin(act, (int(Action.DELETE), int(Action.INCREMENT), int(Action.MARK)))
     vis = ~never & np.where(counter_row, succ_n <= inc_n, succ_n == 0)
+    return vis, src_rows, tgt_rows
+
+
+def stale_read_state(doc):
+    """The flatten + linearization + visibility intermediates shared by
+    every stale read at one history length — computed once, cached by the
+    Document so N object reads pay one history pass, not N. None when the
+    array path can't serve this history."""
+    stored = [a.stored for a in doc.history]
+    if not stored:
+        return None
+    flat = flatten_changes(stored)
+    if flat.get("rb") is None:
+        return None  # no value columns: let the store answer
+    obj_keys, obj_off, elem_rows = _seq_export(stored, flat)
+    vis, _, _ = _row_visibility(flat)
+    return {
+        "flat": flat,
+        "obj_keys": np.asarray(obj_keys),
+        "obj_off": obj_off,
+        "elem_rows": np.asarray(elem_rows),
+        "vis": vis,
+    }
+
+
+def stale_text(doc, obj_exid: str, state):
+    """Current-state text of one object straight from history arrays — no
+    op-store materialization. None when this path can't serve (caller
+    falls back to the materialized store).
+
+    This is the sync-consumer read path: a replica that catches up over
+    the wire and is only *read* never pays the Python object build; the
+    store materializes lazily on the first local edit (the same
+    history-is-source-of-truth stance as Document._materialize_ops)."""
+    opid = doc.import_id(obj_exid)
+    if opid == (0, 0):
+        return None  # root is a map
+    flat = state["flat"]
+    rb = flat["rb"]
+    actor_b = doc.actors.get(opid[1]).bytes
+    rank = flat["rank_of"].get(bytes(actor_b))
+    if rank is None:
+        return None
+    qkey = (opid[0] << ACTOR_BITS) | rank
+
+    obj_keys, obj_off, elem_rows = state["obj_keys"], state["obj_off"], state["elem_rows"]
+    kidx = np.flatnonzero(np.asarray(obj_keys) == qkey)
+    if len(kidx) == 0:
+        return None  # empty / unknown / non-sequence object
+    k = int(kidx[0])
+    rows = elem_rows[int(obj_off[k]) : int(obj_off[k + 1])].astype(np.int64)
+    vis = state["vis"]
+    ids = flat["op_id"]
+
+    # winner per element: the insert op if visible, overridden by the last
+    # visible update targeting it (ascending lamport — same rule as the
+    # rebuild's seq-update pass / reference TopOps)
+    win = np.where(vis[rows], rows, -1)
+    upd = np.flatnonzero(
+        (flat["prop"] != 0) & (flat["insert"] == 0) & vis & (flat["obj"] == qkey)
+    )
+    if len(upd):
+        upd = upd[np.argsort(ids[upd], kind="stable")]
+        elem_ids = ids[rows]
+        order = np.argsort(elem_ids)
+        pos = np.searchsorted(elem_ids[order], flat["elem"][upd])
+        pos = np.clip(pos, 0, max(len(rows) - 1, 0))
+        ok = elem_ids[order][pos] == flat["elem"][upd] if len(rows) else np.zeros(0, bool)
+        win[order[pos[ok]]] = upd[ok]
+
+    sel = win[win >= 0]
+    a = rb["a"]
+    vc = a["vcode"][sel].tolist()
+    off = a["voff"][sel].tolist()
+    ln = a["vlen"][sel].tolist()
+    raw = a["vraw"]
+    parts = []
+    for i in range(len(vc)):
+        if vc[i] == 6:
+            o = off[i]
+            parts.append(raw[o : o + ln[i]].decode("utf-8"))
+        else:
+            parts.append("￼")
+    return "".join(parts)
+
+
+def _rebuild_op_store(doc) -> None:
+    stored = [a.stored for a in doc.history]
+    flat = flatten_changes(stored)
+    obj_keys, obj_off, elem_rows = _seq_export(stored, flat)
+
+    # ---- build Op objects (linear pass over change ops) -------------------
+    n = len(flat["op_id"])
+    ops: List[Op] = [None] * n
+    objs_of: List[Tuple[int, int]] = [None] * n  # (obj ctr, obj doc-idx)
+    sort_key = doc._ops.lamport_key  # direct: doc.ops may be mid-rebuild
+    if flat.get("rb") is not None:
+        _build_ops_from_arrays(doc, flat, ops, objs_of, sort_key)
+    else:
+        _build_ops_from_changes(doc, stored, ops, objs_of, sort_key)
+
+    ids = flat["op_id"]
+
+    # ---- succ lists / counter incs + visibility (vectorized) --------------
+    vis, src_rows, tgt_rows = _row_visibility(flat)
+    edge_order = np.lexsort((ids[src_rows], tgt_rows))
+    for k in edge_order:
+        s, t = ops[int(src_rows[k])], ops[int(tgt_rows[k])]
+        t.succ.append(s.id)
+        if s.is_inc and t.is_counter:
+            t.incs.append((s.id, s.value.value))
 
     # ---- object registry --------------------------------------------------
     store = OpStore(doc.actors)

@@ -105,6 +105,9 @@ class Document:
         # exid-string -> OpId memo: actor interning is append-only, so a
         # resolved id never changes (misses are NOT cached)
         self._exid_cache: Dict[str, OpId] = {}
+        # ((history length, obj), text) memo for stale-store text reads;
+        # history is append-only so the length keys the doc state
+        self._stale_text_memo = None
         # live manual transactions (registered by Transaction); a device
         # merge or save while one is open would silently miss its ops.
         # Weak refs: an abandoned (unreachable, never committed) transaction
@@ -601,9 +604,47 @@ class Document:
         return self.ops.seq_length(obj_id, enc, clock)
 
     def text(self, obj: str, heads=None, clock=None) -> str:
-        obj_id = self.import_obj(obj)
         clock = self._resolve_clock(heads, clock)
+        if clock is None and self._ops_stale:
+            # read-only consumer after a bulk apply (the sync catch-up
+            # pattern): answer from history arrays without materializing
+            # the op store (bulk_load.stale_text)
+            t = self._stale_text(obj)
+            if t is not None:
+                return t
+        obj_id = self.import_obj(obj)
         return self.ops.text(obj_id, clock)
+
+    def _stale_text(self, obj: str):
+        import os
+
+        from .bulk_load import stale_text
+
+        from .bulk_load import stale_read_state
+
+        memo = self._stale_text_memo
+        if memo is None or memo[0] != len(self.history):
+            # state=False: not computed yet; None: path unavailable
+            memo = self._stale_text_memo = [len(self.history), False, {}]
+        cache = memo[2]
+        if obj in cache:
+            return cache[obj]
+        if memo[1] is False:
+            try:
+                memo[1] = stale_read_state(self)
+            except Exception:
+                if os.environ.get("AUTOMERGE_TPU_DEBUG"):
+                    raise
+                memo[1] = None
+        t = None
+        if memo[1] is not None:
+            try:
+                t = stale_text(self, obj, memo[1])
+            except Exception:
+                if os.environ.get("AUTOMERGE_TPU_DEBUG"):
+                    raise
+        cache[obj] = t  # None memoized too: don't re-try per failed read
+        return t
 
     def list_items(self, obj: str, heads=None, clock=None) -> List[Tuple[object, str]]:
         obj_id = self.import_obj(obj)

@@ -213,6 +213,7 @@ class SeqObject:
         "visible_len",
         "text_width",
         "_cursor",  # (Element, list_index, text_index) of a visible element
+        "_text_cache",  # current-state text (TEXT objects, bulk rebuild)
     )
 
     def __init__(self, obj_type: ObjType, actors=None):
@@ -225,9 +226,14 @@ class SeqObject:
         self.visible_len = 0
         self.text_width = 0
         self._cursor = None
+        # current-state text, filled by rebuild_blocks for TEXT objects;
+        # any element mutation drops it (every seq mutation path calls
+        # invalidate_cursor)
+        self._text_cache: Optional[str] = None
 
     def invalidate_cursor(self) -> None:
         self._cursor = None
+        self._text_cache = None
 
     # -- block index maintenance ------------------------------------------
 
@@ -306,8 +312,15 @@ class SeqObject:
             b.width += dwidth
 
     def rebuild_blocks(self) -> None:
-        """Partition the element list into fresh blocks (bulk load path)."""
+        """Partition the element list into fresh blocks (bulk load path).
+
+        For TEXT objects the same winner sweep also assembles the
+        current-state text cache, so the first text() read after a bulk
+        rebuild (the sync catch-up read pattern) is a plain string return
+        instead of a second full element walk."""
         self.blocks = []
+        cache_text = self.obj_type == ObjType.TEXT
+        parts: List[str] = []
         b = None
         el = self.head.next
         while el is not None:
@@ -320,6 +333,9 @@ class SeqObject:
             if w is not None:
                 b.vis += 1
                 b.width += w.text_width()
+                if cache_text:
+                    v = w.value
+                    parts.append(v.value if v.tag == "str" else "￼")
             if el.op.is_mark:
                 b.marks += 1
             key = self._block_key(el)
@@ -328,6 +344,8 @@ class SeqObject:
             el = el.next
         self.visible_len = sum(x.vis for x in self.blocks)
         self.text_width = sum(x.width for x in self.blocks)
+        if cache_text:
+            self._text_cache = "".join(parts)
 
     def next_visible_from(self, el: Optional[Element]) -> Optional[Element]:
         """First CURRENT-STATE-visible element strictly after ``el``
@@ -835,6 +853,10 @@ class OpStore:
             el = el.next
 
     def text(self, obj_id: OpId, clock=None) -> str:
+        if clock is None:
+            cached = getattr(self.get_obj(obj_id).data, "_text_cache", None)
+            if cached is not None:
+                return cached
         parts = []
         for _, w in self.visible_elements(obj_id, clock):
             if w.value.tag == "str":

@@ -190,6 +190,50 @@ class Transaction:
         self._sessions[obj_id] = [sess, 0]  # [session, drained watermark]
         return sess
 
+    def fast_splice_fn(self, obj: str):
+        """A minimal per-splice closure for the typing hot path, or None.
+
+        Collapses the AutoDoc -> Transaction -> EditSession -> ctypes chain
+        (4+ Python frames and a ~1us libffi call per edit) into one closure
+        frame and one METH_FASTCALL C call. The closure returns False when
+        it can no longer serve (session drained/closed) so the caller falls
+        back to the general path and drops its cache. Raises the same typed
+        error as splice_text on out-of-bounds."""
+        from .. import native
+
+        fc = native.fastcall()
+        if fc is None:
+            return None
+        obj_id = self.doc.import_id(obj)
+        ent = self._sessions.get(obj_id)
+        if ent is None:
+            return None
+        sess = ent[0]
+        if not sess._h:
+            return None
+        h = sess._h
+        fsplice = fc.splice
+        from ..types import get_text_encoding
+
+        enc = {"unicode": 0, "utf8": 1, "utf16": 2}[get_text_encoding()]
+        splice_err = native._splice_error
+        start = self.start_op
+
+        def fast(pos: int, ndel: int, text: str) -> bool:
+            if sess._h is None or self._done:
+                return False
+            n = fsplice(
+                h,
+                start + len(self.operations) + self._session_ops,
+                pos, ndel, text, enc,
+            )
+            if n < 0:
+                raise splice_err(n)
+            self._session_ops += n
+            return True
+
+        return fast
+
     def _drain_all(self, drop: bool = False) -> None:
         """Materialize pending (undrained) session ops through the python
         per-op path (id order), so the op store reflects them.

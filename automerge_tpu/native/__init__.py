@@ -166,6 +166,64 @@ def load() -> Optional[ctypes.CDLL]:
     return _lib
 
 
+_fastcall = None
+_fastcall_tried = False
+
+
+def fastcall():
+    """The CPython fast-call extension (fastcall.c), building it on first
+    use; None if unavailable. Its splice() entry bypasses ctypes' ~1us
+    per-call marshalling on the per-edit hot path."""
+    global _fastcall, _fastcall_tried
+    if _fastcall is not None or _fastcall_tried:
+        return _fastcall
+    _fastcall_tried = True
+    lib = load()
+    if lib is None:
+        return None
+    import sys
+    import sysconfig
+
+    src = os.path.join(_HERE, "fastcall.c")
+    h = hashlib.sha256()
+    with open(src, "rb") as f:
+        h.update(f.read())
+    # unlike the pure-C codecs .so, this links against Python.h internals
+    # (PyUnicode object layout) — the interpreter ABI tag must key the
+    # cache or a module built under one CPython silently corrupts another
+    tag = sys.implementation.cache_tag or "py"
+    name = f"_am_fastcall-{tag}-{h.hexdigest()[:16]}.so"
+    path = os.path.join(os.path.dirname(_lib_path()), name)
+    if not os.path.exists(path):
+        tmp = f"{path}.tmp{os.getpid()}"
+        inc = sysconfig.get_path("include")
+        cmd = ["gcc", "-O2", "-shared", "-fPIC", f"-I{inc}", "-o", tmp, src]
+        try:
+            r = subprocess.run(cmd, capture_output=True, timeout=120)
+            if r.returncode != 0 or not os.path.exists(tmp):
+                return None
+            os.replace(tmp, path)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+    try:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location("am_fastcall", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        mod.setup(ctypes.cast(lib.am_edit_splice, ctypes.c_void_p).value)
+        _fastcall = mod
+    except Exception:
+        return None
+    return _fastcall
+
+
 def available() -> bool:
     return load() is not None
 

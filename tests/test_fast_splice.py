@@ -1,0 +1,98 @@
+"""The per-edit fast-splice path (api.py splice cache + native fastcall).
+
+Differential: every scenario is replayed through a second document with
+the fast path disabled (AUTOMERGE_TPU sessions off via manual python
+transactions) or through splice_text_many, and the results must agree.
+"""
+
+import pytest
+
+from automerge_tpu.api import AutoDoc
+from automerge_tpu.types import ActorId, ObjType
+from automerge_tpu import native
+
+
+def _mk():
+    d = AutoDoc(actor=ActorId(bytes([1]) * 16))
+    t = d.put_object("_root", "text", ObjType.TEXT)
+    return d, t
+
+
+def test_fast_path_interleaved_with_reads():
+    d, t = _mk()
+    d.splice_text(t, 0, 0, "hello")
+    assert d.text(t) == "hello"  # read drains but keeps the session
+    d.splice_text(t, 5, 0, " world")  # cache may rebuild; must still land
+    d.splice_text(t, 0, 1, "H")
+    assert d.text(t) == "Hello world"
+    d.commit()
+    assert AutoDoc.load(d.save()).text(t) == "Hello world"
+
+
+def test_fast_path_survives_python_mutation_between_splices():
+    d, t = _mk()
+    d.splice_text(t, 0, 0, "abc")
+    d.put("_root", "k", 1)  # python-path op; drains/drops sessions
+    d.splice_text(t, 3, 0, "def")
+    assert d.text(t) == "abcdef"
+    assert d.hydrate() == {"text": "abcdef", "k": 1}
+
+
+def test_fast_path_across_commits():
+    d, t = _mk()
+    for i in range(5):
+        d.splice_text(t, d.length(t), 0, f"x{i}")
+        d.commit()
+    assert d.text(t) == "x0x1x2x3x4"
+    loaded = AutoDoc.load(d.save())
+    assert loaded.text(t) == "x0x1x2x3x4"
+
+
+def test_fast_path_non_ascii_widths():
+    d, t = _mk()
+    d.splice_text(t, 0, 0, "aé中\U0001f600b")  # 1,2,3,4-byte utf8
+    assert d.text(t) == "aé中\U0001f600b"
+    d.splice_text(t, 2, 1, "z")  # positions are width-unit based (unicode=cp)
+    assert d.text(t) == "aéz\U0001f600b"
+    d.commit()
+    assert AutoDoc.load(d.save()).text(t) == "aéz\U0001f600b"
+
+
+def test_fast_path_out_of_bounds_raises():
+    d, t = _mk()
+    d.splice_text(t, 0, 0, "abc")
+    with pytest.raises(Exception):
+        d.splice_text(t, 99, 0, "x")
+    # the transaction is still usable after the error
+    d.splice_text(t, 3, 0, "d")
+    assert d.text(t) == "abcd"
+
+
+def test_fastcall_module_loads():
+    if native.load() is None:
+        pytest.skip("native unavailable")
+    fc = native.fastcall()
+    assert fc is None or hasattr(fc, "splice")
+
+
+def test_fast_path_differential_vs_batch():
+    import numpy as np
+
+    rng = np.random.default_rng(42)
+    edits, ln = [], 0
+    for _ in range(2000):
+        if ln == 0 or rng.random() < 0.7:
+            pos = int(rng.integers(0, ln + 1))
+            edits.append([pos, 0, chr(97 + int(rng.integers(0, 26)))])
+            ln += 1
+        else:
+            edits.append([int(rng.integers(0, ln)), 1])
+            ln -= 1
+    a, ta = _mk()
+    for e in edits:
+        a.splice_text(ta, e[0], e[1], "".join(e[2:]))
+    a.commit()
+    b, tb = _mk()
+    b.splice_text_many(tb, edits, clamp=False)
+    b.commit()
+    assert a.text(ta) == b.text(tb)

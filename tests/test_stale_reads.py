@@ -1,0 +1,153 @@
+"""Stale-store text reads (bulk_load.stale_text) — differential vs the
+materialized op store.
+
+After a bulk apply the op store is a stale materialized view; text() may
+answer straight from history arrays. Every scenario asserts the stale
+answer equals the answer after forcing full materialization.
+"""
+
+import pytest
+
+from automerge_tpu.api import AutoDoc
+from automerge_tpu.core.document import Document
+from automerge_tpu.types import ActorId, ObjType
+
+
+@pytest.fixture(autouse=True)
+def _small_bulk_threshold(monkeypatch):
+    # force the bulk (stale-marking) apply path at test sizes
+    monkeypatch.setattr(Document, "BULK_MIN_OPS", 1)
+
+
+def _fork_edit(base: AutoDoc, actor: bytes, fn):
+    f = base.fork(actor=ActorId(actor))
+    fn(f)
+    f.commit()
+    return f
+
+
+def _stale_then_materialized(doc: AutoDoc, tobj: str):
+    d = doc.doc
+    assert d._ops_stale, "precondition: store must be stale"
+    stale = doc.text(tobj)
+    assert d._ops_stale, "text() on a stale store must not materialize it"
+    d.ops  # force materialization
+    return stale, d.ops.text(d.import_obj(tobj), None)
+
+
+def _merged(base: AutoDoc, forks):
+    out = AutoDoc.load(base.save())
+    for f in forks:
+        out.doc.apply_changes([a.stored for a in f.doc.history if a.hash not in out.doc.history_index])
+    return out
+
+
+def test_stale_text_concurrent_inserts():
+    base = AutoDoc(actor=ActorId(bytes([1]) * 16))
+    t = base.put_object("_root", "text", ObjType.TEXT)
+    base.splice_text(t, 0, 0, "base text here")
+    base.commit()
+    forks = [
+        _fork_edit(base, bytes([i + 2]) * 16, lambda f, i=i: f.splice_text(t, i, 0, f"<{i}>"))
+        for i in range(4)
+    ]
+    m = _merged(base, forks)
+    stale, mat = _stale_then_materialized(m, t)
+    assert stale == mat
+
+
+def test_stale_text_deletes_and_updates():
+    base = AutoDoc(actor=ActorId(bytes([1]) * 16))
+    t = base.put_object("_root", "text", ObjType.TEXT)
+    base.splice_text(t, 0, 0, "abcdefghij")
+    base.commit()
+
+    def del_some(f):
+        f.splice_text(t, 2, 3, "")
+
+    def ins_mid(f):
+        f.splice_text(t, 5, 0, "XYZ")
+
+    m = _merged(base, [
+        _fork_edit(base, bytes([2]) * 16, del_some),
+        _fork_edit(base, bytes([3]) * 16, ins_mid),
+    ])
+    stale, mat = _stale_then_materialized(m, t)
+    assert stale == mat
+
+
+def test_stale_text_non_ascii():
+    base = AutoDoc(actor=ActorId(bytes([1]) * 16))
+    t = base.put_object("_root", "text", ObjType.TEXT)
+    base.splice_text(t, 0, 0, "héllo ✨ wörld 中文")
+    base.commit()
+    m = _merged(base, [
+        _fork_edit(base, bytes([2]) * 16, lambda f: f.splice_text(t, 3, 2, "🎈")),
+    ])
+    stale, mat = _stale_then_materialized(m, t)
+    assert stale == mat
+
+
+def test_stale_text_with_marks():
+    base = AutoDoc(actor=ActorId(bytes([1]) * 16))
+    t = base.put_object("_root", "text", ObjType.TEXT)
+    base.splice_text(t, 0, 0, "marked text")
+    base.mark(t, 0, 6, "bold", True)
+    base.commit()
+    m = _merged(base, [
+        _fork_edit(base, bytes([2]) * 16, lambda f: f.splice_text(t, 7, 0, "up ")),
+    ])
+    stale, mat = _stale_then_materialized(m, t)
+    assert stale == mat
+
+
+def test_stale_text_memo_invalidated_by_new_changes():
+    base = AutoDoc(actor=ActorId(bytes([1]) * 16))
+    t = base.put_object("_root", "text", ObjType.TEXT)
+    base.splice_text(t, 0, 0, "one")
+    base.commit()
+    f1 = _fork_edit(base, bytes([2]) * 16, lambda f: f.splice_text(t, 3, 0, " two"))
+    f2 = _fork_edit(base, bytes([3]) * 16, lambda f: f.splice_text(t, 0, 0, "zero "))
+    m = AutoDoc.load(base.save())
+    m.doc.apply_changes([a.stored for a in f1.doc.history if a.hash not in m.doc.history_index])
+    first = m.text(t)
+    m.doc.apply_changes([a.stored for a in f2.doc.history if a.hash not in m.doc.history_index])
+    second = m.text(t)
+    assert first != second
+    m.doc.ops
+    assert m.doc.ops.text(m.doc.import_obj(t), None) == second
+
+
+def test_stale_text_empty_and_missing_fall_back():
+    base = AutoDoc(actor=ActorId(bytes([1]) * 16))
+    t = base.put_object("_root", "text", ObjType.TEXT)
+    base.commit()
+    f = _fork_edit(base, bytes([2]) * 16, lambda f: f.put("_root", "k", 1))
+    m = _merged(base, [f])
+    assert m.text(t) == ""  # empty text object: fallback path
+    with pytest.raises(Exception):
+        m.text("99@" + "00" * 16)  # unknown object still raises
+
+
+def test_stale_text_after_sync_roundtrip():
+    from automerge_tpu.sync import SyncState
+    from automerge_tpu.sync.protocol import generate_sync_message, receive_sync_message
+
+    a = AutoDoc(actor=ActorId(bytes([1]) * 16))
+    t = a.put_object("_root", "text", ObjType.TEXT)
+    a.splice_text(t, 0, 0, "synced content " * 50)
+    a.commit()
+    b = AutoDoc.load(a.save())
+    a.splice_text(t, 0, 0, "more ")
+    a.commit()
+    sa, sb = SyncState(), SyncState()
+    for _ in range(20):
+        ma = generate_sync_message(a.doc, sa)
+        if ma:
+            receive_sync_message(b.doc, sb, ma)
+        mb = generate_sync_message(b.doc, sb)
+        if mb:
+            receive_sync_message(a.doc, sa, mb)
+        if not ma and not mb:
+            break
+    assert b.text(t) == a.text(t)
