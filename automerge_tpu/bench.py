@@ -259,9 +259,17 @@ def synth_mapcounter(
     every replica also puts a few shared map keys so the merge resolves real
     conflicts, not just commutative adds. Returns (changes, expected
     per-key counter totals) so callers can verify the merge exactly.
+
+    Changes are built straight at the column level (the array-native
+    ``build_change(cols=...)`` path also used by document load) — one
+    replica's whole op block is numpy arrays, never ChangeOp objects, so
+    synthesizing the BASELINE-scale 1M-op divergence takes ~1s instead of
+    dominating the config's wall time.
     """
+    from .storage.change import LazyOps, encode_change_cols_arrays
+
     d = doc.doc
-    base_heads = d.get_heads()
+    base_heads = sorted(d.get_heads())
     base_max = d.max_op
     base_actor = d.actor.bytes
     # counter put op ids in commit order: root puts are ops 1..n by actor 1
@@ -271,56 +279,106 @@ def synth_mapcounter(
         name = d.props.get(prop_idx)
         for op in run:
             put_id[name] = (op.id[0], d.actors.get(op.id[1]).bytes)
+
+    # one rng for the whole workload (deterministic, vectorized)
+    rng = np.random.default_rng(3000)
+    picks = rng.integers(0, len(keys), (n_actors, incs_per_actor))
+    counts = np.bincount(picks.reshape(-1), minlength=len(keys))
+    expected = {k: int(counts[j]) for j, k in enumerate(keys) if counts[j]}
+
+    # column templates shared by every replica change: incs then 4 puts
+    m = incs_per_actor + 4
+    key_table = list(keys) + [f"w{j}" for j in range(4)]
+    put_ctr = np.asarray([put_id[k][0] for k in keys], np.int64)
+    zeros = np.zeros(m, np.int64)
+    zeros_u8 = np.zeros(m, np.uint8)
+    action = np.concatenate([
+        np.full(incs_per_actor, _ACTION_INCREMENT, np.int64),
+        np.full(4, _ACTION_PUT, np.int64),
+    ])
+    pred_num = np.concatenate([
+        np.ones(incs_per_actor, np.int64), np.zeros(4, np.int64)
+    ])
+    # increments carry int 1 (sleb 0x01, meta 0x14); puts carry int i
+    inc_meta = np.full(incs_per_actor, (1 << 4) | 4, np.int64)
+    inc_raw = b"\x01" * incs_per_actor
+    mark_ids = np.full(m, -1, np.int64)
+
+    from .utils.leb128 import sleb_bytes
+
+    # 12 of the 14 columns are identical across replicas (obj, key-elem,
+    # insert, action, expand, marks, pred_actor/num, ...) — encode them ONCE
+    # via the shared array-native encoder, then per replica only the three
+    # varying columns (key ids, pred counters, value payload) are rebuilt.
+    template = encode_change_cols_arrays(
+        {
+            "obj_mask": zeros_u8,
+            "obj_ctr": zeros,
+            "obj_actor": zeros,
+            "key_str_ids": np.concatenate(
+                [picks[0], np.arange(len(keys), len(keys) + 4)]
+            ),
+            "key_str_table": key_table,
+            "key_ctr": zeros,
+            "key_ctr_mask": zeros_u8,
+            "key_actor": zeros,
+            "key_actor_mask": zeros_u8,
+            "insert": zeros_u8,
+            "action": action,
+            "val_meta": np.concatenate([inc_meta, np.full(4, (1 << 4) | 4, np.int64)]),
+            "val_raw": b"",
+            "pred_num": pred_num,
+            "pred_ctr": put_ctr[picks[0]],
+            "pred_actor": np.ones(incs_per_actor, np.int64),  # base actor
+            "expand": zeros_u8,
+            "mark_ids": mark_ids,
+            "mark_table": [],
+        }
+    )
+    from .storage.change import (
+        COL_KEY_STR, COL_PRED_CTR, COL_VAL_META, COL_VAL_RAW,
+    )
+    base_cols = dict(template)
+    # the varying columns are rebuilt per replica below; drop them from the
+    # shared template so any accidental reliance fails loudly
+    for _c in (COL_KEY_STR, COL_PRED_CTR, COL_VAL_META, COL_VAL_RAW):
+        base_cols.pop(_c, None)
+    key_tail = np.arange(len(keys), len(keys) + 4)
+    ones_p = np.ones(incs_per_actor, np.uint8)
+    meta_cache: Dict[int, bytes] = {}
+    from . import native as _native
+
     out = []
-    expected: Dict[str, int] = {}
     for i in range(n_actors):
         actor = _replica_actor(i)
-        others = sorted({base_actor} - {actor})
-        local = {actor: 0, **{a: j + 1 for j, a in enumerate(others)}}
-        ops = []
-        ctr = base_max
-        rng = np.random.default_rng(3000 + i)
-        for j in range(incs_per_actor):
-            key = keys[int(rng.integers(0, len(keys)))]
-            expected[key] = expected.get(key, 0) + 1
-            pc, pa = put_id[key]
-            ctr += 1
-            ops.append(
-                ChangeOp(
-                    obj=ROOT_STORED,
-                    key=Key.map(key),
-                    insert=False,
-                    action=_ACTION_INCREMENT,
-                    value=ScalarValue("int", 1),
-                    pred=[(pc, local[pa])],
-                )
+        put_raw = sleb_bytes(i)
+        put_meta = (len(put_raw) << 4) | 4
+        vm = meta_cache.get(put_meta)
+        if vm is None:
+            vm = _native.rle_encode_array(
+                np.concatenate([inc_meta, np.full(4, put_meta, np.int64)]),
+                np.ones(m, np.uint8), False,
             )
-        # a few conflicting shared-key puts
-        for j in range(4):
-            ctr += 1
-            ops.append(
-                ChangeOp(
-                    obj=ROOT_STORED,
-                    key=Key.map(f"w{j}"),
-                    insert=False,
-                    action=_ACTION_PUT,
-                    value=ScalarValue("int", i),
-                )
-            )
-        out.append(
-            build_change(
-                StoredChange(
-                    dependencies=list(base_heads),
-                    actor=actor,
-                    other_actors=others,
-                    seq=1,
-                    start_op=base_max + 1,
-                    timestamp=0,
-                    message=None,
-                    ops=ops,
-                )
-            )
+            meta_cache[put_meta] = vm
+        cols_d = dict(base_cols)
+        cols_d[COL_KEY_STR] = _native.rle_encode_strtab(
+            np.concatenate([picks[i], key_tail]), key_table
         )
+        cols_d[COL_PRED_CTR] = _native.delta_encode_array(put_ctr[picks[i]], ones_p)
+        cols_d[COL_VAL_META] = vm
+        cols_d[COL_VAL_RAW] = inc_raw + put_raw * 4
+        cols = sorted(cols_d.items())  # chunk columns must ascend by spec
+        sc = StoredChange(
+            dependencies=list(base_heads),
+            actor=actor,
+            other_actors=[base_actor],
+            seq=1,
+            start_op=base_max + 1,
+            timestamp=0,
+            message=None,
+            ops=LazyOps(cols_d, m),
+        )
+        out.append(build_change(sc, cols=cols))
     return out, expected
 
 

@@ -30,6 +30,7 @@ _SRCS = [
     os.path.join(_HERE, "apply.cpp"),
     os.path.join(_HERE, "extract_batch.cpp"),
     os.path.join(_HERE, "session.cpp"),
+    os.path.join(_HERE, "merge_cols.cpp"),
 ]
 _SRC = _SRCS[0]
 
@@ -145,6 +146,21 @@ def load() -> Optional[ctypes.CDLL]:
         fn = getattr(lib, name)
         fn.restype = ctypes.c_longlong
         fn.argtypes = argtypes
+    lib.am_rle_encode_strtab.restype = ctypes.c_longlong
+    lib.am_rle_encode_strtab.argtypes = [
+        i64p, ctypes.c_int64, i64p, i64p, u8p, u8p, ctypes.c_int64,
+    ]
+    lib.am_join_rows_i64.restype = ctypes.c_longlong
+    lib.am_join_rows_i64.argtypes = [
+        i64p, ctypes.c_int64, i64p, ctypes.c_int64, ctypes.c_int32, i32p,
+    ]
+    lib.am_merge_cols.restype = ctypes.c_longlong
+    lib.am_merge_cols.argtypes = [
+        i32p, u8p, i32p, i32p, i32p, i32p, i32p, i32p, u8p, ctypes.c_int64,
+        i32p, i32p, ctypes.c_int64, ctypes.c_int64,
+        u8p, i32p, i32p, i32p, i32p, i32p, i32p, i32p, i32p, u8p, i32p,
+        i32p, i32p, ctypes.c_int32,
+    ]
     vp = ctypes.c_void_p
     lib.am_edit_create.restype = vp
     lib.am_edit_create.argtypes = [ctypes.c_int64]
@@ -409,6 +425,109 @@ def seq_apply_export(
     if k < 0:
         raise ValueError(f"sequential apply failed (code {k})")
     return obj_keys[:k], obj_off[: k + 1], elem_rows[: int(obj_off[k])]
+
+
+def rle_encode_strtab(ids: np.ndarray, table) -> bytes:
+    """String RLE column from an int-id column (-1 = null) + string table;
+    byte-identical to RleEncoder("str") over table lookups. Raises
+    NativeUnavailable when the lib is absent."""
+    lib = load()
+    if lib is None or not hasattr(lib, "am_rle_encode_strtab"):
+        raise NativeUnavailable("native strtab encode not available")
+    ids = np.ascontiguousarray(ids, np.int64)
+    n = len(ids)
+    raws = [s.encode("utf-8") for s in table]
+    tab_len = np.asarray([len(r) for r in raws] or [0], np.int64)
+    tab_off = np.concatenate([[0], np.cumsum(tab_len)]).astype(np.int64)
+    tab_buf = _inbuf(b"".join(raws))
+    max_len = int(tab_len.max()) if len(raws) else 0
+    cap = n * (11 + max_len) + 32
+    if cap > (1 << 27):  # degenerate giant-string tables: python fallback
+        raise NativeUnavailable("strtab encode capacity too large")
+    out = np.empty(cap, np.uint8)
+    w = lib.am_rle_encode_strtab(
+        _i64(ids), n, _i64(tab_off), _i64(tab_len), _u8(tab_buf), _u8(out), cap
+    )
+    if w < 0:
+        raise ValueError("strtab encode: output overflow")
+    return out[:w].tobytes()
+
+
+def join_rows(sorted_keys: np.ndarray, queries: np.ndarray, missing: int) -> np.ndarray:
+    """out[i] = row of queries[i] in the sorted key column, else ``missing``
+    (multithreaded native binary search). Raises NativeUnavailable."""
+    lib = load()
+    if lib is None or not hasattr(lib, "am_join_rows_i64"):
+        raise NativeUnavailable("native join not available")
+    s = np.ascontiguousarray(sorted_keys, np.int64)
+    q = np.ascontiguousarray(queries, np.int64)
+    out = np.empty(max(len(q), 1), np.int32)
+    lib.am_join_rows_i64(_i64(s), len(s), _i64(q), len(q), missing, _i32(out))
+    return out[: len(q)]
+
+
+def merge_available() -> bool:
+    lib = load()
+    return lib is not None and hasattr(lib, "am_merge_cols")
+
+
+def merge_cols(cols, n_objs: int, want_elem_index: bool = True):
+    """Host columnar merge (merge_cols.cpp): the native engine producing the
+    same output arrays as the jax merge kernel from the same padded columns.
+
+    Returns the full output dict (ops/merge.py ALL_OUTPUTS); callers select
+    what they need. ``want_elem_index=False`` skips the preorder walk (the
+    only random-access pass; elem_index comes back all -1) for fetches that
+    exclude document order. Raises NativeUnavailable without the lib."""
+    lib = load()
+    if lib is None:
+        raise NativeUnavailable("native merge not available")
+    action = np.ascontiguousarray(cols["action"], np.int32)
+    insert = np.ascontiguousarray(cols["insert"], np.uint8)
+    prop = np.ascontiguousarray(cols["prop"], np.int32)
+    elem_ref = np.ascontiguousarray(cols["elem_ref"], np.int32)
+    obj_dense = np.ascontiguousarray(cols["obj_dense"], np.int32)
+    value_tag = np.ascontiguousarray(cols["value_tag"], np.int32)
+    value_i32 = np.ascontiguousarray(cols["value_i32"], np.int32)
+    width = np.ascontiguousarray(cols["width"], np.int32)
+    covered = np.ascontiguousarray(cols["covered"], np.uint8)
+    pred_src = np.ascontiguousarray(cols["pred_src"], np.int32)
+    pred_tgt = np.ascontiguousarray(cols["pred_tgt"], np.int32)
+    P = len(action)
+    Q = len(pred_src)
+    N = 2 * P + 3
+    n_objs2 = n_objs + 2
+    out = {
+        "visible": np.empty(P, np.uint8),
+        "counter_inc": np.empty(P, np.int32),
+        "winner": np.empty(P, np.int32),
+        "conflicts": np.empty(P, np.int32),
+        "succ_count": np.empty(P, np.int32),
+        "inc_count": np.empty(P, np.int32),
+        "first_child": np.empty(N, np.int32),
+        "next_sib": np.empty(N, np.int32),
+        "parent_row": np.empty(P, np.int32),
+        "is_elem": np.empty(P, np.uint8),
+        "obj_vis_len": np.empty(n_objs2, np.int32),
+        "obj_text_width": np.empty(n_objs2, np.int32),
+        "elem_index": np.empty(P, np.int32),
+    }
+    r = lib.am_merge_cols(
+        _i32(action), _u8(insert), _i32(prop), _i32(elem_ref), _i32(obj_dense),
+        _i32(value_tag), _i32(value_i32), _i32(width), _u8(covered), P,
+        _i32(pred_src), _i32(pred_tgt), Q, n_objs,
+        _u8(out["visible"]), _i32(out["counter_inc"]), _i32(out["winner"]),
+        _i32(out["conflicts"]), _i32(out["succ_count"]), _i32(out["inc_count"]),
+        _i32(out["first_child"]), _i32(out["next_sib"]),
+        _i32(out["parent_row"]), _u8(out["is_elem"]),
+        _i32(out["obj_vis_len"]), _i32(out["obj_text_width"]),
+        _i32(out["elem_index"]), int(bool(want_elem_index)),
+    )
+    if r < 0:
+        raise ValueError("native merge failed (cyclic element structure)")
+    out["visible"] = out["visible"].astype(bool)
+    out["is_elem"] = out["is_elem"].astype(bool)
+    return out
 
 
 def preorder_available() -> bool:

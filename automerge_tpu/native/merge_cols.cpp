@@ -1,0 +1,353 @@
+// Host columnar merge: the native engine behind ops/merge.py merge_columns.
+//
+// Computes exactly what the jax kernel (ops/merge.py resolve_state +
+// linearization) computes — succ resolution, visibility, per-key winners,
+// RGA document order, per-object stats — from the same padded int32
+// columns, but as O(n) linear passes on the host:
+//
+//   * succ resolution is one scatter loop over the pred stream (the
+//     batched ``add_succ``, reference: rust/automerge/src/op_set.rs:194-203)
+//   * per-key winner groups need NO sort: a sequence run's group id is the
+//     run-head insert row itself (rows are Lamport-ranked by construction,
+//     ops/oplog.py), so seq groups are a dense array indexed by row; map
+//     groups go through a dense (obj x prop) table when small, else an
+//     open-addressing hash
+//   * sibling lists build by ascending-row prepend (descending Lamport =
+//     descending row, reference: query/insert.rs tie-breaking), then the
+//     existing native preorder walk (codecs.cpp am_preorder_index) ranks
+//     document order
+//
+// Remote accelerators behind a thin link are round-trip-bound; below a
+// size threshold this engine beats the device end to end (see
+// merge_columns engine selection). Same columns in, same arrays out.
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" long long am_preorder_index(const int32_t* first_child,
+                                       const int32_t* next_sib,
+                                       const int32_t* parent, int64_t P,
+                                       int64_t N, int32_t* out);
+
+namespace {
+
+constexpr int32_t kPadAction = 15;
+constexpr int32_t kDelete = 3;
+constexpr int32_t kIncrement = 5;
+constexpr int32_t kMark = 7;
+constexpr int32_t kPut = 1;
+constexpr int32_t kTagCounter = 8;
+constexpr int32_t kElemHead = -1;
+constexpr int32_t kElemMissing = -3;
+constexpr int32_t kNone = -1;
+
+struct Group {
+  int32_t win = kNone;   // max visible row in the group
+  int32_t cnt = 0;       // visible rows in the group
+};
+
+// Open-addressing (linear probe) map group table for the rare case where
+// the dense (obj x prop) matrix would be too large.
+struct MapHash {
+  std::vector<uint64_t> keys;
+  std::vector<int32_t> slot;
+  std::vector<Group> groups;
+  uint64_t mask;
+
+  explicit MapHash(int64_t n) {
+    uint64_t cap = 64;
+    while (cap < (uint64_t)(2 * n)) cap <<= 1;
+    keys.assign(cap, UINT64_MAX);
+    slot.assign(cap, -1);
+    mask = cap - 1;
+  }
+  Group* get(uint64_t key) {
+    uint64_t h = (key * 0x9E3779B97F4A7C15ull) & mask;
+    for (;;) {
+      if (keys[h] == key) return &groups[slot[h]];
+      if (keys[h] == UINT64_MAX) {
+        keys[h] = key;
+        slot[h] = (int32_t)groups.size();
+        groups.emplace_back();
+        return &groups.back();
+      }
+      h = (h + 1) & mask;
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// All row arrays have length P (padded capacity; pad rows carry
+// action == 15). pred arrays have length Q. Object-stat outputs have
+// length n_objs + 2. first_child / next_sib are node space (2P + 3, as in
+// ops/merge.py: elements [0,P), object roots [P,2P+2), sentinel).
+// ``want_elem_index`` gates the preorder walk (the only random-access
+// pass) — callers whose fetch excludes elem_index (historical views)
+// skip it; elem_index is then left all -1.
+// Returns 0, or -1 on a cyclic element structure.
+long long am_merge_cols(
+    const int32_t* action, const uint8_t* insert, const int32_t* prop,
+    const int32_t* elem_ref, const int32_t* obj_dense,
+    const int32_t* value_tag, const int32_t* value_i32, const int32_t* width,
+    const uint8_t* covered, int64_t P, const int32_t* pred_src,
+    const int32_t* pred_tgt, int64_t Q, int64_t n_objs,
+    // outputs
+    uint8_t* visible, int32_t* counter_inc, int32_t* winner,
+    int32_t* conflicts, int32_t* succ_count, int32_t* inc_count,
+    int32_t* first_child, int32_t* next_sib, int32_t* parent_row,
+    uint8_t* is_elem, int32_t* obj_vis_len, int32_t* obj_text_width,
+    int32_t* elem_index, int32_t want_elem_index) {
+  const int64_t N = 2 * P + 3;
+  const int32_t S = (int32_t)(N - 1);
+
+  // --- 1. succ resolution (pred scatter) --------------------------------
+  std::memset(succ_count, 0, P * sizeof(int32_t));
+  std::memset(inc_count, 0, P * sizeof(int32_t));
+  std::memset(counter_inc, 0, P * sizeof(int32_t));
+  for (int64_t e = 0; e < Q; e++) {
+    const int32_t tgt = pred_tgt[e];
+    if (tgt < 0) continue;
+    const int32_t src = pred_src[e];
+    if (!covered[src]) continue;
+    if (action[src] == kIncrement) {
+      inc_count[tgt]++;
+      counter_inc[tgt] += value_i32[src];
+    } else {
+      succ_count[tgt]++;
+    }
+  }
+
+  // --- 2. visibility (types.rs:712-744) ---------------------------------
+  for (int64_t i = 0; i < P; i++) {
+    const int32_t a = action[i];
+    if (a == kPadAction || !covered[i] || a == kDelete || a == kIncrement ||
+        a == kMark) {
+      visible[i] = 0;
+      continue;
+    }
+    const bool is_counter = (a == kPut) && (value_tag[i] == kTagCounter);
+    visible[i] =
+        (is_counter ? succ_count[i] == 0
+                    : (succ_count[i] + inc_count[i]) == 0)
+            ? 1
+            : 0;
+  }
+
+  // --- 3. per-key winners ------------------------------------------------
+  // seq groups: dense by run-head row; HEAD / missing targets get two
+  // per-object slots (they group by (obj, sentinel key) on the device too)
+  std::vector<Group> run(P);
+  const int64_t n_objs2 = n_objs + 2;
+  std::vector<Group> head_g(n_objs2), miss_g(n_objs2);
+  // map groups: dense (obj x prop) when small, hash otherwise
+  int64_t n_props = 0;
+  for (int64_t i = 0; i < P; i++)
+    if (action[i] != kPadAction && prop[i] >= n_props) n_props = prop[i] + 1;
+  const bool dense_maps =
+      n_props == 0 || n_objs2 <= (4 * P + 65536) / n_props;
+  std::vector<Group> map_dense(dense_maps ? n_objs2 * n_props : 0);
+  MapHash map_hash(dense_maps ? 1 : P);
+
+  auto group_of = [&](int64_t i) -> Group* {
+    if (prop[i] >= 0) {
+      if (dense_maps) return &map_dense[(int64_t)obj_dense[i] * n_props + prop[i]];
+      return map_hash.get(((uint64_t)obj_dense[i] << 32) | (uint32_t)prop[i]);
+    }
+    const int32_t er = elem_ref[i];
+    const int32_t r = insert[i] ? (int32_t)i : er;
+    if (r >= 0) return &run[r];
+    return er == kElemHead ? &head_g[obj_dense[i]] : &miss_g[obj_dense[i]];
+  };
+
+  for (int64_t i = 0; i < P; i++) {
+    if (action[i] == kPadAction) continue;
+    if (!visible[i]) continue;
+    Group* g = group_of(i);
+    g->win = (int32_t)i;  // rows ascend: the last visible row wins
+    g->cnt++;
+  }
+  for (int64_t i = 0; i < P; i++) {
+    if (action[i] == kPadAction) {
+      winner[i] = kNone;
+      conflicts[i] = 0;
+      continue;
+    }
+    const Group* g = group_of(i);
+    winner[i] = g->win;
+    conflicts[i] = g->cnt;
+  }
+
+  // --- 4. RGA linearization ----------------------------------------------
+  // parent chain + sibling lists; ascending-row prepend leaves each child
+  // list in descending row (= descending Lamport) order
+  for (int64_t i = 0; i < N; i++) first_child[i] = kNone;
+  for (int64_t i = 0; i < N; i++) next_sib[i] = kNone;
+  for (int64_t i = 0; i < P; i++) {
+    const bool el = insert[i] && action[i] != kPadAction;
+    is_elem[i] = el ? 1 : 0;
+    const int32_t er = elem_ref[i];
+    parent_row[i] =
+        el ? (er == kElemHead ? (int32_t)(P + obj_dense[i])
+                              : (er >= 0 ? er : S))
+           : S;
+  }
+  for (int64_t i = 0; i < P; i++) {
+    if (!is_elem[i]) continue;
+    const int32_t p = parent_row[i];
+    next_sib[i] = first_child[p];
+    first_child[p] = (int32_t)i;
+  }
+  if (want_elem_index) {
+    if (am_preorder_index(first_child, next_sib, parent_row, P, N,
+                          elem_index) < 0)
+      return -1;
+    for (int64_t i = 0; i < P; i++)
+      if (!is_elem[i]) elem_index[i] = kNone;
+  } else {
+    for (int64_t i = 0; i < P; i++) elem_index[i] = kNone;
+  }
+
+  // --- per-object stats ---------------------------------------------------
+  std::memset(obj_vis_len, 0, n_objs2 * sizeof(int32_t));
+  std::memset(obj_text_width, 0, n_objs2 * sizeof(int32_t));
+  for (int64_t i = 0; i < P; i++) {
+    if (!is_elem[i] || winner[i] < 0) continue;
+    const int32_t o = obj_dense[i];
+    if (o >= n_objs2) continue;  // padded sentinel object
+    obj_vis_len[o]++;
+    obj_text_width[o] += width[winner[i]];
+  }
+  return 0;
+}
+
+// String-table RLE encode: the encode counterpart of codecs.cpp
+// am_rle_decode_batch_strtab. ids[i] is -1 (null) or an index into the
+// string table (tab_off/tab_len into tab_buf, utf-8 payloads); equal ids
+// are equal strings (tables are interned). Run/literal/null-run framing is
+// byte-identical to the Python RleEncoder("str") / am_rle_encode_i64:
+// sleb(count)+value for runs, sleb(-k)+k values for literals, sleb(0)+
+// uleb(n) for null runs; an all-null column encodes to zero bytes.
+// Returns bytes written, or -1 on output overflow.
+long long am_rle_encode_strtab(const int64_t* ids, int64_t n,
+                               const int64_t* tab_off, const int64_t* tab_len,
+                               const uint8_t* tab_buf, uint8_t* out,
+                               int64_t out_cap) {
+  int64_t w = 0;
+  bool ok = true;
+  auto uleb = [&](uint64_t v) {
+    do {
+      uint8_t b = v & 0x7F;
+      v >>= 7;
+      if (v) b |= 0x80;
+      if (w >= out_cap) {
+        ok = false;
+        return;
+      }
+      out[w++] = b;
+    } while (v && ok);
+  };
+  auto sleb = [&](int64_t v) {
+    for (;;) {
+      uint8_t b = v & 0x7F;
+      v >>= 7;
+      const bool done = (v == 0 && !(b & 0x40)) || (v == -1 && (b & 0x40));
+      if (!done) b |= 0x80;
+      if (w >= out_cap) {
+        ok = false;
+        return;
+      }
+      out[w++] = b;
+      if (done) return;
+    }
+  };
+  auto value = [&](int64_t id) {
+    const int64_t len = tab_len[id];
+    uleb((uint64_t)len);
+    if (w + len > out_cap) {
+      ok = false;
+      return;
+    }
+    std::memcpy(out + w, tab_buf + tab_off[id], (size_t)len);
+    w += len;
+  };
+  int64_t i = 0;
+  while (i < n && ok) {
+    if (ids[i] < 0) {  // null run
+      int64_t j = i;
+      while (j < n && ids[j] < 0) j++;
+      if (i == 0 && j == n) return 0;  // all-null: zero bytes
+      sleb(0);
+      uleb((uint64_t)(j - i));
+      i = j;
+      continue;
+    }
+    int64_t j = i + 1;
+    while (j < n && ids[j] == ids[i]) j++;
+    if (j - i >= 2) {  // value run
+      sleb(j - i);
+      value(ids[i]);
+      i = j;
+      continue;
+    }
+    // literal run: until a pair of equal values or a null
+    const int64_t lit_start = i;
+    for (;;) {
+      if (j >= n || ids[j] < 0) break;
+      if (ids[j] == ids[j - 1]) {
+        j--;
+        break;
+      }
+      j++;
+    }
+    sleb(-(j - lit_start));
+    for (int64_t k = lit_start; k < j && ok; k++) value(ids[k]);
+    i = j;
+  }
+  return ok ? (long long)w : -1;
+}
+
+// Sorted join: out[i] = position of q[i] in sorted[0..n) if present, else
+// ``missing``. The extraction hot path resolves op-id references (elem /
+// pred targets) against the Lamport-sorted id column with this — binary
+// searches over a cold int64 array are latency-bound, so the query range
+// splits across threads.
+long long am_join_rows_i64(const int64_t* sorted, int64_t n, const int64_t* q,
+                           int64_t m, int32_t missing, int32_t* out) {
+  auto run = [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; i++) {
+      const int64_t key = q[i];
+      int64_t a = 0, b = n;
+      while (a < b) {
+        const int64_t mid = (a + b) >> 1;
+        if (sorted[mid] < key)
+          a = mid + 1;
+        else
+          b = mid;
+      }
+      out[i] = (a < n && sorted[a] == key) ? (int32_t)a : missing;
+    }
+  };
+  const unsigned hw = std::thread::hardware_concurrency();
+  const int64_t nt =
+      m >= 16384 ? (int64_t)(hw > 8 ? 8 : (hw ? hw : 1)) : 1;
+  if (nt <= 1) {
+    run(0, m);
+    return 0;
+  }
+  std::vector<std::thread> ts;
+  const int64_t step = (m + nt - 1) / nt;
+  for (int64_t t = 0; t < nt; t++) {
+    const int64_t lo = t * step, hi = lo + step < m ? lo + step : m;
+    if (lo >= hi) break;
+    ts.emplace_back(run, lo, hi);
+  }
+  for (auto& t : ts) t.join();
+  return 0;
+}
+
+}  // extern "C"

@@ -645,6 +645,46 @@ def merge_columns(cols_np, linearize: str = "auto", fetch=None, n_objs=None):
     ):
         return {"elem_index": host_linearize(cols_np)}
 
+    # Engine selection. The merge has two equivalent engines: the jit
+    # kernel (device) and the O(n) native host merge (merge_cols.cpp).
+    # A remote accelerator behind a thin link is round-trip-bound — ~0.3s
+    # of transport minimum — while the host engine runs ~25ms/M ops, so
+    # below AUTOMERGE_TPU_HOST_MERGE_MAX rows (default 4M, tuned for
+    # tunnel-attached devices; set 0 on PCIe/DMA-attached hosts) the host
+    # engine wins end to end. AUTOMERGE_TPU_ENGINE=jax|native overrides.
+    # The CPU backend keeps the jax path so tests exercise the kernel.
+    engine = os.environ.get("AUTOMERGE_TPU_ENGINE", "auto")
+
+    def _backend_is_accel() -> bool:
+        # decide from the environment when possible: initializing the jax
+        # backend (seconds over a tunnel) just to decide NOT to use it
+        # would defeat the host engine's purpose
+        plat = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip()
+        if plat:
+            return plat != "cpu"
+        return jax.default_backend() != "cpu"
+
+    if (
+        engine != "jax"
+        and linearize in ("auto", "native")
+        and native.merge_available()
+        and (
+            engine == "native"
+            or (
+                len(cols_np["action"])
+                <= int(os.environ.get("AUTOMERGE_TPU_HOST_MERGE_MAX", 1 << 22))
+                and _backend_is_accel()
+            )
+        )
+    ):
+        need = fetch if fetch is not None else ALL_OUTPUTS
+        out = native.merge_cols(
+            cols_np,
+            n_objs if n_objs is not None else len(cols_np["action"]),
+            want_elem_index="elem_index" in need,
+        )
+        return {k: out[k] for k in need}
+
     transport = os.environ.get("AUTOMERGE_TPU_TRANSPORT")
     if transport is None:
         transport = (

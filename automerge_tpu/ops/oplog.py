@@ -327,11 +327,17 @@ class OpLog:
         inv = np.empty(n, np.int32)  # old row -> new row
         inv[order] = np.arange(n, dtype=np.int32)
 
-        def rows_of(keys: np.ndarray, missing: int) -> np.ndarray:
-            pos = np.searchsorted(log.id_key, keys)
-            posc = np.clip(pos, 0, max(n - 1, 0)).astype(np.int32)
-            hit = (log.id_key[posc] == keys) if n else np.zeros(len(keys), bool)
-            return np.where(hit, posc, np.int32(missing)).astype(np.int32)
+        from .. import native
+
+        if native.available():
+            def rows_of(keys: np.ndarray, missing: int) -> np.ndarray:
+                return native.join_rows(log.id_key, keys, missing)
+        else:
+            def rows_of(keys: np.ndarray, missing: int) -> np.ndarray:
+                pos = np.searchsorted(log.id_key, keys)
+                posc = np.clip(pos, 0, max(n - 1, 0)).astype(np.int32)
+                hit = (log.id_key[posc] == keys) if n else np.zeros(len(keys), bool)
+                return np.where(hit, posc, np.int32(missing)).astype(np.int32)
 
         # element references: HEAD=-1, map op=-2, missing=-3
         log.elem_ref = np.where(

@@ -327,6 +327,12 @@ def encode_change_cols_arrays(a) -> List[Tuple[int, bytes]]:
     from .. import native
     from ..utils.codecs import _bool_runs_col, _str_runs_col
 
+    def str_col(ids, table) -> bytes:
+        try:
+            return native.rle_encode_strtab(ids, table)
+        except native.NativeUnavailable:
+            return _str_runs_col(ids, table, RleEncoder("str"))
+
     n = len(a["action"])
     ones = np.ones(n, np.uint8)
     ones_p = np.ones(len(a["pred_ctr"]), np.uint8)
@@ -335,7 +341,7 @@ def encode_change_cols_arrays(a) -> List[Tuple[int, bytes]]:
         (COL_OBJ_CTR, native.rle_encode_array(a["obj_ctr"], a["obj_mask"], False)),
         (COL_KEY_ACTOR, native.rle_encode_array(a["key_actor"], a["key_actor_mask"], False)),
         (COL_KEY_CTR, native.delta_encode_array(a["key_ctr"], a["key_ctr_mask"])),
-        (COL_KEY_STR, _str_runs_col(a["key_str_ids"], a["key_str_table"], RleEncoder("str"))),
+        (COL_KEY_STR, str_col(a["key_str_ids"], a["key_str_table"])),
         (COL_INSERT, native.bool_encode_array(a["insert"])),
         (COL_ACTION, native.rle_encode_array(a["action"], ones, False)),
         (COL_VAL_META, native.rle_encode_array(a["val_meta"], ones, False)),
@@ -344,7 +350,7 @@ def encode_change_cols_arrays(a) -> List[Tuple[int, bytes]]:
         (COL_PRED_ACTOR, native.rle_encode_array(a["pred_actor"], ones_p, False)),
         (COL_PRED_CTR, native.delta_encode_array(a["pred_ctr"], ones_p)),
         (COL_EXPAND, _bool_runs_col(a["expand"], MaybeBooleanEncoder())),
-        (COL_MARK_NAME, _str_runs_col(a["mark_ids"], a["mark_table"], RleEncoder("str"))),
+        (COL_MARK_NAME, str_col(a["mark_ids"], a["mark_table"])),
     ]
 
 
