@@ -84,6 +84,25 @@ def succ_resolution(c):
     return acc[:, 0], acc[:, 1], acc[:, 2]
 
 
+def visibility(c, succ_count, inc_count):
+    """Phase 2: the visibility rule (types.rs:712-744), shared by the
+    single-device kernel and the sharded path (parallel/sharding.py).
+
+    ``covered`` masks ops outside the read clock (all-true for current
+    state)."""
+    action = c["action"]
+    valid = action != PAD_ACTION
+    never = (action == _DELETE) | (action == _INCREMENT) | (action == _MARK)
+    is_counter = (action == _PUT) & (c["value_tag"] == TAG_COUNTER)
+    # counter puts survive increment successors (types.rs:712-720)
+    return (
+        valid
+        & c["covered"]
+        & ~never
+        & jnp.where(is_counter, succ_count == 0, (succ_count + inc_count) == 0)
+    )
+
+
 def resolve_state(c, succ_count, inc_count, counter_inc, obj_cap=None):
     """Phases 2-4: visibility, per-key winners, RGA linearization.
 
@@ -108,19 +127,10 @@ def resolve_state(c, succ_count, inc_count, counter_inc, obj_cap=None):
     obj_dense = c["obj_dense"]
 
     # --- 2. visibility -----------------------------------------------------
-    # ``covered`` masks ops outside the read clock (all-true for current
-    # state); RGA linearization below deliberately ignores it so element
+    # RGA linearization below deliberately ignores ``covered`` so element
     # order — which depends only on the insert forest — is identical across
     # historical views of one log.
-    never = (action == _DELETE) | (action == _INCREMENT) | (action == _MARK)
-    is_counter = (action == _PUT) & (c["value_tag"] == TAG_COUNTER)
-    # counter puts survive increment successors (types.rs:712-720)
-    visible = (
-        valid
-        & c["covered"]
-        & ~never
-        & jnp.where(is_counter, succ_count == 0, (succ_count + inc_count) == 0)
-    )
+    visible = visibility(c, succ_count, inc_count)
 
     # --- 3. per-key winners ------------------------------------------------
     is_map = c["prop"] >= 0

@@ -2,32 +2,58 @@
 
 The reference's "distribution" is logical (actors + the sync protocol,
 reference: rust/automerge/src/sync.rs); its compute is single-threaded. On
-TPU the merge itself scales across chips: the pred stream — the dominant
-data volume, one entry per overwritten/deleted op — is sharded across the
-mesh, every device scatter-adds its slice into full-size succ/inc counter
-arrays, and one ``psum`` over ICI combines them (a segmented all-reduce,
-the collective analogue of the reference's per-op ``add_succ``,
-op_set.rs:194-203). State resolution (winners + RGA linearization) then
-runs replicated on every chip, so the resolved document is immediately
-available device-local for downstream reads on any shard.
+TPU every phase of the merge scales across chips:
 
-Scaling model (How-to-Scale style): succ resolution is memory-bound with
-per-device cost Q/n + one P-sized all-reduce; resolution is O(P log P)
-sort-bound and replicated. For fan-in merges Q ≈ P, so chips shave the
-scatter phase while the all-reduce cost stays flat — the next lever
-(sharding the lexsorts) is a later-round optimization.
+  1. succ resolution — the pred stream is split across the mesh, every
+     device scatter-adds its slice into full-size counter arrays, one
+     ``psum`` over ICI combines them (the collective analogue of the
+     reference's per-op ``add_succ``, op_set.rs:194-203).
+  2. visibility — elementwise, replicated (cheaper than communicating it).
+  3. per-key winners — NO sort: a sequence run's group id is the run-head
+     insert row itself and map groups index a dense (obj x prop) table, so
+     each device scatter-max/adds its ROW SLICE into group-id arrays and
+     one ``pmax``/``psum`` pair merges them. This is what makes the
+     resolution phase itself shard (round-2 sharded only the pred
+     scatter); the sort-based formulation (ops/merge.py resolve_state)
+     remains the fallback when the map-group table would be too large.
+  4. RGA linearization — the sibling forest builds with scatters (first
+     child = max-row child; next sibling = each child pointing its
+     predecessor, derived from one replicated sort kept for adjacency);
+     the pointer-doubling threading + Wyllie ranking loops — the dominant
+     cost on a single chip — run SHARDED: each device advances its node
+     slice and an ``all_gather`` re-replicates state between doubling
+     steps (O(log n) steps, compute per step P/n).
+
+Scaling model (How-to-Scale style): phases 1+3 are scatter-bound with
+per-device cost (Q+P)/n plus P-sized all-reduces; phase 4 is
+gather-latency-bound with per-device cost (P log P)/n plus log P
+all-gathers. All collectives ride the mesh axis (ICI on real chips).
+
+The packed transport (ops/merge.py encode_transport) runs through this
+path too: runs are decoded on device inside the shard_map body, so a
+tunnel-attached multi-chip host ships a few KB per column, not columns.
 """
 
 from __future__ import annotations
 
-from functools import lru_cache
+from functools import lru_cache, partial
 from typing import Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.merge import device_linearize, resolve_state, succ_resolution
+from ..ops.merge import (
+    NONE32,
+    _ceil_log2,
+    _unpack_transport,
+    encode_transport,
+    resolve_state,
+    succ_resolution,
+    visibility,
+)
+from ..ops.oplog import ELEM_HEAD, PAD_ACTION
 
 AXIS = "shard"
 
@@ -47,7 +73,9 @@ def default_mesh(n_devices: Optional[int] = None, devices: Optional[Sequence] = 
 
 
 # column -> partition spec: the pred stream splits along the mesh axis, op
-# columns are replicated (single source of truth for in_specs + device_put)
+# columns are replicated (single source of truth for in_specs + device_put).
+# Row WORK is sharded by slicing inside the body, so replicated columns do
+# not serialize the resolution phases.
 COLUMN_SPECS = {
     "action": P(),
     "insert": P(),
@@ -62,34 +90,321 @@ COLUMN_SPECS = {
     "pred_tgt": P(AXIS),
 }
 
+def _sharded_winners(c, visible, Pl, n_objs2, n_props, G):
+    """Scatter-based per-key winners, row-sliced per device.
 
-def _sharded_merge(c):
-    """shard_map body: sharded pred scatter + psum, replicated resolution."""
+    Group-id space: [0,P) seq runs (run-head row), then per-object
+    HEAD/missing sentinel groups, then the dense (obj x prop) map table,
+    then one trash slot for pad rows. Winner = pmax of per-shard
+    scatter-max of visible global rows; conflicts = psum of counts.
+    """
+    Ptot = c["action"].shape[0]
+    i0 = jax.lax.axis_index(AXIS) * Pl
+
+    def sl(x):
+        return jax.lax.dynamic_slice_in_dim(x, i0, Pl)
+
+    rows_l = i0 + jnp.arange(Pl, dtype=jnp.int32)
+    action_l = sl(c["action"])
+    valid_l = action_l != PAD_ACTION
+    insert_l = sl(c["insert"])
+    elem_l = sl(c["elem_ref"])
+    obj_l = sl(c["obj_dense"])
+    prop_l = sl(c["prop"])
+    vis_l = sl(visible)
+
+    run_l = jnp.where(insert_l, rows_l, elem_l)
+    seq_gid = jnp.where(
+        run_l >= 0,
+        run_l,
+        Ptot + obj_l * 2 + jnp.where(elem_l == ELEM_HEAD, 0, 1),
+    )
+    map_gid = Ptot + 2 * n_objs2 + obj_l * n_props + prop_l
+    gid = jnp.where(prop_l >= 0, map_gid, seq_gid)
+    gid = jnp.where(valid_l, gid, G - 1).astype(jnp.int32)
+
+    win = (
+        jnp.full(G, NONE32, jnp.int32)
+        .at[gid]
+        .max(jnp.where(vis_l, rows_l, NONE32))
+    )
+    cnt = jnp.zeros(G, jnp.int32).at[gid].add(vis_l.astype(jnp.int32))
+    win = jax.lax.pmax(win, AXIS)
+    cnt = jax.lax.psum(cnt, AXIS)
+
+    winner_l = jnp.where(valid_l, win[gid], NONE32)
+    conflicts_l = jnp.where(valid_l, cnt[gid], 0)
+    winner = jax.lax.all_gather(winner_l, AXIS, tiled=True)
+    conflicts = jax.lax.all_gather(conflicts_l, AXIS, tiled=True)
+
+    # per-object stats from the local slice (obj arrays sized P+2 to match
+    # resolve_state's layout)
+    is_elem_l = insert_l & valid_l
+    elem_vis_l = is_elem_l & (winner_l >= 0)
+    w_width_l = jnp.where(
+        elem_vis_l, c["width"][jnp.clip(winner_l, 0, Ptot - 1)], 0
+    )
+    obj_idx_l = jnp.where(valid_l, obj_l, jnp.int32(Ptot + 1))
+    obj_vis_len = jax.lax.psum(
+        jnp.zeros(Ptot + 2, jnp.int32)
+        .at[obj_idx_l]
+        .add(elem_vis_l.astype(jnp.int32)),
+        AXIS,
+    )
+    obj_text_width = jax.lax.psum(
+        jnp.zeros(Ptot + 2, jnp.int32).at[obj_idx_l].add(w_width_l), AXIS
+    )
+    return winner, conflicts, obj_vis_len, obj_text_width
+
+
+def _forest(c):
+    """Sibling forest (parent / first_child / next_sib), replicated.
+
+    first_child is a scatter-max (children order is descending row =
+    descending Lamport, query/insert.rs); next_sib adjacency keeps the one
+    sort — it is a few percent of the single-chip merge (BASELINE.md) and
+    the doubling loops, not this, are what sharding must attack.
+    """
+    Ptot = c["action"].shape[0]
+    rows = jnp.arange(Ptot, dtype=jnp.int32)
+    action = c["action"]
+    valid = action != PAD_ACTION
+    insert = c["insert"]
+    elem_ref = c["elem_ref"]
+    obj_dense = c["obj_dense"]
+    N = 2 * Ptot + 3
+    S = jnp.int32(N - 1)
+    is_elem = insert & valid
+    parent_row = jnp.where(
+        is_elem,
+        jnp.where(
+            elem_ref == ELEM_HEAD,
+            Ptot + obj_dense,
+            jnp.where(elem_ref >= 0, elem_ref, S),
+        ),
+        S,
+    ).astype(jnp.int32)
+    first_child = (
+        jnp.full(N, NONE32, jnp.int32)
+        .at[jnp.where(is_elem, parent_row, N - 1)]
+        .max(jnp.where(is_elem, rows, NONE32))
+    )
+    # adjacency: sort children by (parent, -row); consecutive same-parent
+    # entries give next_sib (descending row within parent)
+    sib_parent = jnp.where(is_elem, parent_row, jnp.int32(N))
+    sp_s, neg_rows = jax.lax.sort((sib_parent, -rows), num_keys=2, is_stable=True)
+    sib_idx = -neg_rows
+    nxt_same = jnp.concatenate([sp_s[1:] == sp_s[:-1], jnp.array([False])])
+    nxt_row = jnp.concatenate([sib_idx[1:], jnp.array([-1], jnp.int32)])
+    in_range = sp_s < N
+    next_sib = (
+        jnp.full(N, NONE32, jnp.int32)
+        .at[jnp.where(in_range, sib_idx, N - 1)]
+        .set(jnp.where(nxt_same & in_range, nxt_row, NONE32))
+    )
+    return is_elem, parent_row, first_child, next_sib
+
+
+def _sharded_linearize(c, is_elem, parent_row, first_child, next_sib, Pl):
+    """Document-order ranking with SHARDED doubling steps.
+
+    Same algorithm as ops/merge.py device_linearize (threaded successors by
+    pointer doubling + Wyllie list ranking) but each device advances only
+    its slice of the state arrays per step and an all_gather re-replicates
+    them — per-step compute drops to P/n gathers, comms is O(P) per step
+    over the mesh axis.
+    """
+    Ptot = c["action"].shape[0]
+    E = Ptot + 1
+    SE = jnp.int32(Ptot)
+    elem_ref = c["elem_ref"]
+    next_sib_e = jnp.concatenate([next_sib[:Ptot], jnp.array([-1], jnp.int32)])
+    fc_e = jnp.concatenate(
+        [jnp.minimum(first_child[:Ptot], SE + 1), jnp.array([-1], jnp.int32)]
+    )
+    fc_e = jnp.where(fc_e > SE, NONE32, fc_e)
+    parent_e = jnp.concatenate(
+        [
+            jnp.where(is_elem & (elem_ref >= 0), elem_ref, SE),
+            jnp.array([Ptot], jnp.int32),
+        ]
+    ).astype(jnp.int32)
+    is_elem_e = jnp.concatenate([is_elem, jnp.array([False])])
+    has_sib = next_sib_e != NONE32
+    done = has_sib | ~is_elem_e | (parent_e == SE)
+    ans = jnp.where(has_sib & is_elem_e, next_sib_e, NONE32)
+    jump = parent_e
+
+    # element-space slices: E = P + 1, so row-slice length Pl would leave
+    # the sentinel uncovered (n*Pl = P < E). Element space gets its own
+    # slice length El = Pl + 1; arrays pad to n*El and padding entries are
+    # fixed points of both loops (done=True / dist=0, nxt=SE), so covering
+    # them is harmless.
+    n_sh = Ptot // Pl
+    El = Pl + 1
+    Epad = n_sh * El
+    i0 = jax.lax.axis_index(AXIS) * El
+
+    def pad_e(x, fill):
+        return jnp.concatenate([x, jnp.full(Epad - E, fill, x.dtype)])
+
+    def sl(x):
+        return jax.lax.dynamic_slice_in_dim(x, i0, El)
+
+    def regather(x_l):
+        return jax.lax.all_gather(x_l, AXIS, tiled=True)
+
+    # thread: resolve next-sibling-of-nearest-ancestor by doubling
+    ansP, doneP, jumpP = pad_e(ans, NONE32), pad_e(done, True), pad_e(jump, SE)
+
+    def _thread(_, st):
+        ansF, doneF, jumpF = st
+        a_l, d_l, j_l = sl(ansF), sl(doneF), sl(jumpF)
+        take = (~d_l) & doneF[j_l]
+        a_l = jnp.where(take, ansF[j_l], a_l)
+        d_l = d_l | take
+        j_l = jumpF[j_l]
+        return regather(a_l), regather(d_l), regather(j_l)
+
+    ansP, doneP, jumpP = jax.lax.fori_loop(
+        0, _ceil_log2(E) + 1, _thread, (ansP, doneP, jumpP)
+    )
+    ans = ansP[:E]
+
+    succ_e = jnp.where(fc_e != NONE32, fc_e, ans)
+    nxt = jnp.where(succ_e < 0, SE, succ_e)
+    nxt = nxt.at[SE].set(SE)
+    dist = jnp.where(jnp.arange(E, dtype=jnp.int32) == SE, 0, 1).astype(jnp.int32)
+    distP, nxtP = pad_e(dist, 0), pad_e(nxt, SE)
+
+    def _rank(_, st):
+        dF, nF = st
+        d_l, n_l = sl(dF), sl(nF)
+        d_l = d_l + dF[n_l]
+        n_l = nF[n_l]
+        return regather(d_l), regather(n_l)
+
+    distP, nxtP = jax.lax.fori_loop(0, _ceil_log2(E) + 1, _rank, (distP, nxtP))
+    dist = distP[:E]
+    rows = jnp.arange(Ptot, dtype=jnp.int32)
+    start = first_child[Ptot + c["obj_dense"]]
+    start_c = jnp.clip(start, 0, Ptot - 1)
+    return jnp.where(
+        is_elem & (start >= 0), dist[start_c] - dist[rows], NONE32
+    )
+
+
+def _sharded_merge(c, Pl, n_objs2, n_props, G, use_scatter):
+    """shard_map body: every phase sharded (see module docstring)."""
     partial_counts = succ_resolution(c)
     succ_count, inc_count, counter_inc = (
         jax.lax.psum(x, AXIS) for x in partial_counts
     )
-    core = resolve_state(c, succ_count, inc_count, counter_inc)
-    core["elem_index"] = device_linearize(c, core)
+    if use_scatter:
+        visible = visibility(c, succ_count, inc_count)
+        winner, conflicts, obj_vis_len, obj_text_width = _sharded_winners(
+            c, visible, Pl, n_objs2, n_props, G
+        )
+        is_elem, parent_row, first_child, next_sib = _forest(c)
+        core = {
+            "visible": visible,
+            "counter_inc": counter_inc,
+            "winner": winner,
+            "conflicts": conflicts,
+            "succ_count": succ_count,
+            "inc_count": inc_count,
+            "first_child": first_child,
+            "next_sib": next_sib,
+            "parent_row": parent_row,
+            "is_elem": is_elem,
+            "obj_vis_len": obj_vis_len,
+            "obj_text_width": obj_text_width,
+        }
+    else:
+        # map-group table too large for the dense gid space: replicated
+        # sort-based resolution (the round-2 shape), sharded scatter only
+        core = resolve_state(c, succ_count, inc_count, counter_inc)
+        is_elem = core["is_elem"]
+        parent_row = core["parent_row"]
+        first_child = core["first_child"]
+        next_sib = core["next_sib"]
+    core["elem_index"] = _sharded_linearize(
+        c, is_elem, parent_row, first_child, next_sib, Pl
+    )
     return core
 
 
 @lru_cache(maxsize=None)
-def make_sharded_merge(mesh: Mesh):
-    """Build a jitted N-chip merge function for ``mesh``.
+def _make_sharded_fn(mesh: Mesh, Ptot: int, n_objs2: int, n_props: int, packed_key):
+    n = mesh.devices.size
+    Pl = Ptot // n
+    n_props_eff = max(n_props, 1)
+    G = Ptot + 2 * n_objs2 + n_objs2 * n_props_eff + 1
+    use_scatter = n_objs2 * n_props_eff <= 8 * Ptot + 65536
+    if not use_scatter:
+        G = Ptot + 1  # unused
 
-    Input: the padded column dict (OpLog.padded_columns). The pred stream
-    is split along the mesh axis; op columns are replicated. Output arrays
-    are replicated (identical on every chip).
-    """
-    in_specs = (dict(COLUMN_SPECS),)
+    if packed_key is None:
+        body = partial(
+            _sharded_merge,
+            Pl=Pl,
+            n_objs2=n_objs2,
+            n_props=n_props_eff,
+            G=G,
+            use_scatter=use_scatter,
+        )
+        # check_vma=False: outputs pass through all_gather, whose
+        # replication the vma checker cannot infer statically (values ARE
+        # identical across shards — asserted by the CPU-mesh equality tests)
+        fn = jax.shard_map(
+            body, mesh=mesh, in_specs=(dict(COLUMN_SPECS),), out_specs=P(),
+            check_vma=False,
+        )
+        return jax.jit(fn)
+
+    # packed transport: runs decoded on device inside the body; the pred
+    # stream is sliced per shard from the expanded columns
+    def packed_body(arrays):
+        cols = _unpack_transport(packed_key[0], arrays, Ptot, packed_key[1])
+        q = packed_key[1]
+        ql = q // n
+        qi = jax.lax.axis_index(AXIS) * ql
+        c = dict(cols)
+        c["pred_src"] = jax.lax.dynamic_slice_in_dim(cols["pred_src"], qi, ql)
+        c["pred_tgt"] = jax.lax.dynamic_slice_in_dim(cols["pred_tgt"], qi, ql)
+        return _sharded_merge(
+            c, Pl=Pl, n_objs2=n_objs2, n_props=n_props_eff, G=G,
+            use_scatter=use_scatter,
+        )
+
     fn = jax.shard_map(
-        _sharded_merge,
-        mesh=mesh,
-        in_specs=in_specs,
-        out_specs=P(),
+        packed_body, mesh=mesh, in_specs=(P(),), out_specs=P(),
+        check_vma=False,
     )
     return jax.jit(fn)
+
+
+def make_sharded_merge(mesh: Mesh, n_objs2: int = None, n_props: int = None):
+    """Build a jitted N-chip merge for ``mesh`` (dict-transport variant).
+
+    Kept for callers that prepare padded columns themselves. Without real
+    ``n_objs2``/``n_props`` geometry the conservative defaults route map
+    groups through the sort-based fallback (a dense table sized from
+    guesses would silently collapse distinct map keys into one group).
+    """
+    n = mesh.devices.size
+
+    def run(cols):
+        P_ = cols["action"].shape[0]
+        if P_ % n:
+            raise ValueError(
+                f"row capacity {P_} must divide evenly over {n} devices"
+            )
+        no2 = n_objs2 if n_objs2 is not None else P_ + 2
+        np_ = n_props if n_props is not None else P_
+        return _make_sharded_fn(mesh, P_, no2, np_, None)(cols)
+
+    return run
 
 
 def _pad_to_multiple(a: np.ndarray, m: int, fill) -> np.ndarray:
@@ -99,23 +414,51 @@ def _pad_to_multiple(a: np.ndarray, m: int, fill) -> np.ndarray:
     return np.concatenate([a, np.full(r, fill, dtype=a.dtype)])
 
 
-def sharded_merge_columns(cols_np, mesh: Optional[Mesh] = None):
+def sharded_merge_columns(
+    cols_np, mesh: Optional[Mesh] = None, n_objs: Optional[int] = None,
+    n_props: Optional[int] = None, transport: str = "dict",
+):
     """Host entry: numpy columns in, numpy resolution out, over ``mesh``.
 
     Arrays are placed with explicit per-column shardings on the mesh's own
     devices — never the process-default backend, which may be a different
     (or unusable) client than the mesh was built over.
+
+    ``n_objs``/``n_props`` (the live object/prop counts, from OpLog) size
+    the dense map-group table; absent, conservative defaults route map
+    groups through the sort-based fallback. ``transport="packed"`` ships
+    slope-RLE runs and decodes on device (the thin-link path).
     """
     mesh = mesh or default_mesh()
     n = mesh.devices.size
     cols_np = dict(cols_np)
-    # the pred stream must split evenly across the mesh axis
     cols_np["pred_src"] = _pad_to_multiple(cols_np["pred_src"], n, 0)
     cols_np["pred_tgt"] = _pad_to_multiple(cols_np["pred_tgt"], n, -1)
-    cols = {
-        k: jax.device_put(v, NamedSharding(mesh, COLUMN_SPECS[k]))
-        for k, v in cols_np.items()
-    }
-    fn = make_sharded_merge(mesh)
-    out = fn(cols)
+    Ptot = len(cols_np["action"])
+    if Ptot % n:
+        raise ValueError(
+            f"row capacity {Ptot} must divide evenly over {n} devices "
+            "(padded_columns capacities are powers of two / 8k multiples)"
+        )
+    n_objs2 = (n_objs + 2) if n_objs is not None else Ptot + 2
+    np_eff = n_props if n_props is not None else Ptot
+
+    if transport == "packed":
+        static_key, arrays = encode_transport(cols_np)
+        fn = _make_sharded_fn(
+            mesh, Ptot, n_objs2, np_eff,
+            (static_key, len(cols_np["pred_src"])),
+        )
+        arrs = {
+            k: jax.device_put(v, NamedSharding(mesh, P()))
+            for k, v in arrays.items()
+        }
+        out = fn(arrs)
+    else:
+        cols = {
+            k: jax.device_put(v, NamedSharding(mesh, COLUMN_SPECS[k]))
+            for k, v in cols_np.items()
+        }
+        fn = _make_sharded_fn(mesh, Ptot, n_objs2, np_eff, None)
+        out = fn(cols)
     return {k: np.asarray(v) for k, v in out.items()}
