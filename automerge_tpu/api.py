@@ -25,8 +25,13 @@ from .types import ActorId, ObjType
 
 
 class AutoDoc:
-    def __init__(self, actor: Optional[ActorId] = None, document: Optional[Document] = None):
-        self.doc = document or Document(actor)
+    def __init__(
+        self,
+        actor: Optional[ActorId] = None,
+        document: Optional[Document] = None,
+        text_encoding: Optional[str] = None,
+    ):
+        self.doc = document or Document(actor, text_encoding=text_encoding)
         self._tx: Optional[Transaction] = None
         self._manual: Optional[Transaction] = None
         self._isolation: Optional[List[bytes]] = None
@@ -384,11 +389,13 @@ class AutoDoc:
         verify: bool = True,
         on_partial: str = "error",
         string_migration: str = "none",
+        text_encoding: Optional[str] = None,
     ) -> "AutoDoc":
         return cls(
             document=Document.load(
                 data, actor, verify,
                 on_partial=on_partial, string_migration=string_migration,
+                text_encoding=text_encoding,
             )
         )
 

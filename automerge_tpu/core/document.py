@@ -89,7 +89,21 @@ class AppliedChange:
 class Document:
     """A CRDT document: nested maps/lists/text/counters with full history."""
 
-    def __init__(self, actor: Optional[ActorId] = None):
+    def __init__(
+        self,
+        actor: Optional[ActorId] = None,
+        text_encoding: Optional[str] = None,
+    ):
+        from ..types import TEXT_ENCODINGS
+
+        if text_encoding is not None and text_encoding not in TEXT_ENCODINGS:
+            raise ValueError(f"unknown text encoding {text_encoding!r}")
+        # the text index unit of THIS document (reference: a per-build
+        # property, text_value.rs:5-15); None = follow the process default.
+        # Activated via a context stack around every width-sensitive entry
+        # point (see _width_ctx below), so documents with different
+        # encodings coexist in one process.
+        self.text_encoding = text_encoding
         self.actor = actor or ActorId()
         self.actors: IndexedCache[ActorId] = IndexedCache()
         self.props: IndexedCache[str] = IndexedCache()
@@ -528,7 +542,7 @@ class Document:
 
     def fork(self, actor: Optional[ActorId] = None) -> "Document":
         self._check_no_pending_tx("fork")
-        doc = Document(actor or ActorId())
+        doc = Document(actor or ActorId(), text_encoding=self.text_encoding)
         doc.apply_changes(c.stored for c in self.history)
         return doc
 
@@ -538,7 +552,7 @@ class Document:
         missing = [h for h in heads if h not in self.history_index]
         if missing:
             raise AutomergeError(f"fork_at: unknown heads {missing}")
-        doc = Document(actor or ActorId())
+        doc = Document(actor or ActorId(), text_encoding=self.text_encoding)
         doc.apply_changes(c.stored for c in self.history if c.hash in keep)
         return doc
 
@@ -1191,16 +1205,19 @@ class Document:
         verify: bool = True,
         on_partial: str = "error",
         string_migration: str = "none",
+        text_encoding: Optional[str] = None,
     ) -> "Document":
         """Strict by default: any malformed chunk rejects the whole load
         (the reference's LoadOptions defaults to OnPartialLoad::Error for
         ``load``; pass on_partial="ignore" to keep the valid prefix —
         automerge.rs:41-47,601-705). ``string_migration="convert_to_text"``
         rewrites scalar strings into TEXT objects after loading
-        (StringMigration, automerge.rs:1567-1610)."""
+        (StringMigration, automerge.rs:1567-1610). ``text_encoding`` fixes
+        the loaded document's text index unit (LoadOptions analogue of the
+        reference's per-build TextValue width)."""
         from .. import trace
 
-        doc = cls(actor)
+        doc = cls(actor, text_encoding=text_encoding)
         with trace.span("load", bytes=len(data)):
             doc.load_incremental(data, verify=verify, on_partial=on_partial)
         if string_migration == "convert_to_text":
@@ -1733,3 +1750,57 @@ def reconstruct_changes(doc: ParsedDocument, verify: bool = True) -> List[Stored
         )
     return changes
 
+
+
+# -- per-document text-encoding activation ------------------------------------
+#
+# Every width-sensitive Document entry point runs under the document's text
+# encoding (reference: the per-build TextValue width, text_value.rs:5-15).
+# Wrapping here — one explicit list — rather than per-def decorators keeps
+# the hot paths branch-free for the default case (text_encoding=None skips
+# the context entirely) and makes the covered surface auditable at a glance.
+# Width math also happens inside Transaction methods; core/transaction.py
+# wraps those the same way.
+
+
+def _width_ctx(fn):
+    import functools
+
+    from ..types import using_text_encoding
+
+    @functools.wraps(fn)
+    def wrapped(self, *args, **kwargs):
+        enc = self.text_encoding
+        if enc is None:
+            return fn(self, *args, **kwargs)
+        with using_text_encoding(enc):
+            return fn(self, *args, **kwargs)
+
+    return wrapped
+
+
+for _name in (
+    "apply_changes",
+    "_materialize_ops",
+    "merge",
+    "length",
+    "text",
+    "_stale_text",
+    "get",
+    "get_all",
+    "keys",
+    "list_items",
+    "map_entries",
+    "values",
+    "parents",
+    "get_cursor",
+    "get_cursor_position",
+    "marks",
+    "diff",
+    "hydrate",
+    "dump",
+    "convert_scalar_strings_to_text",
+    "load_incremental",
+):
+    setattr(Document, _name, _width_ctx(getattr(Document, _name)))
+del _name

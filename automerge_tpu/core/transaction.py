@@ -968,3 +968,51 @@ def _sv_width(v: ScalarValue, enc: int) -> int:
 
         return str_width(v.value)
     return 1
+
+
+# -- per-document text-encoding activation (see core/document.py) -------------
+
+
+def _tx_width_ctx(fn):
+    import functools
+
+    from ..types import using_text_encoding
+
+    @functools.wraps(fn)
+    def wrapped(self, *args, **kwargs):
+        enc = self.doc.text_encoding
+        if enc is None:
+            return fn(self, *args, **kwargs)
+        with using_text_encoding(enc):
+            return fn(self, *args, **kwargs)
+
+    return wrapped
+
+
+for _name in (
+    "put",
+    "put_object",
+    "delete",
+    "increment",
+    "insert",
+    "insert_object",
+    "splice_text",
+    "splice_text_many",
+    "splice",
+    "mark",
+    "unmark",
+    "commit",
+    "rollback",
+    "get",
+    "get_all",
+    "text",
+    "length",
+    "keys",
+    "list_items",
+    "map_entries",
+    "fast_splice_fn",
+    "_drain_all",
+    "session_length",
+):
+    setattr(Transaction, _name, _tx_width_ctx(getattr(Transaction, _name)))
+del _name

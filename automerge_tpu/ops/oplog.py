@@ -367,7 +367,10 @@ class OpLog:
         history, so pending transaction ops would otherwise be silently
         absent (the reference's AutoCommit likewise commits at every
         save/merge/sync boundary, autocommit.rs:582)."""
+        from ..types import using_text_encoding
+
         changes: List[StoredChange] = []
+        encoding = None
         for d in docs:
             commit = getattr(d, "commit", None)
             if commit is not None:
@@ -378,8 +381,14 @@ class OpLog:
                     "document has an open manual transaction; commit or "
                     "roll it back before building a device log"
                 )
+            if encoding is None:
+                encoding = getattr(doc, "text_encoding", None)
             changes.extend(a.stored for a in doc.history)
-        return cls.from_changes(changes)
+        # width columns follow the (first) document's text encoding;
+        # merging documents with conflicting encodings is undefined, as in
+        # the reference where the unit is fixed per build
+        with using_text_encoding(encoding):
+            return cls.from_changes(changes)
 
     # -- device prep -----------------------------------------------------
 

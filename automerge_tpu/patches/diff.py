@@ -371,15 +371,23 @@ def diff_incremental(doc, before, after, new_applied) -> Optional[List[Patch]]:
             if el is None:
                 return None
             elems.append(el)
-        # per-object block position + visible-width prefix (one pass over
-        # the block list, then each element resolves within its block only)
+        # per-object block position + visible-width prefix, scanning only
+        # until every touched element's block has been seen — drain cost
+        # is bounded by the FURTHEST touched block, not the object size
+        need = {id(el.block) for el in elems}
+        if None in (el.block for el in elems):
+            return None
         block_pos = {}
         prefix = {}
         acc = 0
         for i, b in enumerate(data.blocks):
-            block_pos[id(b)] = i
+            bid = id(b)
+            block_pos[bid] = i
             prefix[i] = acc
             acc += b.width if is_text else b.vis
+            need.discard(bid)
+            if not need:
+                break
 
         def doc_order(el):
             b = el.block

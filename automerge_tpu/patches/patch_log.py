@@ -61,7 +61,16 @@ class PatchLog:
     def make_patches(self, doc) -> List[Patch]:
         """Drain: patches covering everything since the cursor (or the whole
         current state when the cursor was never set — the load /
-        current_state case, reference automerge/current_state.rs)."""
+        current_state case, reference automerge/current_state.rs).
+
+        Runs under the document's text encoding: patch indices count in
+        its width unit."""
+        from ..types import using_text_encoding
+
+        with using_text_encoding(getattr(doc, "text_encoding", None)):
+            return self._make_patches(doc)
+
+    def _make_patches(self, doc) -> List[Patch]:
         after = doc.get_heads()
         if not self.active:
             self._advance(doc, after, None)

@@ -155,17 +155,29 @@ def is_make_action(a: int) -> bool:
 # The unit a text index counts in. The reference fixes this per BUILD —
 # chars natively, UTF-16 code units under wasm, UTF-8 bytes behind the
 # utf8-indexing feature (reference: text_value.rs:5-15, types.rs:701-706
-# Op::width) — so a process-level setting is the faithful analogue. It
-# must be chosen before documents are built; changing it mid-document
-# desynchronizes cached width aggregates.
+# Op::width). Here the unit is a DOCUMENT property: Document/AutoDoc take
+# ``text_encoding`` (constructor + load option) and push it onto a context
+# stack around every width-sensitive operation, so documents with
+# different encodings coexist in one process. The process-level setting
+# remains the default for documents that don't choose one; it must then be
+# set before documents are built (changing it under an existing document
+# desynchronizes cached width aggregates).
+
+import contextvars as _contextvars
 
 TEXT_ENCODINGS = ("unicode", "utf8", "utf16")
 _text_encoding = "unicode"
+# innermost active per-document encoding; a ContextVar so threads (the C
+# ABI embedding releases the GIL) and async tasks cannot corrupt each
+# other's width math
+_active_enc: _contextvars.ContextVar = _contextvars.ContextVar(
+    "automerge_tpu_text_encoding", default=None
+)
 
 
 def set_text_encoding(encoding: str) -> None:
-    """Select the text index unit: "unicode" code points (default),
-    "utf8" bytes, or "utf16" code units."""
+    """Select the process-default text index unit: "unicode" code points
+    (default), "utf8" bytes, or "utf16" code units."""
     global _text_encoding
     if encoding not in TEXT_ENCODINGS:
         raise ValueError(f"unknown text encoding {encoding!r}")
@@ -173,14 +185,43 @@ def set_text_encoding(encoding: str) -> None:
 
 
 def get_text_encoding() -> str:
-    return _text_encoding
+    """The ACTIVE text index unit: the innermost document context if one
+    is active, else the process default."""
+    return _active_enc.get() or _text_encoding
+
+
+class using_text_encoding:
+    """Context manager activating ``encoding`` for the dynamic extent of a
+    document operation; ``None`` is a no-op (follow the process default).
+    Re-entrant and cheap — the per-document plumbing in core/document.py
+    wraps every width-sensitive entry point with this."""
+
+    __slots__ = ("_enc", "_token")
+
+    def __init__(self, encoding):
+        if encoding is not None and encoding not in TEXT_ENCODINGS:
+            raise ValueError(f"unknown text encoding {encoding!r}")
+        self._enc = encoding
+        self._token = None
+
+    def __enter__(self):
+        if self._enc is not None:
+            self._token = _active_enc.set(self._enc)
+        return self
+
+    def __exit__(self, *exc):
+        if self._token is not None:
+            _active_enc.reset(self._token)
+            self._token = None
+        return False
 
 
 def str_width(s: str) -> int:
-    """Width of ``s`` in the configured text index unit."""
-    if _text_encoding == "unicode":
+    """Width of ``s`` in the active text index unit."""
+    enc = _active_enc.get() or _text_encoding
+    if enc == "unicode":
         return len(s)
-    if _text_encoding == "utf8":
+    if enc == "utf8":
         return len(s.encode("utf-8"))
     return sum(2 if ord(c) > 0xFFFF else 1 for c in s)
 

@@ -211,3 +211,59 @@ def test_text_width_encodings(restore_encoding):
     assert doc.get(t, 5)[0] == ("scalar", ("str", "b"))
     dev = DeviceDoc.merge([doc])
     assert dev.length(t) == 6
+
+
+def test_per_document_text_encoding_coexists():
+    """Two documents with DIFFERENT width units in one process (reference
+    makes the unit a build/doc property, text_value.rs:5-15): each
+    document's reads, edits, forks and device path count in its own unit,
+    with no process-global flips."""
+    s = "a\U0001f43bb"  # 3 code points, 6 utf-8 bytes, 4 utf-16 units
+
+    du = AutoDoc(actor=ActorId(bytes([1]) * 16), text_encoding="unicode")
+    d8 = AutoDoc(actor=ActorId(bytes([2]) * 16), text_encoding="utf8")
+    d16 = AutoDoc(actor=ActorId(bytes([3]) * 16), text_encoding="utf16")
+    objs = []
+    for d in (du, d8, d16):
+        t = d.put_object("_root", "t", ObjType.TEXT)
+        for ch in s:
+            d.splice_text(t, d.length(t), 0, ch)
+        d.commit()
+        objs.append(t)
+    # interleaved reads: each doc keeps its own unit
+    assert du.length(objs[0]) == 3
+    assert d8.length(objs[1]) == 6
+    assert d16.length(objs[2]) == 4
+    assert d16.get(objs[2], 2)[0] == ("scalar", ("str", "\U0001f43b"))
+    assert d8.get(objs[1], 4)[0] == ("scalar", ("str", "\U0001f43b"))
+    # forks inherit the encoding
+    f16 = d16.fork(actor=ActorId(bytes([9]) * 16))
+    assert f16.doc.text_encoding == "utf16"
+    assert f16.length(objs[2]) == 4
+    # save/load: the load option fixes the unit per loaded doc
+    saved = d16.save()
+    l8 = AutoDoc.load(saved, text_encoding="utf8")
+    l16 = AutoDoc.load(saved, text_encoding="utf16")
+    assert l8.length(objs[2]) == 6
+    assert l16.length(objs[2]) == 4
+    # device path follows the doc's unit
+    dev = DeviceDoc.merge([d16])
+    assert dev.length(objs[2]) == 4
+    dev8 = DeviceDoc.merge([AutoDoc.load(saved, text_encoding="utf8")])
+    assert dev8.length(objs[2]) == 6
+
+
+def test_per_document_encoding_splice_positions():
+    """Splice positions count in the document's unit (utf-16 here), and
+    the bulk-ingest path agrees with the per-edit path."""
+    d = AutoDoc(actor=ActorId(bytes([5]) * 16), text_encoding="utf16")
+    t = d.put_object("_root", "t", ObjType.TEXT)
+    d.splice_text(t, 0, 0, "x\U0001f43by")  # widths 1,2,1
+    d.splice_text(t, 3, 1, "z")  # position 3 = after the bear
+    d.commit()
+    assert d.text(t) == "x\U0001f43bz"
+    b = AutoDoc(actor=ActorId(bytes([6]) * 16), text_encoding="utf16")
+    tb = b.put_object("_root", "t", ObjType.TEXT)
+    b.splice_text_many(tb, [[0, 0, "x\U0001f43by"], [3, 1, "z"]])
+    b.commit()
+    assert b.text(tb) == "x\U0001f43bz"
