@@ -18,7 +18,6 @@ from typing import Optional
 _HERE = os.path.dirname(os.path.abspath(__file__))
 HEADER = os.path.join(_HERE, "am.h")
 _SRC = os.path.join(_HERE, "am_embed.cpp")
-_TEST_SRC = os.path.join(_HERE, "test_am.c")
 _REPO_ROOT = os.path.dirname(os.path.dirname(_HERE))
 
 
@@ -68,17 +67,26 @@ def build(out_dir: Optional[str] = None) -> Optional[str]:
                 pass
 
 
-def build_test(lib_path: str, out_dir: Optional[str] = None) -> Optional[str]:
-    """Compile the C test program against the cdylib; returns its path."""
+TEST_SOURCES = ("test_am.c", "test_basic.c", "test_sync.c")
+
+
+def build_test(
+    lib_path: str, out_dir: Optional[str] = None, source: str = "test_am.c"
+) -> Optional[str]:
+    """Compile one C test program against the cdylib; returns its path."""
     out_dir = out_dir or _HERE
-    exe = os.path.join(out_dir, "test_am")
+    src = os.path.join(_HERE, source)
+    exe = os.path.join(out_dir, os.path.splitext(source)[0])
     cmd = [
-        "gcc", "-O1", "-o", exe, _TEST_SRC,
+        "gcc", "-O1", "-o", exe, src,
         f"-I{_HERE}", lib_path, f"-Wl,-rpath,{os.path.dirname(lib_path)}",
     ]
     try:
         r = subprocess.run(cmd, capture_output=True, timeout=120)
         if r.returncode != 0:
+            import sys
+
+            sys.stderr.write(r.stderr.decode(errors="replace"))
             return None
         return exe
     except (OSError, subprocess.TimeoutExpired):

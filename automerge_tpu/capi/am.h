@@ -97,12 +97,29 @@ AMresult *am_map_put_object(AMdoc *doc, const char *obj, const char *key,
 AMresult *am_map_delete(AMdoc *doc, const char *obj, const char *key);
 AMresult *am_map_increment(AMdoc *doc, const char *obj, const char *key, int64_t by);
 
+AMresult *am_list_put_null(AMdoc *doc, const char *obj, size_t index);
+AMresult *am_list_put_bool(AMdoc *doc, const char *obj, size_t index, int v);
 AMresult *am_list_put_int(AMdoc *doc, const char *obj, size_t index, int64_t v);
+AMresult *am_list_put_uint(AMdoc *doc, const char *obj, size_t index, uint64_t v);
+AMresult *am_list_put_f64(AMdoc *doc, const char *obj, size_t index, double v);
 AMresult *am_list_put_str(AMdoc *doc, const char *obj, size_t index, const char *v);
+AMresult *am_list_put_bytes(AMdoc *doc, const char *obj, size_t index,
+                            const uint8_t *v, size_t len);
+AMresult *am_list_put_counter(AMdoc *doc, const char *obj, size_t index, int64_t v);
+AMresult *am_list_put_timestamp(AMdoc *doc, const char *obj, size_t index, int64_t v);
+AMresult *am_list_put_object(AMdoc *doc, const char *obj, size_t index,
+                             AMobjType t); /* item: OBJ_ID */
 AMresult *am_list_insert_null(AMdoc *doc, const char *obj, size_t index);
+AMresult *am_list_insert_bool(AMdoc *doc, const char *obj, size_t index, int v);
 AMresult *am_list_insert_int(AMdoc *doc, const char *obj, size_t index, int64_t v);
+AMresult *am_list_insert_uint(AMdoc *doc, const char *obj, size_t index, uint64_t v);
+AMresult *am_list_insert_f64(AMdoc *doc, const char *obj, size_t index, double v);
 AMresult *am_list_insert_str(AMdoc *doc, const char *obj, size_t index, const char *v);
+AMresult *am_list_insert_bytes(AMdoc *doc, const char *obj, size_t index,
+                               const uint8_t *v, size_t len);
 AMresult *am_list_insert_counter(AMdoc *doc, const char *obj, size_t index, int64_t v);
+AMresult *am_list_insert_timestamp(AMdoc *doc, const char *obj, size_t index,
+                                   int64_t v);
 AMresult *am_list_insert_object(AMdoc *doc, const char *obj, size_t index,
                                 AMobjType t); /* item: OBJ_ID */
 AMresult *am_list_delete(AMdoc *doc, const char *obj, size_t index);
@@ -119,6 +136,48 @@ AMresult *am_map_get_all(AMdoc *doc, const char *obj, const char *key);
 AMresult *am_list_get(AMdoc *doc, const char *obj, size_t index);
 AMresult *am_keys(AMdoc *doc, const char *obj);   /* items: STR */
 AMresult *am_length(AMdoc *doc, const char *obj); /* item: UINT */
+/* item: UINT AMobjType code */
+AMresult *am_object_type(AMdoc *doc, const char *obj);
+/* one value/OBJ_ID item per visible element */
+AMresult *am_list_items(AMdoc *doc, const char *obj);
+/* per entry: STR key then the value item (2 items each) */
+AMresult *am_map_entries(AMdoc *doc, const char *obj);
+
+/* -- historical reads (*_at) ----------------------------------------------- */
+/* ``heads`` = n_heads concatenated 32-byte change hashes (the bytes of
+ * am_get_heads items back to back) — the reference's *_at read surface
+ * (reference: rust/automerge/src/read.rs parents_at/keys_at/...). */
+AMresult *am_map_get_at(AMdoc *doc, const char *obj, const char *key,
+                        const uint8_t *heads, size_t n_heads);
+AMresult *am_map_get_all_at(AMdoc *doc, const char *obj, const char *key,
+                            const uint8_t *heads, size_t n_heads);
+AMresult *am_list_get_at(AMdoc *doc, const char *obj, size_t index,
+                         const uint8_t *heads, size_t n_heads);
+AMresult *am_keys_at(AMdoc *doc, const char *obj, const uint8_t *heads,
+                     size_t n_heads);
+AMresult *am_length_at(AMdoc *doc, const char *obj, const uint8_t *heads,
+                       size_t n_heads);
+AMresult *am_text_at(AMdoc *doc, const char *obj, const uint8_t *heads,
+                     size_t n_heads);
+AMresult *am_marks_at(AMdoc *doc, const char *obj, const uint8_t *heads,
+                      size_t n_heads);
+/* Fork pinned at historical heads (reference: automerge.rs fork_at). */
+AMdoc *am_fork_at(AMdoc *doc, const uint8_t *heads, size_t n_heads,
+                  const uint8_t *actor, size_t actor_len);
+
+/* -- patches ---------------------------------------------------------------- */
+/* Both return flat 6-item records per patch:
+ *   STR obj exid | STR path ("key/3/sub") | STR kind | STR prop |
+ *   UINT index-or-length | value item (VOID when the kind carries none)
+ * kinds: put_map put_seq insert splice_text del_map del_seq increment
+ * flag_conflict. Insert emits one record per inserted value. Patch value
+ * items carry counter values as INT (the materialized number); read
+ * accessors (am_map_get &c.) are the source of counter-ness. */
+AMresult *am_diff(AMdoc *doc, const uint8_t *before, size_t n_before,
+                  const uint8_t *after, size_t n_after);
+/* Patches since the last pop; the first call activates the observer log
+ * at the current heads and returns an empty result. */
+AMresult *am_pop_patches(AMdoc *doc);
 
 /* -- marks / cursors ------------------------------------------------------- */
 /* expand: "none" | "before" | "after" | "both" (reference ExpandMark). */
@@ -140,6 +199,8 @@ AMresult *am_apply_changes(AMdoc *doc, const uint8_t *data, size_t len);
 /* Change chunks not covered by the given 32-byte head hashes (concatenated
  * AMresult BYTES items from am_get_heads); item: BYTES. */
 AMresult *am_save_incremental(AMdoc *doc, const uint8_t *heads, size_t n_heads);
+/* Raw change chunks not reachable from the given heads; items: BYTES. */
+AMresult *am_get_changes(AMdoc *doc, const uint8_t *heads, size_t n_heads);
 
 /* -- sync ------------------------------------------------------------------ */
 AMsyncState *am_sync_state_new(void);
@@ -147,6 +208,10 @@ void am_sync_state_free(AMsyncState *s);
 AMresult *am_generate_sync_message(AMdoc *doc, AMsyncState *s); /* BYTES or empty */
 AMresult *am_receive_sync_message(AMdoc *doc, AMsyncState *s, const uint8_t *msg,
                                   size_t len);
+/* Persistable sync-state codec (reference: sync/state.rs encode/decode —
+ * only shared_heads survive the roundtrip, by design). */
+AMresult *am_sync_state_encode(AMsyncState *s); /* item: BYTES */
+AMsyncState *am_sync_state_decode(const uint8_t *data, size_t len);
 
 #ifdef __cplusplus
 }

@@ -448,37 +448,66 @@ extern "C" AMresult *am_map_increment(AMdoc *d, const char *o, const char *k,
 
 /* -- list mutation ---------------------------------------------------------*/
 
-extern "C" AMresult *am_list_put_int(AMdoc *d, const char *o, size_t i, int64_t v) {
-  AM_ARGS("(LsniL)", (long long)d->handle, o, (Py_ssize_t)i, AM_VAL_INT, (long long)v);
-  return dispatch("list_put", args);
-}
+/* the full scalar matrix for both list verbs routes through ONE pair of
+ * shim entries (list_put / insert) with a tag + payload, so each wrapper
+ * is a marshalling one-liner — the reference needs a macro forest for the
+ * same surface (automerge-c/src/doc/list.rs) */
+#define AM_LIST_SCALAR(name, verb, tag, fmt, ...)                            \
+  extern "C" AMresult *name {                                                \
+    AM_ARGS("(Lsni" fmt ")", (long long)d->handle, o, (Py_ssize_t)i, tag,    \
+            __VA_ARGS__);                                                    \
+    return dispatch(verb, args);                                             \
+  }
 
-extern "C" AMresult *am_list_put_str(AMdoc *d, const char *o, size_t i, const char *v) {
-  AM_ARGS("(Lsnis)", (long long)d->handle, o, (Py_ssize_t)i, AM_VAL_STR, v ? v : "");
-  return dispatch("list_put", args);
-}
+AM_LIST_SCALAR(am_list_put_null(AMdoc *d, const char *o, size_t i),
+               "list_put", AM_VAL_NULL, "i", 0)
+AM_LIST_SCALAR(am_list_put_bool(AMdoc *d, const char *o, size_t i, int v),
+               "list_put", AM_VAL_BOOL, "i", v ? 1 : 0)
+AM_LIST_SCALAR(am_list_put_int(AMdoc *d, const char *o, size_t i, int64_t v),
+               "list_put", AM_VAL_INT, "L", (long long)v)
+AM_LIST_SCALAR(am_list_put_uint(AMdoc *d, const char *o, size_t i, uint64_t v),
+               "list_put", AM_VAL_UINT, "K", (unsigned long long)v)
+AM_LIST_SCALAR(am_list_put_f64(AMdoc *d, const char *o, size_t i, double v),
+               "list_put", AM_VAL_F64, "d", v)
+AM_LIST_SCALAR(am_list_put_str(AMdoc *d, const char *o, size_t i, const char *v),
+               "list_put", AM_VAL_STR, "s", v ? v : "")
+/* NULL bytes marshal as an empty payload, never None (same hazard the
+ * AM_HEADS macro guards) */
+AM_LIST_SCALAR(am_list_put_bytes(AMdoc *d, const char *o, size_t i,
+                                 const uint8_t *v, size_t len),
+               "list_put", AM_VAL_BYTES, "y#", v ? (const char *)v : "",
+               (Py_ssize_t)(v ? len : 0))
+AM_LIST_SCALAR(am_list_put_counter(AMdoc *d, const char *o, size_t i, int64_t v),
+               "list_put", AM_VAL_COUNTER, "L", (long long)v)
+AM_LIST_SCALAR(am_list_put_timestamp(AMdoc *d, const char *o, size_t i, int64_t v),
+               "list_put", AM_VAL_TIMESTAMP, "L", (long long)v)
 
-extern "C" AMresult *am_list_insert_null(AMdoc *d, const char *o, size_t i) {
-  AM_ARGS("(Lsnii)", (long long)d->handle, o, (Py_ssize_t)i, AM_VAL_NULL, 0);
-  return dispatch("insert", args);
-}
+AM_LIST_SCALAR(am_list_insert_null(AMdoc *d, const char *o, size_t i),
+               "insert", AM_VAL_NULL, "i", 0)
+AM_LIST_SCALAR(am_list_insert_bool(AMdoc *d, const char *o, size_t i, int v),
+               "insert", AM_VAL_BOOL, "i", v ? 1 : 0)
+AM_LIST_SCALAR(am_list_insert_int(AMdoc *d, const char *o, size_t i, int64_t v),
+               "insert", AM_VAL_INT, "L", (long long)v)
+AM_LIST_SCALAR(am_list_insert_uint(AMdoc *d, const char *o, size_t i, uint64_t v),
+               "insert", AM_VAL_UINT, "K", (unsigned long long)v)
+AM_LIST_SCALAR(am_list_insert_f64(AMdoc *d, const char *o, size_t i, double v),
+               "insert", AM_VAL_F64, "d", v)
+AM_LIST_SCALAR(am_list_insert_str(AMdoc *d, const char *o, size_t i, const char *v),
+               "insert", AM_VAL_STR, "s", v ? v : "")
+AM_LIST_SCALAR(am_list_insert_bytes(AMdoc *d, const char *o, size_t i,
+                                    const uint8_t *v, size_t len),
+               "insert", AM_VAL_BYTES, "y#", v ? (const char *)v : "",
+               (Py_ssize_t)(v ? len : 0))
+AM_LIST_SCALAR(am_list_insert_counter(AMdoc *d, const char *o, size_t i, int64_t v),
+               "insert", AM_VAL_COUNTER, "L", (long long)v)
+AM_LIST_SCALAR(am_list_insert_timestamp(AMdoc *d, const char *o, size_t i,
+                                        int64_t v),
+               "insert", AM_VAL_TIMESTAMP, "L", (long long)v)
 
-extern "C" AMresult *am_list_insert_int(AMdoc *d, const char *o, size_t i, int64_t v) {
-  AM_ARGS("(LsniL)", (long long)d->handle, o, (Py_ssize_t)i, AM_VAL_INT, (long long)v);
-  return dispatch("insert", args);
-}
-
-extern "C" AMresult *am_list_insert_str(AMdoc *d, const char *o, size_t i,
-                                        const char *v) {
-  AM_ARGS("(Lsnis)", (long long)d->handle, o, (Py_ssize_t)i, AM_VAL_STR, v ? v : "");
-  return dispatch("insert", args);
-}
-
-extern "C" AMresult *am_list_insert_counter(AMdoc *d, const char *o, size_t i,
-                                            int64_t v) {
-  AM_ARGS("(LsniL)", (long long)d->handle, o, (Py_ssize_t)i, AM_VAL_COUNTER,
-          (long long)v);
-  return dispatch("insert", args);
+extern "C" AMresult *am_list_put_object(AMdoc *d, const char *o, size_t i,
+                                        AMobjType t) {
+  AM_ARGS("(Lsni)", (long long)d->handle, o, (Py_ssize_t)i, (int)t);
+  return dispatch("list_put_object", args);
 }
 
 extern "C" AMresult *am_list_insert_object(AMdoc *d, const char *o, size_t i,
@@ -538,6 +567,103 @@ extern "C" AMresult *am_length(AMdoc *d, const char *o) {
   return dispatch("length", args);
 }
 
+extern "C" AMresult *am_object_type(AMdoc *d, const char *o) {
+  AM_ARGS("(Ls)", (long long)d->handle, o);
+  return dispatch("object_type", args);
+}
+
+extern "C" AMresult *am_list_items(AMdoc *d, const char *o) {
+  AM_ARGS("(Ls)", (long long)d->handle, o);
+  return dispatch("list_items", args);
+}
+
+extern "C" AMresult *am_map_entries(AMdoc *d, const char *o) {
+  AM_ARGS("(Ls)", (long long)d->handle, o);
+  return dispatch("map_entries", args);
+}
+
+/* -- historical reads ------------------------------------------------------*/
+
+/* NULL heads = "no heads": marshal an empty byte string, never a NULL
+ * pointer (Py_BuildValue "y#" would turn NULL into None) */
+#define AM_HEADS(h, n)                                      \
+  (const char *)((h) ? (const char *)(h) : ""),             \
+      (Py_ssize_t)((h) ? (n) * 32 : 0)
+
+extern "C" AMresult *am_map_get_at(AMdoc *d, const char *o, const char *k,
+                                   const uint8_t *heads, size_t n_heads) {
+  AM_ARGS("(Lssy#)", (long long)d->handle, o, k, AM_HEADS(heads, n_heads));
+  return dispatch("get_at", args);
+}
+
+extern "C" AMresult *am_map_get_all_at(AMdoc *d, const char *o, const char *k,
+                                       const uint8_t *heads, size_t n_heads) {
+  AM_ARGS("(Lssy#)", (long long)d->handle, o, k, AM_HEADS(heads, n_heads));
+  return dispatch("get_all_at", args);
+}
+
+extern "C" AMresult *am_list_get_at(AMdoc *d, const char *o, size_t i,
+                                    const uint8_t *heads, size_t n_heads) {
+  AM_ARGS("(Lsny#)", (long long)d->handle, o, (Py_ssize_t)i,
+          AM_HEADS(heads, n_heads));
+  return dispatch("list_get_at", args);
+}
+
+extern "C" AMresult *am_keys_at(AMdoc *d, const char *o, const uint8_t *heads,
+                                size_t n_heads) {
+  AM_ARGS("(Lsy#)", (long long)d->handle, o, AM_HEADS(heads, n_heads));
+  return dispatch("keys_at", args);
+}
+
+extern "C" AMresult *am_length_at(AMdoc *d, const char *o, const uint8_t *heads,
+                                  size_t n_heads) {
+  AM_ARGS("(Lsy#)", (long long)d->handle, o, AM_HEADS(heads, n_heads));
+  return dispatch("length_at", args);
+}
+
+extern "C" AMresult *am_text_at(AMdoc *d, const char *o, const uint8_t *heads,
+                                size_t n_heads) {
+  AM_ARGS("(Lsy#)", (long long)d->handle, o, AM_HEADS(heads, n_heads));
+  return dispatch("text_at", args);
+}
+
+extern "C" AMresult *am_marks_at(AMdoc *d, const char *o, const uint8_t *heads,
+                                 size_t n_heads) {
+  AM_ARGS("(Lsy#)", (long long)d->handle, o, AM_HEADS(heads, n_heads));
+  return dispatch("marks_at", args);
+}
+
+extern "C" AMdoc *am_fork_at(AMdoc *d, const uint8_t *heads, size_t n_heads,
+                             const uint8_t *actor, size_t actor_len) {
+  if (!g_shim) return nullptr;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject *args = Py_BuildValue("(Ly#y#)", (long long)d->handle,
+                                 AM_HEADS(heads, n_heads),
+                                 (const char *)actor, (Py_ssize_t)actor_len);
+  PyGILState_Release(gil);
+  return handle_doc(dispatch("fork_at", args));
+}
+
+/* -- patches ---------------------------------------------------------------*/
+
+extern "C" AMresult *am_diff(AMdoc *d, const uint8_t *before, size_t n_before,
+                             const uint8_t *after, size_t n_after) {
+  AM_ARGS("(Ly#y#)", (long long)d->handle, AM_HEADS(before, n_before),
+          AM_HEADS(after, n_after));
+  return dispatch("diff", args);
+}
+
+extern "C" AMresult *am_pop_patches(AMdoc *d) {
+  AM_ARGS("(L)", (long long)d->handle);
+  return dispatch("pop_patches", args);
+}
+
+extern "C" AMresult *am_get_changes(AMdoc *d, const uint8_t *heads,
+                                    size_t n_heads) {
+  AM_ARGS("(Ly#)", (long long)d->handle, AM_HEADS(heads, n_heads));
+  return dispatch("get_changes", args);
+}
+
 /* -- sync ------------------------------------------------------------------*/
 
 extern "C" AMsyncState *am_sync_state_new(void) {
@@ -560,6 +686,25 @@ extern "C" void am_sync_state_free(AMsyncState *s) {
   AM_ARGS("(L)", (long long)s->handle);
   am_result_free(dispatch("sync_state_free", args));
   delete s;
+}
+
+extern "C" AMresult *am_sync_state_encode(AMsyncState *s) {
+  AM_ARGS("(L)", (long long)s->handle);
+  return dispatch("sync_state_encode", args);
+}
+
+extern "C" AMsyncState *am_sync_state_decode(const uint8_t *data, size_t len) {
+  if (!g_shim) return nullptr;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject *args = Py_BuildValue("(y#)", (const char *)data, (Py_ssize_t)len);
+  PyGILState_Release(gil);
+  AMresult *r = dispatch("sync_state_decode", args);
+  AMsyncState *s = nullptr;
+  if (r->status == AM_STATUS_OK && !r->items.empty()) {
+    s = new AMsyncState{r->items[0].i};
+  }
+  am_result_free(r);
+  return s;
 }
 
 /* -- marks / cursors -------------------------------------------------------*/

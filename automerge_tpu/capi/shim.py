@@ -34,6 +34,7 @@ OBJ_ID = 10
 HANDLE = 11
 
 _OBJTYPE = {0: ObjType.MAP, 1: ObjType.LIST, 2: ObjType.TEXT, 3: ObjType.TABLE}
+_OBJTYPE_CODE = {v: k for k, v in _OBJTYPE.items()}
 
 _docs: Dict[int, AutoDoc] = {}
 _syncs: Dict[int, SyncState] = {}
@@ -250,25 +251,7 @@ def unmark(h: int, obj: str, start: int, end: int, name: str) -> List[Item]:
 
 
 def marks(h: int, obj: str) -> List[Item]:
-    out: List[Item] = []
-    for m in _doc(h).marks(obj):
-        out.append((UINT, m.start))
-        out.append((UINT, m.end))
-        out.append((STR, m.name))
-        v = m.value
-        if isinstance(v, bool):
-            out.append((BOOL, 1 if v else 0))
-        elif isinstance(v, int):
-            out.append((INT, v))
-        elif isinstance(v, float):
-            out.append((F64, v))
-        elif isinstance(v, (bytes, bytearray)):
-            out.append((BYTES, bytes(v)))
-        elif v is None:
-            out.append((NULL, 0))
-        else:
-            out.append((STR, str(v)))
-    return out
+    return _marks_items(_doc(h).marks(obj))
 
 
 def get_cursor(h: int, obj: str, pos: int) -> List[Item]:
@@ -285,10 +268,7 @@ def apply_change_bytes(h: int, data: bytes) -> List[Item]:
 
 
 def save_incremental(h: int, heads_blob: bytes) -> List[Item]:
-    if len(heads_blob) % 32:
-        raise ValueError("heads blob must be a multiple of 32 bytes")
-    heads = [heads_blob[i : i + 32] for i in range(0, len(heads_blob), 32)]
-    return [(BYTES, _doc(h).save_incremental_after(heads))]
+    return [(BYTES, _doc(h).save_incremental_after(_heads(heads_blob)))]
 
 
 def sync_state_new() -> List[Item]:
@@ -310,6 +290,199 @@ def receive_sync_message(h: int, sh: int, data: bytes) -> List[Item]:
 
     _doc(h).receive_sync_message(_syncs[sh], Message.decode(data))
     return []
+
+
+# -- historical reads (*_at) --------------------------------------------------
+#
+# Heads travel as concatenated 32-byte hashes (the am_get_heads item bytes
+# back to back) — the same convention am_save_incremental established.
+
+
+def _heads(blob: bytes) -> List[bytes]:
+    if len(blob) % 32:
+        raise ValueError("heads blob must be a multiple of 32 bytes")
+    return [blob[i : i + 32] for i in range(0, len(blob), 32)]
+
+
+def get_at(h: int, obj: str, key: str, heads: bytes) -> List[Item]:
+    got = _doc(h).get(obj, key, heads=_heads(heads))
+    return _render_item(*got) if got is not None else []
+
+
+def get_all_at(h: int, obj: str, key: str, heads: bytes) -> List[Item]:
+    out: List[Item] = []
+    for rendered, exid in _doc(h).get_all(obj, key, heads=_heads(heads)):
+        out.extend(_render_item(rendered, exid))
+    return out
+
+
+def list_get_at(h: int, obj: str, index: int, heads: bytes) -> List[Item]:
+    got = _doc(h).get(obj, index, heads=_heads(heads))
+    return _render_item(*got) if got is not None else []
+
+
+def keys_at(h: int, obj: str, heads: bytes) -> List[Item]:
+    return [(STR, k) for k in _doc(h).keys(obj, heads=_heads(heads))]
+
+
+def length_at(h: int, obj: str, heads: bytes) -> List[Item]:
+    return [(UINT, _doc(h).length(obj, heads=_heads(heads)))]
+
+
+def text_at(h: int, obj: str, heads: bytes) -> List[Item]:
+    return [(STR, _doc(h).text(obj, heads=_heads(heads)))]
+
+
+def marks_at(h: int, obj: str, heads: bytes) -> List[Item]:
+    return _marks_items(_doc(h).marks(obj, heads=_heads(heads)))
+
+
+def fork_at(h: int, heads: bytes, actor: bytes) -> List[Item]:
+    doc = _doc(h).fork_at(_heads(heads), actor=ActorId(actor) if actor else None)
+    return [(HANDLE, _register(_docs, doc))]
+
+
+# -- richer object/item surface ----------------------------------------------
+
+
+def object_type(h: int, obj: str) -> List[Item]:
+    return [(UINT, _OBJTYPE_CODE[_doc(h).object_type(obj)])]
+
+
+def list_put_object(h: int, obj: str, index: int, objtype: int) -> List[Item]:
+    return [(OBJ_ID, _doc(h).put_object(obj, index, _OBJTYPE[objtype]))]
+
+
+def list_items(h: int, obj: str) -> List[Item]:
+    out: List[Item] = []
+    for rendered, exid in _doc(h).list_items(obj):
+        out.extend(_render_item(rendered, exid))
+    return out
+
+
+def map_entries(h: int, obj: str) -> List[Item]:
+    """Per entry: STR key then the value item (2 items per entry)."""
+    out: List[Item] = []
+    for key, rendered, exid in _doc(h).map_entries(obj):
+        out.append((STR, key))
+        out.extend(_render_item(rendered, exid))
+    return out
+
+
+def get_changes(h: int, heads: bytes) -> List[Item]:
+    return [(BYTES, c.raw_bytes) for c in _doc(h).get_changes(_heads(heads))]
+
+
+# -- patches ------------------------------------------------------------------
+#
+# Each patch flattens to a fixed 6-item record so C callers can walk
+# results without variable framing:
+#   STR obj exid | STR path ("key/3/sub") | STR kind | STR prop |
+#   UINT index-or-length | value item (VOID when the kind carries none)
+# Insert patches emit one record per inserted value (index ascending),
+# matching the reference's per-value patch items.
+
+
+def _patch_records(patches) -> List[Item]:
+    out: List[Item] = []
+
+    def rec(p, kind, prop, index, value_item):
+        path = "/".join(str(k) for _, k in p.path)
+        out.extend(
+            [(STR, p.obj), (STR, path), (STR, kind), (STR, prop), (UINT, index)]
+        )
+        out.append(value_item)
+
+    def val_item(v):
+        if isinstance(v, bool):
+            return (BOOL, 1 if v else 0)
+        if isinstance(v, int):
+            return (INT, v)
+        if isinstance(v, float):
+            return (F64, v)
+        if isinstance(v, (bytes, bytearray)):
+            return (BYTES, bytes(v))
+        if isinstance(v, str):
+            return (STR, v)
+        if v is None:
+            return (NULL, 0)
+        return (STR, str(v))  # hydrated subtree: stringified
+
+    for p in patches:
+        a = p.action
+        k = type(a).__name__
+        if k == "PutMap":
+            rec(p, "put_map", a.key, 0, val_item(a.value))
+        elif k == "PutSeq":
+            rec(p, "put_seq", "", a.index, val_item(a.value))
+        elif k == "Insert":
+            for j, v in enumerate(a.values):
+                rec(p, "insert", "", a.index + j, val_item(v))
+        elif k == "SpliceText":
+            rec(p, "splice_text", "", a.index, (STR, a.value))
+        elif k == "DeleteMap":
+            rec(p, "del_map", a.key, 0, (VOID, 0))
+        elif k == "DeleteSeq":
+            rec(p, "del_seq", "", a.index, (UINT, a.length))
+        elif k == "IncrementPatch":
+            prop = a.prop if isinstance(a.prop, str) else ""
+            idx = a.prop if isinstance(a.prop, int) else 0
+            rec(p, "increment", prop, idx, (INT, a.value))
+        elif k == "FlagConflict":
+            prop = a.prop if isinstance(a.prop, str) else ""
+            idx = a.prop if isinstance(a.prop, int) else 0
+            rec(p, "flag_conflict", prop, idx, (VOID, 0))
+        else:
+            rec(p, k.lower(), "", 0, (VOID, 0))
+    return out
+
+
+def diff(h: int, before: bytes, after: bytes) -> List[Item]:
+    return _patch_records(_doc(h).diff(_heads(before), _heads(after)))
+
+
+def pop_patches(h: int) -> List[Item]:
+    """Drain patches since the last pop (the observer surface from C); the
+    first call activates the log at the current heads."""
+    doc = _doc(h)
+    if not doc.patch_log.is_active():
+        doc.patch_log.set_active(True)
+        doc.patch_log.reset(doc.doc)
+        return []
+    return _patch_records(doc.make_patches())
+
+
+# -- sync state codecs --------------------------------------------------------
+
+
+def sync_state_encode(sh: int) -> List[Item]:
+    return [(BYTES, _syncs[sh].encode())]
+
+
+def sync_state_decode(data: bytes) -> List[Item]:
+    return [(HANDLE, _register(_syncs, SyncState.decode(data)))]
+
+
+def _marks_items(marks_list) -> List[Item]:
+    out: List[Item] = []
+    for m in marks_list:
+        out.append((UINT, m.start))
+        out.append((UINT, m.end))
+        out.append((STR, m.name))
+        v = m.value
+        if isinstance(v, bool):
+            out.append((BOOL, 1 if v else 0))
+        elif isinstance(v, int):
+            out.append((INT, v))
+        elif isinstance(v, float):
+            out.append((F64, v))
+        elif isinstance(v, (bytes, bytearray)):
+            out.append((BYTES, bytes(v)))
+        elif v is None:
+            out.append((NULL, 0))
+        else:
+            out.append((STR, str(v)))
+    return out
 
 
 def call(fn: str, *args) -> List[Item]:

@@ -21,11 +21,12 @@ from automerge_tpu import capi
     shutil.which("g++") is None or shutil.which("gcc") is None,
     reason="no C/C++ toolchain",
 )
-def test_c_abi_end_to_end(tmp_path):
+@pytest.mark.parametrize("source", capi.TEST_SOURCES)
+def test_c_abi_end_to_end(tmp_path, source):
     lib = capi.build()
     assert lib is not None, "cdylib build failed"
-    exe = capi.build_test(lib, str(tmp_path))
-    assert exe is not None, "C test program build failed"
+    exe = capi.build_test(lib, str(tmp_path), source=source)
+    assert exe is not None, f"C test program build failed ({source})"
     env = dict(os.environ)
     # the embedded interpreter must not try to reach the TPU tunnel here
     env["JAX_PLATFORMS"] = "cpu"
