@@ -10,7 +10,7 @@ resolution + visibility masking).
 Reference behavior: aasthaagarwal2003/automerge (see SURVEY.md).
 """
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"
 
 from .api import AutoDoc  # noqa: F401
 from .core.document import AutomergeError, Document, ROOT  # noqa: F401

@@ -393,20 +393,7 @@ def _patch_records(patches) -> List[Item]:
         )
         out.append(value_item)
 
-    def val_item(v):
-        if isinstance(v, bool):
-            return (BOOL, 1 if v else 0)
-        if isinstance(v, int):
-            return (INT, v)
-        if isinstance(v, float):
-            return (F64, v)
-        if isinstance(v, (bytes, bytearray)):
-            return (BYTES, bytes(v))
-        if isinstance(v, str):
-            return (STR, v)
-        if v is None:
-            return (NULL, 0)
-        return (STR, str(v))  # hydrated subtree: stringified
+    val_item = _scalar_item
 
     for p in patches:
         a = p.action
@@ -432,6 +419,12 @@ def _patch_records(patches) -> List[Item]:
             prop = a.prop if isinstance(a.prop, str) else ""
             idx = a.prop if isinstance(a.prop, int) else 0
             rec(p, "flag_conflict", prop, idx, (VOID, 0))
+        elif k == "MarkPatch":
+            # two records per mark: ("mark", name, start, value) then
+            # ("mark_end", name, end, VOID) — keeps the fixed framing
+            for m in a.marks:
+                rec(p, "mark", m.name, m.start, _scalar_item(m.value))
+                rec(p, "mark_end", m.name, m.end, (VOID, 0))
         else:
             rec(p, k.lower(), "", 0, (VOID, 0))
     return out
@@ -463,25 +456,30 @@ def sync_state_decode(data: bytes) -> List[Item]:
     return [(HANDLE, _register(_syncs, SyncState.decode(data)))]
 
 
+def _scalar_item(v) -> Item:
+    """One raw Python value -> item (shared by marks + patch records)."""
+    if isinstance(v, bool):
+        return (BOOL, 1 if v else 0)
+    if isinstance(v, int):
+        return (INT, v)
+    if isinstance(v, float):
+        return (F64, v)
+    if isinstance(v, (bytes, bytearray)):
+        return (BYTES, bytes(v))
+    if isinstance(v, str):
+        return (STR, v)
+    if v is None:
+        return (NULL, 0)
+    return (STR, str(v))  # hydrated subtree: stringified
+
+
 def _marks_items(marks_list) -> List[Item]:
     out: List[Item] = []
     for m in marks_list:
         out.append((UINT, m.start))
         out.append((UINT, m.end))
         out.append((STR, m.name))
-        v = m.value
-        if isinstance(v, bool):
-            out.append((BOOL, 1 if v else 0))
-        elif isinstance(v, int):
-            out.append((INT, v))
-        elif isinstance(v, float):
-            out.append((F64, v))
-        elif isinstance(v, (bytes, bytearray)):
-            out.append((BYTES, bytes(v)))
-        elif v is None:
-            out.append((NULL, 0))
-        else:
-            out.append((STR, str(v)))
+        out.append(_scalar_item(m.value))
     return out
 
 

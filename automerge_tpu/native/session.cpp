@@ -18,6 +18,7 @@
 
 #include <cstddef>
 #include <cstdint>
+#include <algorithm>
 #include <vector>
 
 using std::size_t;
@@ -55,17 +56,85 @@ struct Session {
   // moving cursor: slot whose span starts at cur_at (NONE = unseeded)
   i32 cur = NONE;
   i64 cur_at = 0;
+  // anchor index for random-position seeks (the session analogue of the
+  // host store's block order-statistics index, core/op_store.py): every
+  // kAnchorStride-th visible element with its span start, sorted by
+  // position. Built lazily on the first far seek — sequential typing
+  // (cursor always near) never pays for it — and maintained per splice:
+  // anchors inside a deleted span drop, later anchors shift by the width
+  // delta, and a rebuild re-amortizes after kAnchorRebuild mutations.
+  std::vector<i32> anc_slot;
+  std::vector<i64> anc_at;
+  i64 anc_muts = 0;
+  bool anc_dirty = true;
 };
+
+constexpr i64 kAnchorStride = 512;
+constexpr i64 kAnchorRebuild = 4096;
+
+void anc_rebuild(Session& s) {
+  s.anc_slot.clear();
+  s.anc_at.clear();
+  i64 a = 0, count = 0;
+  for (i32 slot = s.head; slot != NONE; slot = s.elems[slot].next) {
+    if (count % kAnchorStride == 0) {
+      s.anc_slot.push_back(slot);
+      s.anc_at.push_back(a);
+    }
+    a += s.elems[slot].width;
+    count++;
+  }
+  s.anc_muts = 0;
+  s.anc_dirty = false;
+}
+
+// Splice bookkeeping: drop anchors inside the deleted span [pos, pos+del_w),
+// shift anchors at or past the splice point by the width delta.
+void anc_after_splice(Session& s, i64 pos, i64 del_w, i64 ins_w) {
+  if (s.anc_dirty) return;
+  if (++s.anc_muts > kAnchorRebuild) {
+    s.anc_dirty = true;
+    return;
+  }
+  size_t lo = (size_t)(std::lower_bound(s.anc_at.begin(), s.anc_at.end(), pos) -
+                       s.anc_at.begin());
+  size_t hi = (size_t)(std::lower_bound(s.anc_at.begin(), s.anc_at.end(),
+                                        pos + del_w) -
+                       s.anc_at.begin());
+  if (hi > lo) {
+    s.anc_slot.erase(s.anc_slot.begin() + lo, s.anc_slot.begin() + hi);
+    s.anc_at.erase(s.anc_at.begin() + lo, s.anc_at.begin() + hi);
+  }
+  const i64 delta = ins_w - del_w;
+  if (delta)
+    for (size_t i = lo; i < s.anc_at.size(); i++) s.anc_at[i] += delta;
+}
 
 // Find the visible element covering width-position `pos`; returns slot (or
 // NONE past the end) and writes its span start to *at. Walks from the
-// cursor when near, else from the closer end.
+// cursor when near, else from an index anchor, else from the closer end.
 i32 seek(Session& s, i64 pos, i64* at) {
   i32 slot;
   i64 a;
   i64 from_front = pos;
   i64 from_back = s.total_width - pos;
   i64 from_cur = s.cur == NONE ? from_front + 1 : (pos > s.cur_at ? pos - s.cur_at : s.cur_at - pos);
+  i64 best = from_cur < from_front ? from_cur : from_front;
+  if (from_back < best) best = from_back;
+  if (best > 2 * kAnchorStride) {
+    if (s.anc_dirty && s.elems.size() > (size_t)(4 * kAnchorStride))
+      anc_rebuild(s);
+    if (!s.anc_dirty && !s.anc_at.empty()) {
+      size_t idx = (size_t)(std::upper_bound(s.anc_at.begin(), s.anc_at.end(),
+                                             pos) -
+                            s.anc_at.begin());
+      if (idx > 0 && pos - s.anc_at[idx - 1] < best) {
+        slot = s.anc_slot[idx - 1];
+        a = s.anc_at[idx - 1];
+        goto walk;
+      }
+    }
+  }
   if (s.cur != NONE && from_cur <= from_front && from_cur <= from_back) {
     slot = s.cur;
     a = s.cur_at;
@@ -76,6 +145,7 @@ i32 seek(Session& s, i64 pos, i64* at) {
     slot = s.tail;
     a = s.total_width - (s.tail == NONE ? 0 : s.elems[s.tail].width);
   }
+walk:
   // walk backward while pos is before the span
   while (slot != NONE && pos < a) {
     slot = s.elems[slot].prev;
@@ -169,9 +239,15 @@ i64 splice_impl(Session& s, i64 ctr0, i64 pos, i64 ndel, const i32* cps,
 
   // deletes: walk forward from the anchor, unlink each element
   i64 remaining = ndel;
+  i64 del_w = 0;
   i32 cur = anchor == NONE ? s.head : s.elems[anchor].next;
   while (remaining > 0) {
-    if (cur == NONE) return -2;
+    if (cur == NONE) {
+      // elements were already unlinked; the anchor index would otherwise
+      // keep trusting their slots/positions for up to kAnchorRebuild muts
+      s.anc_dirty = true;
+      return -2;
+    }
     SElem& el = s.elems[cur];
     EOp op;
     op.id = (ctr << 20) | s.rank;
@@ -184,6 +260,7 @@ i64 splice_impl(Session& s, i64 ctr0, i64 pos, i64 ndel, const i32* cps,
     ctr++;
     emitted++;
     remaining -= el.width;
+    del_w += el.width;
     s.total_width -= el.width;
     i32 nxt = el.next;
     if (el.prev == NONE)
@@ -200,6 +277,7 @@ i64 splice_impl(Session& s, i64 ctr0, i64 pos, i64 ndel, const i32* cps,
   // inserts: chain after the anchor (ref = previous element id; no marks
   // in session objects, so the sticky-boundary scan reduces to the anchor)
   i32 prev = anchor;
+  i64 ins_w = 0;
   i64 ref = anchor == NONE ? 0 : s.elems[anchor].id;
   for (i64 i = 0; i < ncp; i++) {
     i64 id = (ctr << 20) | s.rank;
@@ -231,8 +309,10 @@ i64 splice_impl(Session& s, i64 ctr0, i64 pos, i64 ndel, const i32* cps,
       s.elems[el.next].prev = slot;
     prev = slot;
     ref = id;
+    ins_w += widths[i];
     s.total_width += widths[i];
   }
+  anc_after_splice(s, pos, del_w, ins_w);
 
   // reseed the cursor at the anchor's (authoritative) span start — the
   // anchor is never deleted by this splice, so both are still valid
