@@ -170,9 +170,14 @@ AMdoc *am_fork_at(AMdoc *doc, const uint8_t *heads, size_t n_heads,
  *   STR obj exid | STR path ("key/3/sub") | STR kind | STR prop |
  *   UINT index-or-length | value item (VOID when the kind carries none)
  * kinds: put_map put_seq insert splice_text del_map del_seq increment
- * flag_conflict. Insert emits one record per inserted value. Patch value
- * items carry counter values as INT (the materialized number); read
- * accessors (am_map_get &c.) are the source of counter-ness. */
+ * flag_conflict mark_clear mark mark_end. Insert emits one record per
+ * inserted value. Mark changes use replace-all framing: one mark_clear
+ * record for the object, then per surviving span a ("mark", name, start,
+ * value) record paired with a ("mark_end", name, end, VOID) record —
+ * replace the object's marks with the set between mark_clear records.
+ * Patch value items carry counter values as INT (the materialized
+ * number); read accessors (am_map_get &c.) are the source of
+ * counter-ness. */
 AMresult *am_diff(AMdoc *doc, const uint8_t *before, size_t n_before,
                   const uint8_t *after, size_t n_after);
 /* Patches since the last pop; the first call activates the observer log

@@ -420,8 +420,12 @@ def _patch_records(patches) -> List[Item]:
             idx = a.prop if isinstance(a.prop, int) else 0
             rec(p, "flag_conflict", prop, idx, (VOID, 0))
         elif k == "MarkPatch":
-            # two records per mark: ("mark", name, start, value) then
-            # ("mark_end", name, end, VOID) — keeps the fixed framing
+            # replace-all framing: one ("mark_clear") record, then two
+            # records per span — ("mark", name, start, value) and
+            # ("mark_end", name, end, VOID). The clear record makes the
+            # empty set (unmark removed the last span) observable and lets
+            # C consumers implement replace-all without extra state.
+            rec(p, "mark_clear", "", 0, (VOID, 0))
             for m in a.marks:
                 rec(p, "mark", m.name, m.start, _scalar_item(m.value))
                 rec(p, "mark_end", m.name, m.end, (VOID, 0))

@@ -27,6 +27,7 @@ from .patch import (
     FlagConflict,
     IncrementPatch,
     Insert,
+    MarkPatch,
     Patch,
     PutMap,
     PutSeq,
@@ -64,8 +65,29 @@ def _diff_obj(doc, obj_id, before, after, patches, path):
         _diff_map(doc, obj_id, exid, info.data, before, after, patches, path)
     elif info.data.obj_type == ObjType.TEXT:
         _diff_text(doc, obj_id, exid, info.data, before, after, patches, path)
+        _diff_marks(doc, exid, info.data, before, after, patches, path)
     else:
         _diff_list(doc, obj_id, exid, info.data, before, after, patches, path)
+        _diff_marks(doc, exid, info.data, before, after, patches, path)
+
+
+def _diff_marks(doc, exid, data, before, after, patches, path):
+    """Emit a MarkPatch when the resolved mark spans differ between the two
+    clocks (reference: diff.rs MarkDiff). Replace-all semantics: the patch
+    carries the FULL after-state span set for the object; consumers
+    replace its marks wholesale. Span positions shift with plain text
+    edits inside marked ranges, so this compares resolved spans, not mark
+    ops. Skipped wholesale for never-marked objects (block mark counts)."""
+    if not any(b.marks for b in data.blocks):
+        return
+    mb = doc.marks(exid, clock=before)
+    ma = doc.marks(exid, clock=after)
+
+    def key(ms):
+        return [(m.start, m.end, m.name, m.value) for m in ms]
+
+    if key(mb) != key(ma):
+        patches.append(Patch(exid, list(path), MarkPatch(list(ma))))
 
 
 def _diff_map_key(doc, exid, key, run, before, after, patches, path):
@@ -279,8 +301,10 @@ def diff_incremental(doc, before, after, new_applied) -> Optional[List[Patch]]:
 
     # 1. touched (object -> keys/elements) from the new changes' ops,
     #    using each change's stored actor translation table
+    _ACTION_MARK = 7
     touched_map: dict = {}  # obj_id -> set of prop names
     touched_seq: dict = {}  # obj_id -> set of element OpIds
+    touched_mark_ops: set = set()  # objects with new mark/unmark ops
     for applied in new_applied:
         ch = applied.stored
         amap = applied.actor_map
@@ -294,6 +318,8 @@ def diff_incremental(doc, before, after, new_applied) -> Optional[List[Patch]]:
             if cop.key.prop is not None:
                 touched_map.setdefault(obj, set()).add(cop.key.prop)
                 continue
+            if cop.action == _ACTION_MARK:
+                touched_mark_ops.add(obj)
             if cop.insert:
                 elem = (ch.start_op + i, author)
             else:
@@ -418,6 +444,7 @@ def diff_incremental(doc, before, after, new_applied) -> Optional[List[Patch]]:
             if is_text
             else _ListEmitter(doc, exid, path, before, after, patches)
         )
+        min_idx = None
         for _, el in keyed:
             wb = el.winner(before)
             wa = el.winner(after)
@@ -426,9 +453,35 @@ def diff_incremental(doc, before, after, new_applied) -> Optional[List[Patch]]:
             idx = pos_of(el)
             if idx is None:
                 return None
+            if min_idx is None or idx < min_idx:
+                min_idx = idx
             # NOTE: unlike the full walk, do NOT recurse into an unchanged
             # child winner — a touched child diffs via its own entry, and
             # recursing here would emit its patches twice
             em.visit(el, wb, wa, idx)
         em._flush()
+        # Mark spans can only change when (a) mark/unmark ops touched the
+        # object, or (b) an edit landed at or before the marked region
+        # (positions shift; expand grows at boundaries). The bound — the
+        # width prefix through one block past the last block holding mark
+        # ops — costs O(#blocks); edits past it skip the O(object) span
+        # resolution, preserving the drain's O(edit) asymptotics.
+        if obj_id in touched_mark_ops:
+            _diff_marks(doc, exid, data, before, after, patches, path)
+        else:
+            blocks = data.blocks
+            last_marked = -1
+            for bi, b in enumerate(blocks):
+                if b.marks:
+                    last_marked = bi
+            if last_marked >= 0 and min_idx is not None:
+                # width prefix through one block past the last marked one
+                # (the slack covers expand-at-boundary growth)
+                upto = min(last_marked + 1, len(blocks) - 1)
+                bound = sum(
+                    blocks[bi].width if is_text else blocks[bi].vis
+                    for bi in range(upto + 1)
+                )
+                if min_idx <= bound:
+                    _diff_marks(doc, exid, data, before, after, patches, path)
     return patches

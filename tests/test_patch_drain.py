@@ -220,3 +220,165 @@ def test_drain_scales_with_edit_not_doc():
     dt_full = time.perf_counter() - t0
     # 50 incremental drains must beat ONE full walk with real margin
     assert dt_inc * 2 < dt_full, (dt_inc, dt_full)
+
+
+def test_mark_patches_emitted_and_equivalent():
+    """Mark changes reach observers (reference: diff.rs MarkDiff) with
+    replace-all span semantics, identically from the full walk and the
+    incremental drain — including position shifts from plain text edits
+    inside marked ranges."""
+    from automerge_tpu.patches.patch import MarkPatch
+
+    d = AutoDoc(actor=actor(1))
+    t = d.put_object("_root", "t", ObjType.TEXT)
+    d.splice_text(t, 0, 0, "styled text here")
+    d.commit()
+    before_heads = d.get_heads()
+    before_len = len(d.doc.history)
+    d.mark(t, 0, 6, "bold", True)
+    d.commit()
+    full = diff(d.doc, before_heads, d.get_heads())
+    inc = diff_incremental(
+        d.doc, d.doc.clock_at(before_heads), d.doc.clock_at(d.get_heads()),
+        d.doc.history[before_len:],
+    )
+    assert inc is not None
+    fm = [p for p in full if isinstance(p.action, MarkPatch)]
+    im = [p for p in inc if isinstance(p.action, MarkPatch)]
+    assert len(fm) == len(im) == 1
+    spans = [(m.start, m.end, m.name, m.value) for m in fm[0].action.marks]
+    assert spans == [(0, 6, "bold", True)]
+    assert spans == [(m.start, m.end, m.name, m.value) for m in im[0].action.marks]
+
+    # a plain edit inside the marked range shifts the span -> new MarkPatch
+    before_heads = d.get_heads()
+    before_len = len(d.doc.history)
+    d.splice_text(t, 2, 0, "XX")
+    d.commit()
+    inc2 = diff_incremental(
+        d.doc, d.doc.clock_at(before_heads), d.doc.clock_at(d.get_heads()),
+        d.doc.history[before_len:],
+    )
+    full2 = diff(d.doc, before_heads, d.get_heads())
+    im2 = [p for p in inc2 if isinstance(p.action, MarkPatch)]
+    fm2 = [p for p in full2 if isinstance(p.action, MarkPatch)]
+    assert len(im2) == len(fm2) == 1
+    assert [(m.start, m.end) for m in im2[0].action.marks] == [(0, 8)]
+
+    # unmark clears -> MarkPatch with an empty span set
+    before_heads = d.get_heads()
+    before_len = len(d.doc.history)
+    d.unmark(t, 0, 8, "bold")
+    d.commit()
+    inc3 = diff_incremental(
+        d.doc, d.doc.clock_at(before_heads), d.doc.clock_at(d.get_heads()),
+        d.doc.history[before_len:],
+    )
+    im3 = [p for p in inc3 if isinstance(p.action, MarkPatch)]
+    assert len(im3) == 1 and im3[0].action.marks == []
+
+    # observer route delivers mark records through the C shim encoding
+    from automerge_tpu.capi import shim
+    h = shim.call("create", b"\x07" * 16)[0][1]
+    doc2 = shim._docs[h]
+    t2 = doc2.put_object("_root", "t", ObjType.TEXT)
+    doc2.splice_text(t2, 0, 0, "abc")
+    doc2.commit()
+    shim.call("pop_patches", h)  # activate
+    doc2.mark(t2, 0, 2, "em", True)
+    doc2.commit()
+    items = shim.call("pop_patches", h)
+    kinds = [items[i + 2][1] for i in range(0, len(items), 6)]
+    assert "mark" in kinds and "mark_end" in kinds
+    shim.call("free", h)
+
+
+def test_list_mark_patches_and_clear_records():
+    """Marks on LIST objects reach the diff (review find) and the C-record
+    framing carries a mark_clear so an emptied set is observable."""
+    from automerge_tpu.capi import shim
+    from automerge_tpu.patches.patch import MarkPatch
+
+    d = AutoDoc(actor=actor(1))
+    lst = d.put_object("_root", "l", ObjType.LIST)
+    for i in range(5):
+        d.insert(lst, i, i)
+    d.commit()
+    before_heads = d.get_heads()
+    before_len = len(d.doc.history)
+    d.mark(lst, 0, 3, "sel", True)
+    d.commit()
+    full = diff(d.doc, before_heads, d.get_heads())
+    inc = diff_incremental(
+        d.doc, d.doc.clock_at(before_heads), d.doc.clock_at(d.get_heads()),
+        d.doc.history[before_len:],
+    )
+    fm = [p for p in full if isinstance(p.action, MarkPatch)]
+    im = [p for p in inc if isinstance(p.action, MarkPatch)]
+    assert len(fm) == len(im) == 1
+    assert [(m.start, m.end) for m in fm[0].action.marks] == [(0, 3)]
+
+    # shim framing: clear record + span pair; after unmark: clear alone
+    h = shim.call("create", b"\x08" * 16)[0][1]
+    doc2 = shim._docs[h]
+    t2 = doc2.put_object("_root", "t", ObjType.TEXT)
+    doc2.splice_text(t2, 0, 0, "abc")
+    doc2.commit()
+    shim.call("pop_patches", h)
+    doc2.mark(t2, 0, 2, "em", True)
+    doc2.commit()
+    items = shim.call("pop_patches", h)
+    kinds = [items[i + 2][1] for i in range(0, len(items), 6)]
+    assert kinds == ["mark_clear", "mark", "mark_end"]
+    doc2.unmark(t2, 0, 2, "em")
+    doc2.commit()
+    items = shim.call("pop_patches", h)
+    kinds = [items[i + 2][1] for i in range(0, len(items), 6)]
+    assert kinds == ["mark_clear"]
+    shim.call("free", h)
+
+
+def test_marked_doc_drain_still_scales():
+    """A single mark near the front must not force O(object) span
+    resolution for edits far past it (the block-bound pre-check)."""
+    import automerge_tpu.patches.diff as DF
+
+    d = AutoDoc(actor=actor(1))
+    t = d.put_object("_root", "t", ObjType.TEXT)
+    d.splice_text_many(t, [[i, 0, "x"] for i in range(40_000)])
+    d.commit()
+    d.mark(t, 0, 50, "bold", True)
+    d.commit()
+    d.patch_log.set_active(True)
+    d.patch_log.reset(d.doc)
+
+    calls = 0
+    real = DF.calculate_marks if hasattr(DF, "calculate_marks") else None
+    from automerge_tpu.core import marks as M
+
+    real_calc = M.calculate_marks
+
+    def counting(*a, **k):
+        nonlocal calls
+        calls += 1
+        return real_calc(*a, **k)
+
+    M.calculate_marks = counting
+    try:
+        # edits far beyond the marked prefix: no span resolution
+        for i in range(5):
+            d.splice_text(t, 30_000 + i, 0, "y")
+            d.commit()
+            d.make_patches()
+        far_calls = calls
+        # an edit inside the marked range DOES resolve spans
+        d.splice_text(t, 10, 0, "z")
+        d.commit()
+        ps = d.make_patches()
+    finally:
+        M.calculate_marks = real_calc
+    assert far_calls == 0, far_calls
+    assert calls > 0
+    from automerge_tpu.patches.patch import MarkPatch
+
+    assert any(isinstance(p.action, MarkPatch) for p in ps)

@@ -1,0 +1,38 @@
+"""Dot dumps of document structure (the analogue of the reference's
+optree-visualisation feature, visualisation.rs / op_set.rs:265-285)."""
+
+from automerge_tpu.api import AutoDoc
+from automerge_tpu.types import ActorId, ObjType
+from automerge_tpu.visualisation import changes_to_dot, doc_to_dot
+
+
+def test_doc_to_dot_renders_objects_and_tombstones():
+    d = AutoDoc(actor=ActorId(bytes([1]) * 16))
+    t = d.put_object("_root", "t", ObjType.TEXT)
+    d.splice_text(t, 0, 0, "hi")
+    d.splice_text(t, 0, 1, "")  # tombstone
+    d.put("_root", "k", 1)
+    d.commit()
+    dot = doc_to_dot(d)
+    assert dot.startswith("digraph automerge")
+    assert "tombstone" in dot
+    assert "'i'" in dot and "k = int 1" in dot
+    assert dot.count("subgraph") == 2  # root + text
+
+
+def test_changes_to_dot_renders_dag():
+    d = AutoDoc(actor=ActorId(bytes([1]) * 16))
+    d.put("_root", "a", 1)
+    d.commit()
+    f = d.fork(actor=ActorId(bytes([2]) * 16))
+    d.put("_root", "b", 2)
+    d.commit()
+    f.put("_root", "c", 3)
+    f.commit()
+    d.merge(f)
+    dot = changes_to_dot(d)
+    assert dot.startswith("digraph changes")
+    # 3 changes, 2 dep edges, 2 heads highlighted
+    assert dot.count("seq") == 3
+    assert dot.count("->") == 2
+    assert dot.count("palegreen") == 2
