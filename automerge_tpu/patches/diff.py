@@ -301,7 +301,8 @@ def diff_incremental(doc, before, after, new_applied) -> Optional[List[Patch]]:
 
     # 1. touched (object -> keys/elements) from the new changes' ops,
     #    using each change's stored actor translation table
-    _ACTION_MARK = 7
+    from ..types import Action
+
     touched_map: dict = {}  # obj_id -> set of prop names
     touched_seq: dict = {}  # obj_id -> set of element OpIds
     touched_mark_ops: set = set()  # objects with new mark/unmark ops
@@ -318,7 +319,7 @@ def diff_incremental(doc, before, after, new_applied) -> Optional[List[Patch]]:
             if cop.key.prop is not None:
                 touched_map.setdefault(obj, set()).add(cop.key.prop)
                 continue
-            if cop.action == _ACTION_MARK:
+            if cop.action == Action.MARK:
                 touched_mark_ops.add(obj)
             if cop.insert:
                 elem = (ch.start_op + i, author)

@@ -1,0 +1,352 @@
+"""Line-delimited JSON-RPC frontend over stdio: the second embedding
+boundary.
+
+The reference ships two FFI frontends: a C API and a wasm-bindgen module
+whose role is to let ANOTHER language runtime (JS) drive documents through
+a narrow marshalled surface (reference: rust/automerge-wasm/src/lib.rs:102-
+1083 — the ~80-method Automerge class). This frontend plays that role for
+any language with a subprocess + JSON: one request per line on stdin, one
+response per line on stdout.
+
+Protocol:
+    -> {"id": 1, "method": "create", "params": {"actor": "<hex>"}}
+    <- {"id": 1, "result": {"doc": 1}}
+    -> {"id": 2, "method": "spliceText",
+        "params": {"doc": 1, "obj": "1@..", "pos": 0, "del": 0, "text": "hi"}}
+    <- {"id": 2, "result": null}
+Errors come back as {"id": n, "error": {"type": "...", "message": "..."}}
+and never kill the server. Bytes (saves, changes, sync messages, hashes)
+travel base64. Values are JSON-native with two wrappers for types JSON
+cannot express: {"$counter": n}, {"$timestamp": ms}, {"$bytes": "<b64>"};
+object creation returns {"$obj": "<exid>", "type": "map|list|text"}.
+
+Run: ``python -m automerge_tpu.rpc`` (see tests/test_rpc.py for a full
+two-peer session driven from a separate process).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import sys
+from typing import Dict, Optional
+
+from .api import AutoDoc
+from .sync import SyncState
+from .types import ActorId, ObjType, ScalarValue
+
+_OBJTYPES = {"map": ObjType.MAP, "list": ObjType.LIST, "text": ObjType.TEXT,
+             "table": ObjType.TABLE}
+
+
+def _b64(b: bytes) -> str:
+    return base64.b64encode(b).decode("ascii")
+
+
+def _unb64(s: str) -> bytes:
+    return base64.b64decode(s)
+
+
+def _to_scalar(v) -> ScalarValue:
+    """JSON value -> ScalarValue (wrappers for counter/timestamp/bytes)."""
+    if isinstance(v, dict):
+        if "$counter" in v:
+            return ScalarValue("counter", int(v["$counter"]))
+        if "$timestamp" in v:
+            return ScalarValue("timestamp", int(v["$timestamp"]))
+        if "$bytes" in v:
+            return ScalarValue("bytes", _unb64(v["$bytes"]))
+        raise ValueError(f"unsupported value wrapper {sorted(v)}")
+    if v is None:
+        return ScalarValue("null")
+    if isinstance(v, bool):
+        return ScalarValue("bool", v)
+    if isinstance(v, int):
+        return ScalarValue("int", v)
+    if isinstance(v, float):
+        return ScalarValue("f64", v)
+    if isinstance(v, str):
+        return ScalarValue("str", v)
+    raise ValueError(f"unsupported value type {type(v).__name__}")
+
+
+def _from_rendered(rendered, exid, doc) -> object:
+    """(kind, payload) from doc.get/get_all -> JSON value."""
+    kind = rendered[0]
+    if kind == "obj":
+        t = doc.object_type(exid)
+        return {"$obj": exid, "type": t.name.lower()}
+    if kind == "counter":
+        return {"$counter": int(rendered[1])}
+    sv = rendered[1]
+    if sv.tag == "bytes":
+        return {"$bytes": _b64(sv.value)}
+    if sv.tag == "timestamp":
+        return {"$timestamp": int(sv.value)}
+    if sv.tag == "counter":
+        return {"$counter": int(sv.value)}
+    if sv.tag == "null":
+        return None
+    if sv.tag == "unknown":
+        return {"$bytes": _b64(bytes(sv.value[1]))}
+    return sv.value
+
+
+class RpcServer:
+    """One frontend session: documents + sync states by integer handle."""
+
+    def __init__(self):
+        self._docs: Dict[int, AutoDoc] = {}
+        self._syncs: Dict[int, SyncState] = {}
+        self._next = 1
+
+    # -- handle plumbing ----------------------------------------------------
+
+    def _reg(self, table, value) -> int:
+        h = self._next
+        self._next += 1
+        table[h] = value
+        return h
+
+    def _doc(self, p) -> AutoDoc:
+        doc = self._docs.get(p["doc"])
+        if doc is None:
+            raise ValueError(f"invalid doc handle {p.get('doc')}")
+        return doc
+
+    def _heads(self, p, key="heads"):
+        hs = p.get(key)
+        return None if hs is None else [_unb64(h) for h in hs]
+
+    # -- methods (wasm lib.rs surface, JSON-shaped) -------------------------
+
+    def create(self, p):
+        actor = bytes.fromhex(p["actor"]) if p.get("actor") else None
+        doc = AutoDoc(
+            actor=ActorId(actor) if actor else None,
+            text_encoding=p.get("textEncoding"),
+        )
+        return {"doc": self._reg(self._docs, doc)}
+
+    def load(self, p):
+        doc = AutoDoc.load(
+            _unb64(p["data"]), text_encoding=p.get("textEncoding")
+        )
+        return {"doc": self._reg(self._docs, doc)}
+
+    def free(self, p):
+        self._docs.pop(p["doc"], None)
+        return None
+
+    def fork(self, p):
+        doc = self._doc(p)
+        actor = bytes.fromhex(p["actor"]) if p.get("actor") else None
+        heads = self._heads(p)
+        forked = (
+            doc.fork_at(heads, actor=ActorId(actor) if actor else None)
+            if heads is not None
+            else doc.fork(actor=ActorId(actor) if actor else None)
+        )
+        return {"doc": self._reg(self._docs, forked)}
+
+    def actor(self, p):
+        return self._doc(p).get_actor().bytes.hex()
+
+    def heads(self, p):
+        return [_b64(h) for h in self._doc(p).get_heads()]
+
+    def commit(self, p):
+        h = self._doc(p).commit(message=p.get("message"))
+        return _b64(h) if h is not None else None
+
+    def save(self, p):
+        return _b64(self._doc(p).save())
+
+    def saveIncremental(self, p):
+        return _b64(self._doc(p).save_incremental_after(self._heads(p) or []))
+
+    def applyChanges(self, p):
+        self._doc(p).load_incremental(_unb64(p["data"]), on_partial="error")
+        return None
+
+    def merge(self, p):
+        return [_b64(h) for h in self._doc(p).merge(self._docs[p["other"]])]
+
+    # mutation
+    def put(self, p):
+        self._doc(p).put(p["obj"], p["prop"], _to_scalar(p["value"]))
+        return None
+
+    def putObject(self, p):
+        exid = self._doc(p).put_object(p["obj"], p["prop"], _OBJTYPES[p["type"]])
+        return {"$obj": exid, "type": p["type"]}
+
+    def insert(self, p):
+        self._doc(p).insert(p["obj"], p["index"], _to_scalar(p["value"]))
+        return None
+
+    def insertObject(self, p):
+        exid = self._doc(p).insert_object(p["obj"], p["index"], _OBJTYPES[p["type"]])
+        return {"$obj": exid, "type": p["type"]}
+
+    def delete(self, p):
+        self._doc(p).delete(p["obj"], p.get("prop", p.get("index")))
+        return None
+
+    def increment(self, p):
+        self._doc(p).increment(p["obj"], p.get("prop", p.get("index")), p["by"])
+        return None
+
+    def spliceText(self, p):
+        self._doc(p).splice_text(p["obj"], p["pos"], p.get("del", 0), p.get("text", ""))
+        return None
+
+    def mark(self, p):
+        self._doc(p).mark(
+            p["obj"], p["start"], p["end"], p["name"], p["value"],
+            expand=p.get("expand", "after"),
+        )
+        return None
+
+    def unmark(self, p):
+        self._doc(p).unmark(p["obj"], p["start"], p["end"], p["name"])
+        return None
+
+    # reads (all honor optional historical heads)
+    def get(self, p):
+        doc = self._doc(p)
+        got = doc.get(p["obj"], p.get("prop", p.get("index")), heads=self._heads(p))
+        return None if got is None else _from_rendered(got[0], got[1], doc)
+
+    def getAll(self, p):
+        doc = self._doc(p)
+        return [
+            _from_rendered(r, e, doc)
+            for r, e in doc.get_all(p["obj"], p.get("prop", p.get("index")),
+                                    heads=self._heads(p))
+        ]
+
+    def keys(self, p):
+        return self._doc(p).keys(p["obj"], heads=self._heads(p))
+
+    def length(self, p):
+        return self._doc(p).length(p["obj"], heads=self._heads(p))
+
+    def text(self, p):
+        return self._doc(p).text(p["obj"], heads=self._heads(p))
+
+    def marks(self, p):
+        return [
+            {"start": m.start, "end": m.end, "name": m.name, "value": m.value}
+            for m in self._doc(p).marks(p["obj"], heads=self._heads(p))
+        ]
+
+    def getCursor(self, p):
+        return self._doc(p).get_cursor(p["obj"], p["pos"], heads=self._heads(p))
+
+    def getCursorPosition(self, p):
+        return self._doc(p).get_cursor_position(
+            p["obj"], p["cursor"], heads=self._heads(p)
+        )
+
+    def materialize(self, p):
+        return self._doc(p).hydrate(p.get("obj", "_root"), heads=self._heads(p))
+
+    # patches
+    def popPatches(self, p):
+        doc = self._doc(p)
+        if not doc.patch_log.is_active():
+            doc.patch_log.set_active(True)
+            doc.patch_log.reset(doc.doc)
+            return []
+        return [self._patch_json(x) for x in doc.make_patches()]
+
+    @staticmethod
+    def _patch_json(patch) -> dict:
+        a = patch.action
+        d = {"obj": patch.obj, "path": [list(pe) for pe in patch.path],
+             "action": type(a).__name__}
+        for f in getattr(a, "__dataclass_fields__", {}):
+            v = getattr(a, f)
+            if f == "marks":
+                v = [
+                    {"start": m.start, "end": m.end, "name": m.name,
+                     "value": m.value}
+                    for m in v
+                ]
+            d[f] = v
+        return d
+
+    # sync
+    def syncStateNew(self, p):
+        return {"sync": self._reg(self._syncs, SyncState())}
+
+    def syncStateFree(self, p):
+        self._syncs.pop(p["sync"], None)
+        return None
+
+    def syncStateEncode(self, p):
+        return _b64(self._syncs[p["sync"]].encode())
+
+    def syncStateDecode(self, p):
+        return {"sync": self._reg(self._syncs, SyncState.decode(_unb64(p["data"])))}
+
+    def generateSyncMessage(self, p):
+        msg = self._doc(p).generate_sync_message(self._syncs[p["sync"]])
+        return None if msg is None else _b64(msg.encode())
+
+    def receiveSyncMessage(self, p):
+        from .sync.protocol import Message
+
+        self._doc(p).receive_sync_message(
+            self._syncs[p["sync"]], Message.decode(_unb64(p["data"]))
+        )
+        return None
+
+    # -- dispatch -----------------------------------------------------------
+
+    def handle(self, req: dict) -> dict:
+        rid = req.get("id")
+        method = req.get("method", "")
+        fn = getattr(self, method, None)
+        if fn is None or method.startswith("_") or method == "handle":
+            return {"id": rid, "error": {"type": "UnknownMethod",
+                                         "message": method}}
+        try:
+            return {"id": rid, "result": fn(req.get("params") or {})}
+        except Exception as e:  # errors answer the request, never kill us
+            return {
+                "id": rid,
+                "error": {"type": type(e).__name__, "message": str(e)},
+            }
+
+    def serve(self, stdin=None, stdout=None) -> None:
+        stdin = stdin or sys.stdin
+        stdout = stdout or sys.stdout
+        for line in stdin:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                req = json.loads(line)
+            except json.JSONDecodeError as e:
+                resp = {"id": None,
+                        "error": {"type": "ParseError", "message": str(e)}}
+            else:
+                if req.get("method") == "shutdown":
+                    stdout.write(json.dumps({"id": req.get("id"),
+                                             "result": None}) + "\n")
+                    stdout.flush()
+                    return
+                resp = self.handle(req)
+            stdout.write(json.dumps(resp) + "\n")
+            stdout.flush()
+
+
+def main() -> int:
+    RpcServer().serve()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,7 +19,7 @@ from __future__ import annotations
 
 from typing import List
 
-from .core.op_store import MapObject, ROOT_OBJ
+from .core.op_store import MapObject
 
 
 def _esc(s: str) -> str:
@@ -64,13 +64,23 @@ def doc_to_dot(doc) -> str:
                         f'style="{style}"{fill}];'
                     )
         else:
+            from .types import Action
+
             prev = None
             for el in info.data.elements():
                 eid = d.export_id(el.elem_id)
                 w = el.winner()
-                label = _value_label(w) if w is not None else "(tombstone)"
-                style = "filled" if w is not None else "dashed"
-                fill = ', fillcolor="lightyellow"' if w is not None else ""
+                if w is not None:
+                    label, style, fill = (
+                        _value_label(w), "filled", ', fillcolor="lightyellow"'
+                    )
+                elif el.op is not None and el.op.action == Action.MARK:
+                    name = el.op.mark_name or "(end)"
+                    label, style, fill = (
+                        f"mark {name}", "dotted", ', fillcolor="mistyrose"'
+                    )
+                else:
+                    label, style, fill = "(tombstone)", "dashed", ""
                 lines.append(
                     f'    "{_esc(eid)}" [label="{_esc(label)}\\n{_esc(eid)}", '
                     f'style="{style}"{fill}];'
@@ -79,13 +89,6 @@ def doc_to_dot(doc) -> str:
                     lines.append(f'    "{_esc(prev)}" -> "{_esc(eid)}";')
                 prev = eid
         lines.append("  }")
-        # containment edge from the holding object
-        if obj_id != ROOT_OBJ:
-            parent_ex = d.export_id(info.parent)
-            lines.append(
-                f'  "{_esc(parent_ex)}__obj" -> "{_esc(exid)}__obj" '
-                "[style=invis];"
-            )
     lines.append("}")
     return "\n".join(lines)
 

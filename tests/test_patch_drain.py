@@ -341,8 +341,6 @@ def test_list_mark_patches_and_clear_records():
 def test_marked_doc_drain_still_scales():
     """A single mark near the front must not force O(object) span
     resolution for edits far past it (the block-bound pre-check)."""
-    import automerge_tpu.patches.diff as DF
-
     d = AutoDoc(actor=actor(1))
     t = d.put_object("_root", "t", ObjType.TEXT)
     d.splice_text_many(t, [[i, 0, "x"] for i in range(40_000)])
@@ -353,7 +351,6 @@ def test_marked_doc_drain_still_scales():
     d.patch_log.reset(d.doc)
 
     calls = 0
-    real = DF.calculate_marks if hasattr(DF, "calculate_marks") else None
     from automerge_tpu.core import marks as M
 
     real_calc = M.calculate_marks

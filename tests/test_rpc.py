@@ -1,0 +1,138 @@
+"""The JSON-RPC stdio frontend (the wasm-module analogue: an embedding
+boundary another language runtime drives through marshalled calls,
+reference: rust/automerge-wasm/src/lib.rs).
+
+Two layers of tests: in-process RpcServer dispatch (fast, covers the
+method surface + error shape) and a real subprocess session driving two
+peers to convergence over the wire — the frontend as an actual separate
+process, as an embedder would run it.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from automerge_tpu.rpc import RpcServer
+
+
+def call(srv, method, **params):
+    resp = srv.handle({"id": 1, "method": method, "params": params})
+    assert "error" not in resp, resp
+    return resp["result"]
+
+
+def test_inprocess_document_surface():
+    srv = RpcServer()
+    d = call(srv, "create", actor="01" * 16)["doc"]
+    t = call(srv, "putObject", doc=d, obj="_root", prop="t", type="text")["$obj"]
+    call(srv, "spliceText", doc=d, obj=t, pos=0, text="hello")
+    call(srv, "put", doc=d, obj="_root", prop="n", value={"$counter": 5})
+    call(srv, "put", doc=d, obj="_root", prop="b", value={"$bytes": "AAEC"})
+    lst = call(srv, "putObject", doc=d, obj="_root", prop="l", type="list")["$obj"]
+    call(srv, "insert", doc=d, obj=lst, index=0, value=1)
+    call(srv, "insert", doc=d, obj=lst, index=1, value="two")
+    h1 = call(srv, "commit", doc=d)
+    assert h1
+    heads1 = call(srv, "heads", doc=d)
+
+    call(srv, "increment", doc=d, obj="_root", prop="n", by=2)
+    call(srv, "spliceText", doc=d, obj=t, pos=5, text=" world")
+    call(srv, "mark", doc=d, obj=t, start=0, end=5, name="bold", value=True)
+    call(srv, "commit", doc=d)
+
+    assert call(srv, "text", doc=d, obj=t) == "hello world"
+    assert call(srv, "get", doc=d, obj="_root", prop="n") == {"$counter": 7}
+    assert call(srv, "get", doc=d, obj="_root", prop="b") == {"$bytes": "AAEC"}
+    assert call(srv, "length", doc=d, obj=lst) == 2
+    assert call(srv, "keys", doc=d, obj="_root") == ["b", "l", "n", "t"]
+    assert call(srv, "marks", doc=d, obj=t) == [
+        {"start": 0, "end": 5, "name": "bold", "value": True}
+    ]
+    # historical reads + fork at heads
+    assert call(srv, "text", doc=d, obj=t, heads=heads1) == "hello"
+    assert call(srv, "get", doc=d, obj="_root", prop="n", heads=heads1) == {
+        "$counter": 5
+    }
+    old = call(srv, "fork", doc=d, heads=heads1)["doc"]
+    assert call(srv, "text", doc=old, obj=t) == "hello"
+    # materialize
+    m = call(srv, "materialize", doc=d)
+    assert m["t"] == "hello world" and m["l"] == [1, "two"]
+    # save / load roundtrip
+    data = call(srv, "save", doc=d)
+    d2 = call(srv, "load", data=data)["doc"]
+    assert call(srv, "text", doc=d2, obj=t) == "hello world"
+    # errors answer, never raise
+    resp = srv.handle({"id": 9, "method": "get", "params": {"doc": 999, "obj": "_root", "prop": "x"}})
+    assert resp["error"]["type"] == "ValueError"
+    resp = srv.handle({"id": 10, "method": "nope", "params": {}})
+    assert resp["error"]["type"] == "UnknownMethod"
+
+
+def test_inprocess_patches_and_sync():
+    srv = RpcServer()
+    a = call(srv, "create", actor="01" * 16)["doc"]
+    b = call(srv, "create", actor="02" * 16)["doc"]
+    t = call(srv, "putObject", doc=a, obj="_root", prop="t", type="text")["$obj"]
+    call(srv, "spliceText", doc=a, obj=t, pos=0, text="sync me")
+    call(srv, "commit", doc=a)
+
+    assert call(srv, "popPatches", doc=b) == []  # activates
+    sa = call(srv, "syncStateNew")["sync"]
+    sb = call(srv, "syncStateNew")["sync"]
+    for _ in range(20):
+        ma = call(srv, "generateSyncMessage", doc=a, sync=sa)
+        mb = call(srv, "generateSyncMessage", doc=b, sync=sb)
+        if ma is None and mb is None:
+            break
+        if ma is not None:
+            call(srv, "receiveSyncMessage", doc=b, sync=sb, data=ma)
+        if mb is not None:
+            call(srv, "receiveSyncMessage", doc=a, sync=sa, data=mb)
+    assert call(srv, "heads", doc=a) == call(srv, "heads", doc=b)
+    patches = call(srv, "popPatches", doc=b)
+    assert any(p["action"] == "PutMap" for p in patches)
+    # sync state survives encode/decode
+    enc = call(srv, "syncStateEncode", sync=sa)
+    sa2 = call(srv, "syncStateDecode", data=enc)["sync"]
+    assert call(srv, "generateSyncMessage", doc=a, sync=sa2) is not None
+
+
+@pytest.mark.skipif(os.name != "posix", reason="subprocess stdio test")
+def test_subprocess_two_peer_session():
+    """Drive the frontend as a real separate process, like an embedder."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "automerge_tpu.rpc"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    rid = [0]
+
+    def rpc(method, **params):
+        rid[0] += 1
+        proc.stdin.write(json.dumps({"id": rid[0], "method": method, "params": params}) + "\n")
+        proc.stdin.flush()
+        resp = json.loads(proc.stdout.readline())
+        assert resp["id"] == rid[0]
+        assert "error" not in resp, resp
+        return resp["result"]
+
+    try:
+        a = rpc("create", actor="0a" * 16)["doc"]
+        t = rpc("putObject", doc=a, obj="_root", prop="t", type="text")["$obj"]
+        rpc("spliceText", doc=a, obj=t, pos=0, text="over the wire")
+        rpc("commit", doc=a)
+        saved = rpc("save", doc=a)
+        b = rpc("load", data=saved)["doc"]
+        rpc("spliceText", doc=b, obj=t, pos=0, text=">> ")
+        rpc("commit", doc=b)
+        rpc("merge", doc=a, other=b)
+        assert rpc("text", doc=a, obj=t) == ">> over the wire"
+        rpc("shutdown")
+    finally:
+        proc.stdin.close()
+        assert proc.wait(timeout=60) == 0
