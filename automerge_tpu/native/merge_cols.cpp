@@ -313,15 +313,36 @@ long long am_rle_encode_strtab(const int64_t* ids, int64_t n,
 
 // Sorted join: out[i] = position of q[i] in sorted[0..n) if present, else
 // ``missing``. The extraction hot path resolves op-id references (elem /
-// pred targets) against the Lamport-sorted id column with this — binary
-// searches over a cold int64 array are latency-bound, so the query range
-// splits across threads.
+// pred targets) against the Lamport-sorted id column with this. Packed op
+// ids (counter << ACTOR_BITS | rank) are near-uniform over their value
+// range in real logs, so a few interpolation probes narrow the window
+// before the binary search — ~3-4 memory touches instead of log2(n) on a
+// cold array. Degenerate distributions just fall through to binary
+// search over the narrowed (or full) window. The query range splits
+// across threads when the host has them.
 long long am_join_rows_i64(const int64_t* sorted, int64_t n, const int64_t* q,
                            int64_t m, int32_t missing, int32_t* out) {
   auto run = [&](int64_t lo, int64_t hi) {
     for (int64_t i = lo; i < hi; i++) {
       const int64_t key = q[i];
       int64_t a = 0, b = n;
+      // interpolation steps keep the lower_bound invariant (answer in
+      // [a, b]): p is clamped into [a, b-1], then the same narrowing rule
+      // as the binary step applies. ~1.7x over plain binary here
+      // (lockstep-prefetch and branchless variants measured WORSE on this
+      // host — see round-3 notes).
+      for (int probe = 0; probe < 4 && b - a > 64; probe++) {
+        const int64_t va = sorted[a], vb = sorted[b - 1];
+        if (vb <= va || key <= va || key >= vb) break;
+        int64_t p = a + (int64_t)((double)(key - va) / (double)(vb - va) *
+                                  (double)(b - 1 - a));
+        if (p < a) p = a;
+        if (p > b - 1) p = b - 1;
+        if (sorted[p] < key)
+          a = p + 1;
+        else
+          b = p;
+      }
       while (a < b) {
         const int64_t mid = (a + b) >> 1;
         if (sorted[mid] < key)

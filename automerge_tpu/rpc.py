@@ -250,6 +250,10 @@ class RpcServer:
         )
 
     def materialize(self, p):
+        """Plain-JSON projection of the (sub)tree, like the wasm module's
+        materialize: counters and timestamps flatten to numbers (JSON has
+        no such types; ``get``/``getAll`` are the typed surface), bytes
+        serialize as the {"$bytes"} wrapper."""
         return self._doc(p).hydrate(p.get("obj", "_root"), heads=self._heads(p))
 
     # patches
@@ -305,20 +309,50 @@ class RpcServer:
 
     # -- dispatch -----------------------------------------------------------
 
+    # explicit allowlist: getattr dispatch must never reach serve/handle or
+    # any other non-API callable
+    METHODS = frozenset({
+        "create", "load", "free", "fork", "actor", "heads", "commit",
+        "save", "saveIncremental", "applyChanges", "merge",
+        "put", "putObject", "insert", "insertObject", "delete", "increment",
+        "spliceText", "mark", "unmark",
+        "get", "getAll", "keys", "length", "text", "marks",
+        "getCursor", "getCursorPosition", "materialize", "popPatches",
+        "syncStateNew", "syncStateFree", "syncStateEncode",
+        "syncStateDecode", "generateSyncMessage", "receiveSyncMessage",
+    })
+
     def handle(self, req: dict) -> dict:
         rid = req.get("id")
         method = req.get("method", "")
-        fn = getattr(self, method, None)
-        if fn is None or method.startswith("_") or method == "handle":
+        if method not in self.METHODS:
             return {"id": rid, "error": {"type": "UnknownMethod",
-                                         "message": method}}
+                                         "message": str(method)}}
         try:
-            return {"id": rid, "result": fn(req.get("params") or {})}
+            return {"id": rid, "result": getattr(self, method)(req.get("params") or {})}
         except Exception as e:  # errors answer the request, never kill us
             return {
                 "id": rid,
                 "error": {"type": type(e).__name__, "message": str(e)},
             }
+
+    @staticmethod
+    def _json_default(v):
+        # stray raw bytes (mark values, hydrated bytes scalars, patch
+        # payloads) serialize as the documented wrapper instead of killing
+        # the server
+        if isinstance(v, (bytes, bytearray)):
+            return {"$bytes": _b64(bytes(v))}
+        raise TypeError(f"unserializable value of type {type(v).__name__}")
+
+    def _encode_response(self, resp: dict) -> str:
+        try:
+            return json.dumps(resp, default=self._json_default)
+        except Exception as e:
+            return json.dumps({
+                "id": resp.get("id"),
+                "error": {"type": "EncodeError", "message": str(e)},
+            })
 
     def serve(self, stdin=None, stdout=None) -> None:
         stdin = stdin or sys.stdin
@@ -330,16 +364,22 @@ class RpcServer:
             try:
                 req = json.loads(line)
             except json.JSONDecodeError as e:
+                req = None
                 resp = {"id": None,
                         "error": {"type": "ParseError", "message": str(e)}}
             else:
-                if req.get("method") == "shutdown":
-                    stdout.write(json.dumps({"id": req.get("id"),
-                                             "result": None}) + "\n")
+                if not isinstance(req, dict):
+                    resp = {"id": None, "error": {
+                        "type": "ParseError",
+                        "message": "request must be a JSON object"}}
+                elif req.get("method") == "shutdown":
+                    stdout.write(self._encode_response(
+                        {"id": req.get("id"), "result": None}) + "\n")
                     stdout.flush()
                     return
-                resp = self.handle(req)
-            stdout.write(json.dumps(resp) + "\n")
+                else:
+                    resp = self.handle(req)
+            stdout.write(self._encode_response(resp) + "\n")
             stdout.flush()
 
 

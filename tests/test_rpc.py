@@ -136,3 +136,41 @@ def test_subprocess_two_peer_session():
     finally:
         proc.stdin.close()
         assert proc.wait(timeout=60) == 0
+
+
+def test_server_survives_hostile_and_binary_inputs():
+    """Review regressions: non-object JSON, non-API method names, and raw
+    bytes in responses must answer with errors/wrappers, never kill the
+    loop."""
+    import io
+
+    srv = RpcServer()
+    d = call(srv, "create", actor="01" * 16)["doc"]
+    call(srv, "put", doc=d, obj="_root", prop="b", value={"$bytes": "AAEC"})
+    t = call(srv, "putObject", doc=d, obj="_root", prop="t", type="text")["$obj"]
+    call(srv, "spliceText", doc=d, obj=t, pos=0, text="xy")
+    call(srv, "mark", doc=d, obj=t, start=0, end=2, name="blob", value=True)
+    call(srv, "commit", doc=d)
+
+    lines = [
+        "123",                                    # valid JSON, not an object
+        "[1,2]",
+        "not json at all",
+        json.dumps({"id": 1, "method": "serve", "params": {"x": 1}}),
+        json.dumps({"id": 2, "method": "handle", "params": {}}),
+        json.dumps({"id": 3, "method": "_doc", "params": {}}),
+        json.dumps({"id": 4, "method": "materialize", "params": {"doc": d}}),
+        json.dumps({"id": 5, "method": "marks", "params": {"doc": d, "obj": t}}),
+        json.dumps({"id": 6, "method": "shutdown"}),
+    ]
+    out = io.StringIO()
+    srv.serve(stdin=iter([ln + "\n" for ln in lines]), stdout=out)
+    resps = [json.loads(x) for x in out.getvalue().splitlines()]
+    assert len(resps) == len(lines)
+    assert all("error" in r for r in resps[:3])
+    assert resps[3]["error"]["type"] == "UnknownMethod"   # serve not callable
+    assert resps[4]["error"]["type"] == "UnknownMethod"
+    assert resps[5]["error"]["type"] == "UnknownMethod"
+    assert resps[6]["result"]["b"] == {"$bytes": "AAEC"}  # bytes wrapped
+    assert resps[7]["result"][0]["name"] == "blob"
+    assert resps[8]["result"] is None                     # clean shutdown
