@@ -120,7 +120,8 @@ class DeviceDoc:
         return cls(
             log,
             merge_columns(
-                log.padded_columns(), fetch=cls.READ_FETCH, n_objs=log.n_objs
+                log.padded_columns(), fetch=cls.READ_FETCH, n_objs=log.n_objs,
+                n_props=len(log.props),
             ),
         )
 
@@ -168,6 +169,7 @@ class DeviceDoc:
                 base.log.padded_columns(covered=covered),
                 fetch=self.VIEW_FETCH,
                 n_objs=base.log.n_objs,
+                n_props=len(base.log.props),
             )
             view = DeviceDoc(base.log, res, covered=covered, base=base)
             base._views[key] = view

@@ -331,6 +331,153 @@ def merge_kernel_core(c):
     return resolve_state(c, *succ_resolution(c))
 
 
+# -- scatter-based resolution -------------------------------------------------
+#
+# The sort-free winner formulation (a sequence run's group id is its
+# run-head row; map groups index a dense obj x prop table) measured ~1.45x
+# faster than the sort-based resolve_state on a v5e at the 1024-replica
+# fan-in (32.5ms vs 47ms for 376k ops), with bit-identical outputs. It
+# needs static group-table geometry (n_objs, n_props from the OpLog), so
+# callers that have it get this kernel and the sort path remains both the
+# fallback and the geometry-free default. Same gate as the native host
+# engine and the sharded path: the dense table must stay O(P)-ish.
+
+
+def scatter_geom_key(n_objs: int, n_props: int):
+    """Pow2-bucketed (n_objs2, n_props) geometry: a growing document must
+    reuse compiled kernels (one per capacity bucket, like obj_cap/P), and a
+    larger group table changes nothing — the gid mapping stays injective
+    and every output is per-row or fixed-size."""
+    return (_next_pow2(max(n_objs + 2, 16)), _next_pow2(max(n_props, 1)))
+
+
+def scatter_geometry_ok(P: int, n_objs: int, n_props: int) -> bool:
+    # evaluated on the BUCKETED geometry (scatter_geom_key) so the gate
+    # bounds the actual compiled table, not the pre-bucket request
+    n_objs2, np_eff = scatter_geom_key(n_objs, n_props)
+    return n_objs2 * np_eff <= 8 * P + 65536
+
+
+def forest(c):
+    """Sibling forest (parent / first_child / next_sib), shared by the
+    scatter kernel and the sharded path (parallel/sharding.py).
+
+    first_child is a scatter-max (children order is descending row =
+    descending Lamport, query/insert.rs); next_sib adjacency keeps one
+    sort — a few percent of the merge."""
+    P = c["action"].shape[0]
+    rows = jnp.arange(P, dtype=jnp.int32)
+    valid = c["action"] != PAD_ACTION
+    insert = c["insert"]
+    elem_ref = c["elem_ref"]
+    obj_dense = c["obj_dense"]
+    N = 2 * P + 3
+    S = jnp.int32(N - 1)
+    is_elem = insert & valid
+    parent_row = jnp.where(
+        is_elem,
+        jnp.where(
+            elem_ref == ELEM_HEAD,
+            P + obj_dense,
+            jnp.where(elem_ref >= 0, elem_ref, S),
+        ),
+        S,
+    ).astype(jnp.int32)
+    first_child = (
+        jnp.full(N, NONE32, jnp.int32)
+        .at[jnp.where(is_elem, parent_row, N - 1)]
+        .max(jnp.where(is_elem, rows, NONE32))
+    )
+    sib_parent = jnp.where(is_elem, parent_row, jnp.int32(N))
+    sp_s, neg_rows = jax.lax.sort((sib_parent, -rows), num_keys=2, is_stable=True)
+    sib_idx = -neg_rows
+    nxt_same = jnp.concatenate([sp_s[1:] == sp_s[:-1], jnp.array([False])])
+    nxt_row = jnp.concatenate([sib_idx[1:], jnp.array([-1], jnp.int32)])
+    in_range = sp_s < N
+    next_sib = (
+        jnp.full(N, NONE32, jnp.int32)
+        .at[jnp.where(in_range, sib_idx, N - 1)]
+        .set(jnp.where(nxt_same & in_range, nxt_row, NONE32))
+    )
+    return is_elem, parent_row, first_child, next_sib
+
+
+def resolve_state_scatter(c, succ_count, inc_count, counter_inc,
+                          n_objs2: int, n_props: int):
+    """Sort-free resolve_state: same output dict, winners via scatter-max/
+    scatter-add over dense group ids."""
+    P = c["action"].shape[0]
+    G = P + 2 * n_objs2 + n_objs2 * n_props + 1
+    rows = jnp.arange(P, dtype=jnp.int32)
+    action = c["action"]
+    valid = action != PAD_ACTION
+    insert = c["insert"]
+    elem_ref = c["elem_ref"]
+    obj_dense = c["obj_dense"]
+    prop = c["prop"]
+    visible = visibility(c, succ_count, inc_count)
+
+    run = jnp.where(insert, rows, elem_ref)
+    seq_gid = jnp.where(
+        run >= 0,
+        run,
+        P + obj_dense * 2 + jnp.where(elem_ref == ELEM_HEAD, 0, 1),
+    )
+    map_gid = P + 2 * n_objs2 + obj_dense * n_props + prop
+    gid = jnp.where(prop >= 0, map_gid, seq_gid)
+    gid = jnp.where(valid, gid, G - 1).astype(jnp.int32)
+    win = (
+        jnp.full(G, NONE32, jnp.int32)
+        .at[gid]
+        .max(jnp.where(visible, rows, NONE32))
+    )
+    cnt = jnp.zeros(G, jnp.int32).at[gid].add(visible.astype(jnp.int32))
+    winner = jnp.where(valid, win[gid], NONE32)
+    conflicts = jnp.where(valid, cnt[gid], 0)
+
+    is_elem, parent_row, first_child, next_sib = forest(c)
+    core = {
+        "visible": visible,
+        "counter_inc": counter_inc,
+        "winner": winner,
+        "conflicts": conflicts,
+        "succ_count": succ_count,
+        "inc_count": inc_count,
+        "first_child": first_child,
+        "next_sib": next_sib,
+        "parent_row": parent_row,
+        "is_elem": is_elem,
+    }
+    elem_vis = is_elem & (winner >= 0)
+    obj_idx = jnp.where(valid, obj_dense, jnp.int32(P + 1))
+    core["obj_vis_len"] = (
+        jnp.zeros(P + 2, jnp.int32).at[obj_idx].add(elem_vis.astype(jnp.int32))
+    )
+    w_width = jnp.where(elem_vis, c["width"][jnp.clip(winner, 0, P - 1)], 0)
+    core["obj_text_width"] = jnp.zeros(P + 2, jnp.int32).at[obj_idx].add(w_width)
+    return core
+
+
+_scatter_core_cache = {}
+
+
+def scatter_kernel_core(n_objs: int, n_props: int):
+    """Jitted geometry-specialized scatter-resolution kernel (no ranking)."""
+    key = scatter_geom_key(n_objs, n_props)
+    fn = _scatter_core_cache.get(key)
+    if fn is None:
+        n_objs2, np_eff = key
+
+        @jax.jit
+        def f(c):
+            return resolve_state_scatter(
+                c, *succ_resolution(c), n_objs2=n_objs2, n_props=np_eff
+            )
+
+        fn = _scatter_core_cache[key] = f
+    return fn
+
+
 # -- packed transport ---------------------------------------------------------
 #
 # Remote accelerators (this image reaches its TPU through a ~25 MB/s,
@@ -530,11 +677,17 @@ def _emit(core, fetch, obj_cap):
     return jnp.concatenate(outs)
 
 
-def _runs_fn(fetch, obj_cap, static_key, P, Q):
+def _runs_fn(fetch, obj_cap, static_key, P, Q, scatter_geom=None):
     @jax.jit
     def f(arrays):
         c = _unpack_transport(static_key, arrays, P, Q)
-        core = resolve_state(c, *succ_resolution(c), obj_cap=obj_cap)
+        if scatter_geom is not None:
+            core = resolve_state_scatter(
+                c, *succ_resolution(c),
+                n_objs2=scatter_geom[0], n_props=scatter_geom[1],
+            )
+        else:
+            core = resolve_state(c, *succ_resolution(c), obj_cap=obj_cap)
         if "elem_index" in fetch:
             core["elem_index"] = device_linearize(c, core)
         return _emit(core, fetch, obj_cap)
@@ -574,13 +727,20 @@ def _split_flat(flat, fetch, P, obj_cap):
     return out
 
 
-def _packed_merge(cols_np, fetch, n_objs):
+def _packed_merge(cols_np, fetch, n_objs, n_props=None):
     from .. import native
 
     P = len(cols_np["action"])
     Q = len(cols_np["pred_src"])
     obj_cap = min(_next_pow2(max((n_objs or P) + 2, 16)), P + 2)
     fetch = tuple(fetch)
+    scatter_geom = (
+        scatter_geom_key(n_objs, n_props)
+        if n_objs is not None
+        and n_props is not None
+        and scatter_geometry_ok(P, n_objs, n_props)
+        else None
+    )
 
     # element order never needs the device (host_linearize): computing it
     # host-side while the kernel runs removes the two pointer-doubling
@@ -596,10 +756,12 @@ def _packed_merge(cols_np, fetch, n_objs):
     )
 
     static_key, arrays = encode_transport(cols_np)
-    key = (dev_fetch, obj_cap, static_key, P, Q)
+    key = (dev_fetch, obj_cap, static_key, P, Q, scatter_geom)
     fn = _packed_cache.get(key)
     if fn is None:
-        fn = _packed_cache[key] = _runs_fn(dev_fetch, obj_cap, static_key, P, Q)
+        fn = _packed_cache[key] = _runs_fn(
+            dev_fetch, obj_cap, static_key, P, Q, scatter_geom
+        )
     flat_dev = fn({k: jnp.asarray(v) for k, v in arrays.items()})  # async
     elem_index = host_linearize(cols_np) if host_elem else None
     flat = np.asarray(flat_dev)
@@ -616,7 +778,8 @@ ALL_OUTPUTS = (
 )
 
 
-def merge_columns(cols_np, linearize: str = "auto", fetch=None, n_objs=None):
+def merge_columns(cols_np, linearize: str = "auto", fetch=None, n_objs=None,
+                  n_props=None):
     """Host entry: numpy columns in, numpy resolution out.
 
     ``linearize``: "device" (all on chip), "native" (C++ preorder walk),
@@ -627,7 +790,10 @@ def merge_columns(cols_np, linearize: str = "auto", fetch=None, n_objs=None):
     (default: all). Device->host transfer is the dominant cost on remote
     accelerators, so read paths should request only what they consume.
     ``n_objs`` (when given) truncates the per-object stats to the live
-    object count before transfer.
+    object count before transfer. ``n_props`` (with ``n_objs``) supplies
+    the static group-table geometry that selects the faster sort-free
+    scatter resolution (resolve_state_scatter) on the device paths;
+    without it the sort-based kernel runs.
 
     Transport: against a non-CPU backend the packed path is used whenever
     ``fetch`` is restricted and ``linearize`` is left on "auto" (one array
@@ -705,7 +871,10 @@ def merge_columns(cols_np, linearize: str = "auto", fetch=None, n_objs=None):
             else "dict"
         )
     if transport == "packed":
-        return _packed_merge(cols_np, fetch if fetch is not None else ALL_OUTPUTS, n_objs)
+        return _packed_merge(
+            cols_np, fetch if fetch is not None else ALL_OUTPUTS, n_objs,
+            n_props,
+        )
 
     cols = {k: jnp.asarray(v) for k, v in cols_np.items()}
     if linearize == "auto":
@@ -722,7 +891,15 @@ def merge_columns(cols_np, linearize: str = "auto", fetch=None, n_objs=None):
         return host
 
     if linearize == "native":
-        out = merge_kernel_core(cols)
+        P = len(cols_np["action"])
+        if (
+            n_objs is not None
+            and n_props is not None
+            and scatter_geometry_ok(P, n_objs, n_props)
+        ):
+            out = scatter_kernel_core(n_objs, n_props)(cols)
+        else:
+            out = merge_kernel_core(cols)
         host = pull(out, need - {"elem_index"})
         if "elem_index" in need:
             # ranked from the host-resident columns — zero device traffic

@@ -49,6 +49,7 @@ from ..ops.merge import (
     _ceil_log2,
     _unpack_transport,
     encode_transport,
+    forest as _forest,
     resolve_state,
     succ_resolution,
     visibility,
@@ -155,54 +156,6 @@ def _sharded_winners(c, visible, Pl, n_objs2, n_props, G):
         jnp.zeros(Ptot + 2, jnp.int32).at[obj_idx_l].add(w_width_l), AXIS
     )
     return winner, conflicts, obj_vis_len, obj_text_width
-
-
-def _forest(c):
-    """Sibling forest (parent / first_child / next_sib), replicated.
-
-    first_child is a scatter-max (children order is descending row =
-    descending Lamport, query/insert.rs); next_sib adjacency keeps the one
-    sort — it is a few percent of the single-chip merge (BASELINE.md) and
-    the doubling loops, not this, are what sharding must attack.
-    """
-    Ptot = c["action"].shape[0]
-    rows = jnp.arange(Ptot, dtype=jnp.int32)
-    action = c["action"]
-    valid = action != PAD_ACTION
-    insert = c["insert"]
-    elem_ref = c["elem_ref"]
-    obj_dense = c["obj_dense"]
-    N = 2 * Ptot + 3
-    S = jnp.int32(N - 1)
-    is_elem = insert & valid
-    parent_row = jnp.where(
-        is_elem,
-        jnp.where(
-            elem_ref == ELEM_HEAD,
-            Ptot + obj_dense,
-            jnp.where(elem_ref >= 0, elem_ref, S),
-        ),
-        S,
-    ).astype(jnp.int32)
-    first_child = (
-        jnp.full(N, NONE32, jnp.int32)
-        .at[jnp.where(is_elem, parent_row, N - 1)]
-        .max(jnp.where(is_elem, rows, NONE32))
-    )
-    # adjacency: sort children by (parent, -row); consecutive same-parent
-    # entries give next_sib (descending row within parent)
-    sib_parent = jnp.where(is_elem, parent_row, jnp.int32(N))
-    sp_s, neg_rows = jax.lax.sort((sib_parent, -rows), num_keys=2, is_stable=True)
-    sib_idx = -neg_rows
-    nxt_same = jnp.concatenate([sp_s[1:] == sp_s[:-1], jnp.array([False])])
-    nxt_row = jnp.concatenate([sib_idx[1:], jnp.array([-1], jnp.int32)])
-    in_range = sp_s < N
-    next_sib = (
-        jnp.full(N, NONE32, jnp.int32)
-        .at[jnp.where(in_range, sib_idx, N - 1)]
-        .set(jnp.where(nxt_same & in_range, nxt_row, NONE32))
-    )
-    return is_elem, parent_row, first_child, next_sib
 
 
 def _sharded_linearize(c, is_elem, parent_row, first_child, next_sib, Pl):

@@ -325,7 +325,9 @@ class RpcServer:
     def handle(self, req: dict) -> dict:
         rid = req.get("id")
         method = req.get("method", "")
-        if method not in self.METHODS:
+        # the isinstance guard keeps unhashable method values (lists,
+        # dicts) from raising out of the membership test
+        if not isinstance(method, str) or method not in self.METHODS:
             return {"id": rid, "error": {"type": "UnknownMethod",
                                          "message": str(method)}}
         try:

@@ -147,3 +147,31 @@ def test_map_hash_fallback():
     jx = merge_columns(cols, linearize="device", fetch=ALL_OUTPUTS, n_objs=log.n_objs)
     nv = native.merge_cols(cols, log.n_objs)
     _assert_same(jx, nv, "hash-fallback")
+
+
+@pytest.mark.parametrize("name", ["fanin", "rga", "mapcounter", "rich"])
+def test_scatter_kernel_matches_sort_kernel(name):
+    """The sort-free scatter resolution (geometry-specialized) must match
+    the sort-based kernel bit-for-bit on every workload shape."""
+    import jax.numpy as jnp
+
+    from automerge_tpu.ops.merge import (
+        merge_kernel_core, scatter_geometry_ok, scatter_kernel_core,
+    )
+
+    log = OpLog.from_changes(_workload(name))
+    cols_np = log.padded_columns()
+    assert scatter_geometry_ok(
+        len(cols_np["action"]), log.n_objs, len(log.props)
+    )
+    cols = {k: jnp.asarray(v) for k, v in cols_np.items()}
+    o1 = merge_kernel_core(cols)
+    o2 = scatter_kernel_core(log.n_objs, len(log.props))(cols)
+    for k in (
+        "visible", "winner", "conflicts", "succ_count", "inc_count",
+        "counter_inc", "is_elem", "parent_row", "first_child", "next_sib",
+        "obj_vis_len", "obj_text_width",
+    ):
+        a, b = np.asarray(o1[k]), np.asarray(o2[k])
+        assert a.shape == b.shape, (name, k, a.shape, b.shape)
+        assert np.array_equal(a, b), (name, k)

@@ -156,6 +156,7 @@ def test_server_survives_hostile_and_binary_inputs():
         "123",                                    # valid JSON, not an object
         "[1,2]",
         "not json at all",
+        json.dumps({"id": 0, "method": [1, 2], "params": {}}),  # unhashable
         json.dumps({"id": 1, "method": "serve", "params": {"x": 1}}),
         json.dumps({"id": 2, "method": "handle", "params": {}}),
         json.dumps({"id": 3, "method": "_doc", "params": {}}),
@@ -168,9 +169,10 @@ def test_server_survives_hostile_and_binary_inputs():
     resps = [json.loads(x) for x in out.getvalue().splitlines()]
     assert len(resps) == len(lines)
     assert all("error" in r for r in resps[:3])
-    assert resps[3]["error"]["type"] == "UnknownMethod"   # serve not callable
-    assert resps[4]["error"]["type"] == "UnknownMethod"
+    assert resps[3]["error"]["type"] == "UnknownMethod"   # unhashable method
+    assert resps[4]["error"]["type"] == "UnknownMethod"   # serve not callable
     assert resps[5]["error"]["type"] == "UnknownMethod"
-    assert resps[6]["result"]["b"] == {"$bytes": "AAEC"}  # bytes wrapped
-    assert resps[7]["result"][0]["name"] == "blob"
-    assert resps[8]["result"] is None                     # clean shutdown
+    assert resps[6]["error"]["type"] == "UnknownMethod"
+    assert resps[7]["result"]["b"] == {"$bytes": "AAEC"}  # bytes wrapped
+    assert resps[8]["result"][0]["name"] == "blob"
+    assert resps[9]["result"] is None                     # clean shutdown
