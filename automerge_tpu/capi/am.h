@@ -58,6 +58,10 @@ typedef enum {
 int am_init(void);
 void am_shutdown(void);
 
+/* -- document lifecycle (see also am_create/am_load/am_fork below) -------- */
+/* Same history AND same actor id (am_fork mints a fresh actor). */
+AMdoc *am_clone(AMdoc *doc);
+
 /* -- results / items ------------------------------------------------------ */
 AMstatus am_result_status(const AMresult *r);
 const char *am_result_error(const AMresult *r); /* NULL when OK */
@@ -80,6 +84,12 @@ AMresult *am_commit(AMdoc *doc, const char *message); /* item: BYTES hash (or em
 AMresult *am_merge(AMdoc *doc, AMdoc *other);         /* items: BYTES hashes */
 AMresult *am_get_heads(AMdoc *doc);                   /* items: BYTES */
 AMresult *am_actor_id(AMdoc *doc);                    /* item: BYTES */
+AMresult *am_set_actor_id(AMdoc *doc, const uint8_t *actor, size_t actor_len);
+/* Current-content equality (hydrated trees; histories may differ). */
+AMresult *am_equal(AMdoc *doc, AMdoc *other);         /* item: BOOL */
+/* Uncommitted op count / discard the open transaction (count discarded). */
+AMresult *am_pending_ops(AMdoc *doc);                 /* item: UINT */
+AMresult *am_rollback(AMdoc *doc);                    /* item: UINT */
 
 /* -- map / list mutation --------------------------------------------------- */
 AMresult *am_map_put_null(AMdoc *doc, const char *obj, const char *key);
@@ -142,6 +152,14 @@ AMresult *am_object_type(AMdoc *doc, const char *obj);
 AMresult *am_list_items(AMdoc *doc, const char *obj);
 /* per entry: STR key then the value item (2 items each) */
 AMresult *am_map_entries(AMdoc *doc, const char *obj);
+/* value items for visible indices in [start, end) */
+AMresult *am_list_range(AMdoc *doc, const char *obj, size_t start, size_t end);
+/* (STR key, value item) pairs for keys in [begin, end); "" end = unbounded */
+AMresult *am_map_range(AMdoc *doc, const char *obj, const char *begin,
+                       const char *end);
+/* delete ``del`` elements at ``pos`` (AMsplice's delete side; insertions
+ * go through the typed am_list_insert_* calls) */
+AMresult *am_list_splice(AMdoc *doc, const char *obj, size_t pos, size_t del);
 
 /* -- historical reads (*_at) ----------------------------------------------- */
 /* ``heads`` = n_heads concatenated 32-byte change hashes (the bytes of
@@ -206,6 +224,16 @@ AMresult *am_apply_changes(AMdoc *doc, const uint8_t *data, size_t len);
 AMresult *am_save_incremental(AMdoc *doc, const uint8_t *heads, size_t n_heads);
 /* Raw change chunks not reachable from the given heads; items: BYTES. */
 AMresult *am_get_changes(AMdoc *doc, const uint8_t *heads, size_t n_heads);
+/* One raw change chunk by its 32-byte hash (empty result = unknown). */
+AMresult *am_get_change_by_hash(AMdoc *doc, const uint8_t *hash);
+/* Raw change chunks present in ``other`` but absent from ``doc`` — what a
+ * merge of ``other`` into ``doc`` would apply. */
+AMresult *am_get_changes_added(AMdoc *doc, AMdoc *other);
+/* The author's most recent change (commits pending ops first). */
+AMresult *am_get_last_local_change(AMdoc *doc);       /* BYTES or empty */
+/* Dependency hashes referenced but not yet applied (the causal queue's
+ * wait set), given additional target heads. */
+AMresult *am_get_missing_deps(AMdoc *doc, const uint8_t *heads, size_t n_heads);
 
 /* -- sync ------------------------------------------------------------------ */
 AMsyncState *am_sync_state_new(void);
@@ -217,6 +245,8 @@ AMresult *am_receive_sync_message(AMdoc *doc, AMsyncState *s, const uint8_t *msg
  * only shared_heads survive the roundtrip, by design). */
 AMresult *am_sync_state_encode(AMsyncState *s); /* item: BYTES */
 AMsyncState *am_sync_state_decode(const uint8_t *data, size_t len);
+/* Heads both peers are known to share (BYTES items). */
+AMresult *am_sync_state_shared_heads(AMsyncState *s);
 
 #ifdef __cplusplus
 }

@@ -664,6 +664,87 @@ extern "C" AMresult *am_get_changes(AMdoc *d, const uint8_t *heads,
   return dispatch("get_changes", args);
 }
 
+/* -- round-3 breadth -------------------------------------------------------*/
+
+extern "C" AMdoc *am_clone(AMdoc *d) {
+  if (!g_shim) return nullptr;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject *args = Py_BuildValue("(L)", (long long)d->handle);
+  PyGILState_Release(gil);
+  return handle_doc(dispatch("clone", args));
+}
+
+extern "C" AMresult *am_set_actor_id(AMdoc *d, const uint8_t *actor,
+                                     size_t actor_len) {
+  AM_ARGS("(Ly#)", (long long)d->handle, (const char *)actor,
+          (Py_ssize_t)actor_len);
+  return dispatch("set_actor", args);
+}
+
+extern "C" AMresult *am_equal(AMdoc *d, AMdoc *other) {
+  AM_ARGS("(LL)", (long long)d->handle, (long long)other->handle);
+  return dispatch("equal", args);
+}
+
+extern "C" AMresult *am_pending_ops(AMdoc *d) {
+  AM_ARGS("(L)", (long long)d->handle);
+  return dispatch("pending_ops", args);
+}
+
+extern "C" AMresult *am_rollback(AMdoc *d) {
+  AM_ARGS("(L)", (long long)d->handle);
+  return dispatch("rollback", args);
+}
+
+extern "C" AMresult *am_get_change_by_hash(AMdoc *d, const uint8_t *hash) {
+  /* NULL hash = empty payload (never dereferenced), same convention as
+   * AM_HEADS; the shim answers with an empty result */
+  AM_ARGS("(Ly#)", (long long)d->handle, hash ? (const char *)hash : "",
+          (Py_ssize_t)(hash ? 32 : 0));
+  return dispatch("get_change_by_hash", args);
+}
+
+extern "C" AMresult *am_get_changes_added(AMdoc *d, AMdoc *other) {
+  AM_ARGS("(LL)", (long long)d->handle, (long long)other->handle);
+  return dispatch("get_changes_added", args);
+}
+
+extern "C" AMresult *am_get_last_local_change(AMdoc *d) {
+  AM_ARGS("(L)", (long long)d->handle);
+  return dispatch("get_last_local_change", args);
+}
+
+extern "C" AMresult *am_get_missing_deps(AMdoc *d, const uint8_t *heads,
+                                         size_t n_heads) {
+  AM_ARGS("(Ly#)", (long long)d->handle, AM_HEADS(heads, n_heads));
+  return dispatch("get_missing_deps", args);
+}
+
+extern "C" AMresult *am_list_range(AMdoc *d, const char *o, size_t start,
+                                   size_t end) {
+  AM_ARGS("(Lsnn)", (long long)d->handle, o, (Py_ssize_t)start,
+          (Py_ssize_t)end);
+  return dispatch("list_range", args);
+}
+
+extern "C" AMresult *am_map_range(AMdoc *d, const char *o, const char *begin,
+                                  const char *end) {
+  AM_ARGS("(Lsss)", (long long)d->handle, o, begin ? begin : "",
+          end ? end : "");
+  return dispatch("map_range", args);
+}
+
+extern "C" AMresult *am_list_splice(AMdoc *d, const char *o, size_t pos,
+                                    size_t del) {
+  AM_ARGS("(Lsnn)", (long long)d->handle, o, (Py_ssize_t)pos, (Py_ssize_t)del);
+  return dispatch("list_splice", args);
+}
+
+extern "C" AMresult *am_sync_state_shared_heads(AMsyncState *s) {
+  AM_ARGS("(L)", (long long)s->handle);
+  return dispatch("sync_state_shared_heads", args);
+}
+
 /* -- sync ------------------------------------------------------------------*/
 
 extern "C" AMsyncState *am_sync_state_new(void) {

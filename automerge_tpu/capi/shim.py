@@ -449,6 +449,95 @@ def pop_patches(h: int) -> List[Item]:
     return _patch_records(doc.make_patches())
 
 
+# -- round-3 breadth: the remaining reference doc.rs surface ------------------
+
+
+def clone(h: int) -> List[Item]:
+    """AMclone: same history, same actor (fork mints a fresh actor)."""
+    doc = _doc(h)
+    cloned = doc.fork(actor=doc.get_actor())
+    return [(HANDLE, _register(_docs, cloned))]
+
+
+def set_actor(h: int, actor: bytes) -> List[Item]:
+    _doc(h).set_actor(ActorId(actor))
+    return []
+
+
+def equal(h: int, other: int) -> List[Item]:
+    """AMequal: current CONTENT equality (hydrated trees), the reference's
+    document-equality semantic — histories may differ."""
+    return [(BOOL, 1 if _doc(h).hydrate() == _doc(other).hydrate() else 0)]
+
+
+def get_change_by_hash(h: int, hash_: bytes) -> List[Item]:
+    doc = _doc(h)
+    doc.commit()  # autocommit boundary, like every history accessor
+    ch = doc.doc.get_change_by_hash(hash_)
+    return [(BYTES, ch.raw_bytes)] if ch is not None else []
+
+
+def get_changes_added(h: int, other: int) -> List[Item]:
+    doc, src = _doc(h), _doc(other)
+    doc.commit()
+    src.commit()  # the result must equal what am_merge would apply
+    added = doc.doc.get_changes_added(src.doc)
+    return [(BYTES, c.raw_bytes) for c in added]
+
+
+def get_missing_deps(h: int, heads: bytes) -> List[Item]:
+    doc = _doc(h)
+    doc.commit()
+    return [(BYTES, x) for x in doc.doc.get_missing_deps(_heads(heads))]
+
+
+def get_last_local_change(h: int) -> List[Item]:
+    ch = _doc(h).get_last_local_change()
+    return [(BYTES, ch.raw_bytes)] if ch is not None else []
+
+
+def pending_ops(h: int) -> List[Item]:
+    return [(UINT, _doc(h).pending_ops())]
+
+
+def rollback(h: int) -> List[Item]:
+    return [(UINT, _doc(h).rollback())]
+
+
+def list_range(h: int, obj: str, start: int, end: int) -> List[Item]:
+    """AMlistRange: value items for visible indices in [start, end)."""
+    doc = _doc(h)
+    out: List[Item] = []
+    for i, (rendered, exid) in enumerate(doc.list_items(obj)):
+        if start <= i < end:
+            out.extend(_render_item(rendered, exid))
+    return out
+
+
+def map_range(h: int, obj: str, begin: str, end: str) -> List[Item]:
+    """AMmapRange: (STR key, value item) pairs for keys in [begin, end)
+    (empty ``end`` = unbounded)."""
+    doc = _doc(h)
+    out: List[Item] = []
+    for key, rendered, exid in doc.map_entries(obj):
+        if key >= begin and (not end or key < end):
+            out.append((STR, key))
+            out.extend(_render_item(rendered, exid))
+    return out
+
+
+def list_splice(h: int, obj: str, pos: int, delete_n: int) -> List[Item]:
+    """AMsplice's delete side; insertions go through the typed insert
+    calls (the item-array marshalling the reference uses has no analogue
+    in this frontend's scalar ABI)."""
+    _doc(h).splice(obj, pos, delete_n, [])
+    return []
+
+
+def sync_state_shared_heads(sh: int) -> List[Item]:
+    return [(BYTES, x) for x in _syncs[sh].shared_heads]
+
+
 # -- sync state codecs --------------------------------------------------------
 
 

@@ -578,11 +578,109 @@ static void test_text_diff_patches(void) {
   am_doc_free(d);
 }
 
+/* -- clone / equality / actor id / rollback --------------------------------- */
+static void test_clone_equal_actor_rollback(void) {
+  uint8_t a1[1] = {1}, a2[1] = {2};
+  AMdoc *d = am_create(a1, 1);
+  CHECK_OK(am_map_put_int(d, AM_ROOT, "x", 1));
+  CHECK_OK(am_commit(d, NULL));
+  AMdoc *c = am_clone(d);
+  CHECK(c != NULL);
+  /* clone keeps the actor; fork mints/uses another */
+  AMresult *r = am_actor_id(c);
+  size_t len = 0;
+  const uint8_t *p = am_item_bytes(r, 0, &len);
+  CHECK(len == 1 && p[0] == 1);
+  am_result_free(r);
+  CHECK(res_int(am_equal(d, c)) == 1);
+  CHECK_OK(am_set_actor_id(c, a2, 1));
+  r = am_actor_id(c);
+  p = am_item_bytes(r, 0, &len);
+  CHECK(len == 1 && p[0] == 2);
+  am_result_free(r);
+  /* divergence flips equality; rollback discards pending ops */
+  CHECK_OK(am_map_put_int(c, AM_ROOT, "y", 2));
+  CHECK(res_int(am_pending_ops(c)) == 1);
+  CHECK(res_int(am_rollback(c)) == 1);
+  CHECK(res_int(am_pending_ops(c)) == 0);
+  CHECK(res_int(am_equal(d, c)) == 1);
+  CHECK_OK(am_map_put_int(c, AM_ROOT, "y", 2));
+  CHECK_OK(am_commit(c, NULL));
+  CHECK(res_int(am_equal(d, c)) == 0);
+  am_doc_free(c);
+  am_doc_free(d);
+}
+
+/* -- change-level history accessors ------------------------------------------ */
+static void test_change_accessors(void) {
+  uint8_t a1[1] = {1}, a2[1] = {2};
+  AMdoc *d = am_create(a1, 1);
+  CHECK_OK(am_map_put_int(d, AM_ROOT, "x", 1));
+  CHECK_OK(am_commit(d, NULL));
+  size_t n1 = res_heads(am_get_heads(d), heads1, 64);
+  CHECK(n1 == 1);
+  /* fetch the head change by hash; an unknown hash is empty, not an error */
+  AMresult *r = am_get_change_by_hash(d, heads1);
+  CHECK(res_ok(r) && am_result_size(r) == 1);
+  am_result_free(r);
+  uint8_t bogus[32] = {0};
+  r = am_get_change_by_hash(d, bogus);
+  CHECK(res_ok(r) && am_result_size(r) == 0);
+  am_result_free(r);
+  /* last local change commits pending ops and returns the chunk */
+  CHECK_OK(am_map_put_int(d, AM_ROOT, "y", 2));
+  r = am_get_last_local_change(d);
+  CHECK(res_ok(r) && am_result_size(r) == 1);
+  am_result_free(r);
+  /* changes a stale fork would pull from us (the merge direction) */
+  AMdoc *old = am_fork_at(d, heads1, n1, a2, 1);
+  r = am_get_changes_added(old, d);
+  CHECK(res_ok(r) && am_result_size(r) == 1);
+  am_result_free(r);
+  /* nothing missing when history is complete */
+  r = am_get_missing_deps(d, NULL, 0);
+  CHECK(res_ok(r) && am_result_size(r) == 0);
+  am_result_free(r);
+  am_doc_free(old);
+  am_doc_free(d);
+}
+
+/* -- range reads + list splice ----------------------------------------------- */
+static void test_ranges_and_splice(void) {
+  AMdoc *d = am_create(NULL, 0);
+  AMresult *r = am_map_put_object(d, AM_ROOT, "l", AM_OBJ_LIST);
+  char l[128];
+  strncpy(l, am_item_str(r, 0), sizeof l - 1);
+  am_result_free(r);
+  for (int i = 0; i < 8; i++) CHECK_OK(am_list_insert_int(d, l, (size_t)i, i * 10));
+  r = am_list_range(d, l, 2, 5);
+  CHECK(res_ok(r) && am_result_size(r) == 3);
+  CHECK(am_item_int(r, 0) == 20 && am_item_int(r, 2) == 40);
+  am_result_free(r);
+  CHECK_OK(am_list_splice(d, l, 1, 3)); /* delete 3 at 1 */
+  CHECK(res_int(am_length(d, l)) == 5);
+  CHECK(res_int(am_list_get(d, l, 1)) == 40);
+  CHECK_OK(am_map_put_int(d, AM_ROOT, "alpha", 1));
+  CHECK_OK(am_map_put_int(d, AM_ROOT, "beta", 2));
+  CHECK_OK(am_map_put_int(d, AM_ROOT, "gamma", 3));
+  r = am_map_range(d, AM_ROOT, "alpha", "gamma");
+  CHECK(res_ok(r) && am_result_size(r) == 4); /* alpha, beta x (key,value) */
+  CHECK(strcmp(am_item_str(r, 0), "alpha") == 0 && am_item_int(r, 3) == 2);
+  am_result_free(r);
+  r = am_map_range(d, AM_ROOT, "beta", "");
+  CHECK(res_ok(r) && am_result_size(r) == 6); /* beta, gamma, l */
+  am_result_free(r);
+  am_doc_free(d);
+}
+
 int main(void) {
   if (am_init() != 0) {
     fprintf(stderr, "am_init failed\n");
     return 2;
   }
+  test_clone_equal_actor_rollback();
+  test_change_accessors();
+  test_ranges_and_splice();
   test_create_fork_free();
   test_start_and_commit();
   test_nonexistent_prop();

@@ -190,6 +190,11 @@ static void test_prior_sync_state_roundtrip(void) {
   AMsyncState *sa = am_sync_state_new(), *sb = am_sync_state_new();
   CHECK(sync_loop(a, b, sa, sb) >= 0);
 
+  /* after convergence both peers record the same shared heads */
+  AMresult *sh = am_sync_state_shared_heads(sa);
+  CHECK(res_ok(sh) && am_result_size(sh) == 1);
+  am_result_free(sh);
+
   /* persist both states (only shared_heads survives, by design) */
   size_t la = res_bytes(am_sync_state_encode(sa), buf, sizeof buf);
   CHECK(la > 0);

@@ -179,44 +179,9 @@ def resolve_state(c, succ_count, inc_count, counter_inc, obj_cap=None):
     conflicts = seg_vis[seg_of_row]
 
     # --- 4. RGA linearization ---------------------------------------------
-    # node space: [0,P) element nodes (insert rows), [P,2P+2) object roots
-    # (indexed P + obj_dense), sentinel S terminates every chain
-    N = 2 * P + 3
-    S = jnp.int32(N - 1)
-    is_elem = insert & valid
-    root_of_row = P + obj_dense
-    parent_row = jnp.where(
-        is_elem,
-        jnp.where(elem_ref == ELEM_HEAD, root_of_row, jnp.where(elem_ref >= 0, elem_ref, S)),
-        S,
-    ).astype(jnp.int32)
-
-    # sibling sort: children of one parent contiguous, descending Lamport
-    # (= descending row, query/insert.rs lamport tie-breaking)
-    sib_parent = jnp.where(is_elem, parent_row, jnp.int32(N))
-    sp_s, neg_rows = jax.lax.sort((sib_parent, -rows), num_keys=2, is_stable=True)
-    sib_idx = -neg_rows
-    elem_cnt = jnp.sum(is_elem.astype(jnp.int32))
-    pos32 = jnp.arange(P, dtype=jnp.int32)
-    in_range = pos32 < elem_cnt
-
-    # first child node per parent (min sorted position per parent)
-    parents_pad = jnp.where(in_range, sp_s, N - 1)
-    big = jnp.int32(P)
-    fc_pos = (
-        jnp.full(N, big, jnp.int32)
-        .at[parents_pad]
-        .min(jnp.where(in_range, pos32, big))
-    )
-    first_child = jnp.where(fc_pos < P, sib_idx[jnp.clip(fc_pos, 0, P - 1)], NONE32)
-    # next sibling per element node
-    nxt_same = jnp.concatenate([sp_s[1:] == sp_s[:-1], jnp.array([False])])
-    nxt_row = jnp.concatenate([sib_idx[1:], jnp.array([-1], jnp.int32)])
-    next_sib = (
-        jnp.full(N, NONE32, jnp.int32)
-        .at[jnp.where(in_range, sib_idx, N - 1)]
-        .set(jnp.where(nxt_same & in_range, nxt_row, NONE32))
-    )
+    # the shared sibling-forest builder (node space: [0,P) element nodes,
+    # [P,2P+2) object roots, sentinel terminates every chain)
+    is_elem, parent_row, first_child, next_sib = forest(c)
 
     core = {
         "visible": visible,
