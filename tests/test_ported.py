@@ -989,3 +989,175 @@ def test_load_incremental_partial_change_stream():
     # the change depends on history doc2 doesn't have: it must queue, not fail
     doc2.load_incremental(encoded)
     assert doc2.get("_root", "b") is None
+
+
+def test_multiple_insertions_same_position_greater_actor():
+    """Insertion-order tie at one position: the greater actor's element
+    sorts after the HEAD anchor consistently (test.rs:711-733)."""
+    a1, a2 = sorted_actors()
+    doc1 = AutoDoc(actor=a1)
+    doc2 = AutoDoc(actor=a2)
+    lst = doc1.put_object("_root", "list", ObjType.LIST)
+    doc1.insert(lst, 0, "two")
+    doc1.commit()
+    doc2.merge(doc1)
+    doc2.insert(lst, 0, "one")
+    assert_doc(doc2, map_({"list": list_(["one", "two"])}))
+
+
+def test_multiple_insertions_same_position_lesser_actor():
+    """Same tie with the actors swapped (test.rs:736-757)."""
+    a2, a1 = sorted_actors()
+    doc1 = AutoDoc(actor=a1)
+    doc2 = AutoDoc(actor=a2)
+    lst = doc1.put_object("_root", "list", ObjType.LIST)
+    doc1.insert(lst, 0, "two")
+    doc1.commit()
+    doc2.merge(doc1)
+    doc2.insert(lst, 0, "one")
+    assert_doc(doc2, map_({"list": list_(["one", "two"])}))
+
+
+def test_ops_on_wrong_object_types_error():
+    """Map verbs on lists, seq verbs on maps, map verbs on text: typed
+    errors, never silent success (test.rs:1379-1402 InvalidOp)."""
+    doc = new_doc(77)
+    lst = doc.put_object("_root", "list", ObjType.LIST)
+    doc.insert(lst, 0, "a")
+    doc.insert(lst, 1, "b")
+    with pytest.raises(AutomergeError):
+        doc.put(lst, "a", "AAA")  # map key on a list
+    with pytest.raises(AutomergeError):
+        doc.splice_text(lst, 0, 0, "hello world")  # text splice on a list
+    m = doc.put_object("_root", "map", ObjType.MAP)
+    doc.put(m, "a", "AAA")
+    doc.put(m, "b", "BBB")
+    with pytest.raises(AutomergeError):
+        doc.insert(m, 0, "b")  # seq insert on a map
+    with pytest.raises(AutomergeError):
+        doc.splice_text(m, 0, 0, "hello world")
+    t = doc.put_object("_root", "text", ObjType.TEXT)
+    doc.splice_text(t, 0, 0, "hello world")
+    with pytest.raises(AutomergeError):
+        doc.put(t, "a", "AAA")  # map key on text
+
+
+def test_save_restore_complex_transactional():
+    """Nested todo edited concurrently on both sides of a fork; the merge
+    keeps both conflict values and survives save/load
+    (test.rs:858-903)."""
+    doc1 = new_doc(81)
+    todos = doc1.put_object("_root", "todos", ObjType.LIST)
+    first = doc1.insert_object(todos, 0, ObjType.MAP)
+    doc1.put(first, "title", "water plants")
+    doc1.put(first, "done", False)
+    doc1.commit()
+
+    doc2 = new_doc(82)
+    doc2.merge(doc1)
+    doc2.put(first, "title", "weed plants")
+    doc2.commit()
+    doc1.put(first, "title", "kill plants")
+    doc1.commit()
+    doc1.merge(doc2)
+
+    reloaded = AutoDoc.load(doc1.save())
+    titles = sorted(
+        v[1].value for v, _ in reloaded.get_all(first, "title")
+    )
+    assert titles == ["kill plants", "weed plants"]
+    assert reloaded.get(first, "done")[0][1].value is False
+    dev = DeviceDoc.merge([reloaded])
+    assert dev.hydrate() == reloaded.hydrate()
+
+
+def test_local_inc_in_map_bumps_all_visible_counters():
+    """A local increment lands on EVERY visible conflicting counter, and a
+    non-counter conflict loser disappears (test.rs:1079-1121)."""
+    import os as _os
+
+    v = sorted(
+        (ActorId(_os.urandom(16)) for _ in range(3)), key=lambda a: a.bytes
+    )
+    doc1 = AutoDoc(actor=v[0])
+    doc1.put("_root", "hello", "world")
+    doc1.commit()
+    doc2 = AutoDoc.load(doc1.save())
+    doc2.set_actor(v[1])
+    doc3 = AutoDoc.load(doc1.save())
+    doc3.set_actor(v[2])
+
+    doc1.put("_root", "cnt", ScalarValue("uint", 20))
+    doc2.put("_root", "cnt", ScalarValue("counter", 0))
+    doc3.put("_root", "cnt", ScalarValue("counter", 10))
+    doc1.commit(); doc2.commit(); doc3.commit()
+    doc1.merge(doc2)
+    doc1.merge(doc3)
+    def rendered_vals():
+        out = []
+        for v, _ in doc1.get_all("_root", "cnt"):
+            if v[0] == "counter":
+                out.append(("counter", v[1]))
+            else:
+                out.append((v[1].tag, v[1].value))
+        return sorted(out)
+
+    assert rendered_vals() == [("counter", 0), ("counter", 10), ("uint", 20)]
+
+    doc1.increment("_root", "cnt", 5)
+    doc1.commit()
+    # the uint loses (increment predecessors overwrite it); counters bump
+    assert rendered_vals() == [("counter", 5), ("counter", 15)]
+    doc4 = AutoDoc.load(doc1.save())
+    assert doc4.save() == doc1.save()
+    dev = DeviceDoc.merge([doc1])
+    assert dev.hydrate() == doc1.hydrate()
+
+
+def test_merging_text_conflicts_then_saving_and_loading():
+    """test.rs:1124-1160: splices on a loaded doc under a new actor,
+    surviving another save/load cycle."""
+    a1, a2 = sorted_actors()
+    doc1 = AutoDoc(actor=a1)
+    text = doc1.put_object("_root", "text", ObjType.TEXT)
+    doc1.splice_text(text, 0, 0, "hello")
+    doc1.commit()
+    doc2 = AutoDoc.load(doc1.save())
+    doc2.set_actor(a2)
+    assert doc2.text(text) == "hello"
+    doc2.splice_text(text, 4, 1, "")
+    doc2.splice_text(text, 4, 0, "!")
+    doc2.splice_text(text, 5, 0, " ")
+    doc2.splice_text(text, 6, 0, "world")
+    assert doc2.text(text) == "hell! world"
+    doc3 = AutoDoc.load(doc2.save())
+    assert doc3.text(text) == "hell! world"
+    dev = DeviceDoc.merge([doc3])
+    assert dev.hydrate() == doc3.hydrate()
+
+
+def test_bad_change_on_storage_boundary():
+    """test.rs:1467-1501: repeated same-key transactions, a fork loaded
+    from the save, then one more change applied from the change stream —
+    the reload must stay valid (the reference's op-tree page-boundary
+    regression, generic at the storage level here)."""
+    doc = new_doc(91)
+    doc.put("_root", "a", "z")
+    doc.put("_root", "b", 0)
+    doc.put("_root", "c", 0)
+    doc.commit()
+    for i in range(15):
+        doc.put("_root", "a", "a" * i)
+        doc.put("_root", "b", i + 1)
+        doc.put("_root", "c", i + 1)
+        doc.commit()
+    doc2 = AutoDoc.load(doc.save())
+    i = 17
+    doc.put("_root", "a", "a" * i)
+    doc.put("_root", "b", i)
+    doc.put("_root", "c", i)
+    doc.commit()
+    changes = doc.get_changes(doc2.get_heads())
+    doc2.apply_changes(changes)
+    AutoDoc.load(doc2.save())
+    assert doc2.get("_root", "b")[0][1].value == 17
