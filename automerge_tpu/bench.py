@@ -437,26 +437,33 @@ def flatten_for_seq_apply(changes: Sequence[StoredChange]):
     }
 
 
-def seq_apply_baseline(changes: Sequence[StoredChange], query_obj: Tuple[int, bytes]):
+def seq_apply_baseline(
+    changes: Sequence[StoredChange], query_obj: Tuple[int, bytes],
+    reps: int = 1,
+):
     """Run the native sequential apply over ``changes``; returns
-    (elapsed_seconds, merged text of query_obj).
+    (best-of-``reps`` elapsed seconds, merged text of query_obj).
 
     The measured equivalent of the reference's sequential Rust
     ``apply_changes`` loop on this host (see BASELINE.md for how this is
-    used as the honest baseline).
+    used as the honest baseline). ``reps`` takes the minimum like the
+    framework side's timing loop, so divisor and dividend face the same
+    best-of protocol on a noisy host.
     """
     from . import native
     from .ops.oplog import ACTOR_BITS
 
     flat = flatten_for_seq_apply(changes)
     qkey = (query_obj[0] << ACTOR_BITS) | flat["rank_of"][query_obj[1]]
-    t0 = time.perf_counter()
-    rows = native.seq_apply(
-        flat["op_id"], flat["obj"], flat["elem"], flat["prop"], flat["action"],
-        flat["insert"], flat["is_counter"], flat["pred_off"], flat["pred_flat"],
-        qkey,
-    )
-    dt = time.perf_counter() - t0
+    dt = float("inf")
+    for _ in range(max(reps, 1)):
+        t0 = time.perf_counter()
+        rows = native.seq_apply(
+            flat["op_id"], flat["obj"], flat["elem"], flat["prop"],
+            flat["action"], flat["insert"], flat["is_counter"],
+            flat["pred_off"], flat["pred_flat"], qkey,
+        )
+        dt = min(dt, time.perf_counter() - t0)
     vals = flat["values"]
     text = "".join(
         vals[r].value if vals[r].tag == "str" else "￼" for r in rows

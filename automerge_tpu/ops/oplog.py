@@ -346,10 +346,29 @@ class OpLog:
             np.where(elem == 0, np.int32(ELEM_HEAD), rows_of(elem, ELEM_MISSING)),
         ).astype(np.int32)
 
-        # dense object ids: 0 = root, then by packed object id order
-        log.obj_table = np.unique(np.concatenate([[0], obj]))
+        # dense object ids: 0 = root, then by packed object id order.
+        # Candidate ids come from the make ops (every object IS a make
+        # op's id) — O(#objects log #objects) instead of np.unique's full
+        # O(n log n) sort; a log whose ops reference objects with no make
+        # op in it (partial histories) falls back to the exact unique.
+        make_rows = np.flatnonzero(
+            (log.action == 0) | (log.action == 2)
+            | (log.action == 4) | (log.action == 6)
+        )
+        cand = np.unique(np.concatenate([[0], log.id_key[make_rows]]))
+        pos = np.searchsorted(cand, obj)
+        posc = np.clip(pos, 0, len(cand) - 1)
+        if np.all(cand[posc] == obj):
+            log.obj_table = cand
+            log.obj_dense = posc.astype(np.int32)
+        else:
+            # partial history: some referenced object has no make op here.
+            # The table still UNIONS the make candidates so childless
+            # objects resolve identically on both paths (consumers
+            # searchsorted into obj_table without a membership check).
+            log.obj_table = np.unique(np.concatenate([cand, obj]))
+            log.obj_dense = np.searchsorted(log.obj_table, obj).astype(np.int32)
         log.n_objs = len(log.obj_table)
-        log.obj_dense = np.searchsorted(log.obj_table, obj).astype(np.int32)
 
         # pred references -> (src row, tgt row) pairs
         pred_src = np.asarray(pred_src, np.int64)

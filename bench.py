@@ -160,7 +160,9 @@ def main():
     n = log.n
 
     # baseline 1: native sequential apply (measured)
-    t_native, native_text = W.seq_apply_baseline(changes, base.text_obj)
+    t_native, native_text = W.seq_apply_baseline(
+        changes, base.text_obj, reps=env_int("BENCH_REPS", 2)
+    )
     native_rate = n / t_native
 
     # convergence check: device == native sequential
@@ -300,9 +302,13 @@ def main():
     rbase = W.build_base(trace, 3_000)
     rga_changes = W.synth_rga(rbase, rga_actors, rga_ops)
     all_rga = list(rbase.changes) + rga_changes
-    rlog, rres, (t_rga_ex, t_rga_mg) = device_merge_timed(all_rga, env_int("BENCH_REPS", 2))
+    rlog, rres, (t_rga_ex, t_rga_mg) = device_merge_timed(
+        all_rga, env_int("BENCH_REPS", 2)
+    )
     t_rga = t_rga_ex + t_rga_mg
-    t_rn, rn_text = W.seq_apply_baseline(all_rga, rbase.text_obj)
+    t_rn, rn_text = W.seq_apply_baseline(
+        all_rga, rbase.text_obj, reps=env_int("BENCH_REPS", 2)
+    )
     rdev = DeviceDoc(rlog, rres)
     assert rdev.text(rbase.text_exid) == rn_text, "rga device/native divergence"
     rga_baseline = max(rlog.n / t_rn, RUST_PIN_APPLY)
