@@ -21,6 +21,7 @@
 // size threshold this engine beats the device end to end (see
 // merge_columns engine selection). Same columns in, same arrays out.
 
+#include <climits>
 #include <cstdint>
 #include <cstring>
 #include <thread>
@@ -322,9 +323,35 @@ long long am_rle_encode_strtab(const int64_t* ids, int64_t n,
 // across threads when the host has them.
 long long am_join_rows_i64(const int64_t* sorted, int64_t n, const int64_t* q,
                            int64_t m, int32_t missing, int32_t* out) {
+  // direct-mapped memo: real query streams are highly repetitive (RGA
+  // anchors and typing chains reference a small working set of targets),
+  // so most lookups resolve to one probe of a 64k-entry cache instead of
+  // a search. The empty marker is INT64_MIN — no packed id reaches it, so
+  // ANY query key (including 0, which both callers do pass) is safe.
+  // Per-thread tables — a shared memo's two-field entries would tear
+  // under concurrent writes — and only for ranges big enough to amortize
+  // the table's zero-init (small incremental joins skip it).
+  constexpr int64_t kCacheBits = 16;
+  constexpr int64_t kEmpty = INT64_MIN;
   auto run = [&](int64_t lo, int64_t hi) {
+    const bool use_memo = (hi - lo) >= (int64_t)1 << (kCacheBits - 2);
+    std::vector<int64_t> memo_key;
+    std::vector<int32_t> memo_val;
+    if (use_memo) {
+      memo_key.assign((size_t)1 << kCacheBits, kEmpty);
+      memo_val.assign((size_t)1 << kCacheBits, 0);
+    }
     for (int64_t i = lo; i < hi; i++) {
       const int64_t key = q[i];
+      size_t slot = 0;
+      if (use_memo) {
+        slot = (size_t)((uint64_t)(key * 0x9E3779B97F4A7C15ull) >>
+                        (64 - kCacheBits));
+        if (memo_key[slot] == key) {
+          out[i] = memo_val[slot];
+          continue;
+        }
+      }
       int64_t a = 0, b = n;
       // interpolation steps keep the lower_bound invariant (answer in
       // [a, b]): p is clamped into [a, b-1], then the same narrowing rule
@@ -350,7 +377,12 @@ long long am_join_rows_i64(const int64_t* sorted, int64_t n, const int64_t* q,
         else
           b = mid;
       }
-      out[i] = (a < n && sorted[a] == key) ? (int32_t)a : missing;
+      const int32_t r = (a < n && sorted[a] == key) ? (int32_t)a : missing;
+      out[i] = r;
+      if (use_memo) {
+        memo_key[slot] = key;
+        memo_val[slot] = r;
+      }
     }
   };
   const unsigned hw = std::thread::hardware_concurrency();

@@ -36,7 +36,7 @@ from ..patches.patch import (
 )
 from ..types import ObjType, is_make_action, objtype_for_action
 from .merge import merge_columns
-from .oplog import ACTOR_BITS, OpLog, TAG_COUNTER
+from .oplog import MAKE_ACTIONS, ACTOR_BITS, OpLog, TAG_COUNTER
 
 _MAKE_OBJ = {0: ObjType.MAP, 2: ObjType.LIST, 4: ObjType.TEXT, 6: ObjType.TABLE}
 _OBJ_REPLACEMENT = "￼"
@@ -70,7 +70,7 @@ class DeviceDoc:
             self._rank_of = {a.bytes: i for i, a in enumerate(log.actors)}
             # object id -> object type, from make ops (+ root)
             self._obj_type: Dict[int, ObjType] = {0: ObjType.MAP}
-            for r in np.flatnonzero(np.isin(log.action[:n], (0, 2, 4, 6))):
+            for r in np.flatnonzero(np.isin(log.action[:n], MAKE_ACTIONS)):
                 self._obj_type[int(log.id_key[r])] = _MAKE_OBJ[int(log.action[r])]
             # row ranges by object
             order = np.argsort(log.obj_key[:n], kind="stable")

@@ -32,6 +32,9 @@ from ..types import ActorId, ScalarValue, str_width
 from ..types import ACTOR_BITS  # noqa: E402
 ACTOR_MASK = (1 << ACTOR_BITS) - 1
 PAD_ACTION = 15
+# the make actions (object-creating ops; reference: types.rs action
+# indices 0/2/4/6) — single authority for the columnar layers
+MAKE_ACTIONS = (0, 2, 4, 6)
 
 # elem_ref sentinels (column is an int32 row index otherwise)
 ELEM_HEAD = -1  # insert at list HEAD
@@ -351,10 +354,7 @@ class OpLog:
         # op's id) — O(#objects log #objects) instead of np.unique's full
         # O(n log n) sort; a log whose ops reference objects with no make
         # op in it (partial histories) falls back to the exact unique.
-        make_rows = np.flatnonzero(
-            (log.action == 0) | (log.action == 2)
-            | (log.action == 4) | (log.action == 6)
-        )
+        make_rows = np.flatnonzero(np.isin(log.action, MAKE_ACTIONS))
         cand = np.unique(np.concatenate([[0], log.id_key[make_rows]]))
         pos = np.searchsorted(cand, obj)
         posc = np.clip(pos, 0, len(cand) - 1)

@@ -175,3 +175,37 @@ def test_scatter_kernel_matches_sort_kernel(name):
         a, b = np.asarray(o1[k]), np.asarray(o2[k])
         assert a.shape == b.shape, (name, k, a.shape, b.shape)
         assert np.array_equal(a, b), (name, k)
+
+
+def test_join_rows_fuzz_and_key_zero():
+    """The extraction join (interpolation + memo) against the numpy oracle,
+    including the key-0 case the memo's empty marker must not alias
+    (review regression) and memo-sized repetitive streams."""
+    rng = np.random.default_rng(5)
+    for trial in range(120):
+        n = int(rng.integers(1, 3000))
+        if trial % 3 == 0:
+            s = np.sort(rng.integers(0, 1 << 40, n).astype(np.int64))
+        elif trial % 3 == 1:  # clustered: adversarial for interpolation
+            s = np.sort(
+                np.concatenate(
+                    [rng.integers(0, 64, n // 2 + 1),
+                     rng.integers(1 << 39, (1 << 39) + 64, n // 2 + 1)]
+                ).astype(np.int64)
+            )[:n]
+        else:  # duplicate-heavy
+            s = np.sort(rng.integers(0, 40, n).astype(np.int64))
+        q = np.concatenate(
+            [rng.choice(s, min(n, 40)), rng.integers(-(1 << 41), 1 << 41, 40)]
+        ).astype(np.int64)
+        got = native.join_rows(s, q, -7)
+        pos = np.searchsorted(s, q)
+        posc = np.clip(pos, 0, n - 1)
+        want = np.where(s[posc] == q, posc, -7).astype(np.int32)
+        assert np.array_equal(got, want), trial
+    # key 0, large repetitive stream (memo active): absent then present
+    s0 = np.sort(rng.integers(1, 1 << 40, 100_000).astype(np.int64))
+    q0 = np.zeros(80_000, np.int64)
+    assert (native.join_rows(s0, q0, -1) == -1).all()
+    s1 = np.unique(np.concatenate([[0], s0]))
+    assert (native.join_rows(s1, q0, -1) == 0).all()
