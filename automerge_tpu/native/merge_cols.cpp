@@ -333,8 +333,10 @@ long long am_join_rows_i64(const int64_t* sorted, int64_t n, const int64_t* q,
   // the table's zero-init (small incremental joins skip it).
   constexpr int64_t kCacheBits = 16;
   constexpr int64_t kEmpty = INT64_MIN;
+  // gate on the TOTAL query count (a per-chunk gate would disable the
+  // memo for mid-size joins exactly when the thread split is active)
+  const bool use_memo = m >= (int64_t)1 << (kCacheBits - 2);
   auto run = [&](int64_t lo, int64_t hi) {
-    const bool use_memo = (hi - lo) >= (int64_t)1 << (kCacheBits - 2);
     std::vector<int64_t> memo_key;
     std::vector<int32_t> memo_val;
     if (use_memo) {
@@ -344,7 +346,9 @@ long long am_join_rows_i64(const int64_t* sorted, int64_t n, const int64_t* q,
     for (int64_t i = lo; i < hi; i++) {
       const int64_t key = q[i];
       size_t slot = 0;
-      if (use_memo) {
+      // key == kEmpty must never consult the memo: a never-written slot
+      // would false-hit on the empty marker
+      if (use_memo && key != kEmpty) {
         slot = (size_t)((uint64_t)(key * 0x9E3779B97F4A7C15ull) >>
                         (64 - kCacheBits));
         if (memo_key[slot] == key) {
@@ -379,7 +383,7 @@ long long am_join_rows_i64(const int64_t* sorted, int64_t n, const int64_t* q,
       }
       const int32_t r = (a < n && sorted[a] == key) ? (int32_t)a : missing;
       out[i] = r;
-      if (use_memo) {
+      if (use_memo && key != kEmpty) {
         memo_key[slot] = key;
         memo_val[slot] = r;
       }

@@ -329,31 +329,45 @@ def main():
     sbase = W.build_base(trace, 2_000)
     n_sync_replicas = max(sync_ops // 2_000, 1)
     sync_changes = W.synth_fanin(sbase, trace, n_sync_replicas, 2_000, 2_000)
-    ahead = AutoDoc.load(sbase.doc.save())
+    base_save = sbase.doc.save()
+    ahead = AutoDoc.load(base_save)
     ahead.apply_changes(sync_changes)
-    behind = AutoDoc.load(sbase.doc.save())
-    s1, s2 = SyncState(), SyncState()
     n_synced = sum(len(c.ops) for c in sync_changes)
-    t0 = time.perf_counter()
-    rounds = 0
-    while True:
-        m1 = ahead.generate_sync_message(s1)
-        m2 = behind.generate_sync_message(s2)
-        if m1 is None and m2 is None:
-            break
-        if m1 is not None:
-            behind.receive_sync_message(s2, m1)
-        if m2 is not None:
-            ahead.receive_sync_message(s1, m2)
-        rounds += 1
-        if rounds > 100:
-            raise RuntimeError("sync did not converge")
-    # one read inside the timed region: op-store materialization is lazy,
-    # so catch-up isn't "done" until the replica is readable
-    behind_text = behind.text(sbase.text_exid)
-    t_sync = time.perf_counter() - t0
-    assert behind.get_heads() == ahead.get_heads()
-    assert behind_text == ahead.text(sbase.text_exid)
+    ahead_text = ahead.text(sbase.text_exid)
+
+    def sync_once():
+        """One full catch-up of a fresh behind replica; returns
+        (seconds, rounds)."""
+        behind = AutoDoc.load(base_save)
+        s1, s2 = SyncState(), SyncState()
+        t0 = time.perf_counter()
+        rounds = 0
+        while True:
+            m1 = ahead.generate_sync_message(s1)
+            m2 = behind.generate_sync_message(s2)
+            if m1 is None and m2 is None:
+                break
+            if m1 is not None:
+                behind.receive_sync_message(s2, m1)
+            if m2 is not None:
+                ahead.receive_sync_message(s1, m2)
+            rounds += 1
+            if rounds > 100:
+                raise RuntimeError("sync did not converge")
+        # one read inside the timed region: op-store materialization is
+        # lazy, so catch-up isn't "done" until the replica is readable
+        behind_text = behind.text(sbase.text_exid)
+        dt = time.perf_counter() - t0
+        assert behind.get_heads() == ahead.get_heads()
+        assert behind_text == ahead_text
+        return dt, rounds
+
+    # best-of-reps like every other config (a fresh replica per rep)
+    t_sync, rounds = sync_once()
+    for _ in range(env_int("BENCH_REPS", 2) - 1):
+        dt, r = sync_once()
+        if dt < t_sync:
+            t_sync, rounds = dt, r
     sync_rate = n_synced / t_sync
     results["sync"] = {
         "divergence_ops": n_synced,

@@ -209,3 +209,13 @@ def test_join_rows_fuzz_and_key_zero():
     assert (native.join_rows(s0, q0, -1) == -1).all()
     s1 = np.unique(np.concatenate([[0], s0]))
     assert (native.join_rows(s1, q0, -1) == 0).all()
+
+
+def test_join_rows_int64_min_key():
+    """INT64_MIN (the memo's empty marker) as a query key must search, not
+    false-hit a pristine slot (review regression); memo active via total
+    query count regardless of the thread split."""
+    rng = np.random.default_rng(9)
+    s = np.sort(rng.integers(1, 1 << 40, 50_000).astype(np.int64))
+    q = np.full(150_000, np.iinfo(np.int64).min, np.int64)
+    assert (native.join_rows(s, q, -3) == -3).all()
