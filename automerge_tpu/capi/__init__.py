@@ -29,6 +29,23 @@ def _lib_name() -> str:
     return f"libautomerge_tpu-{h.hexdigest()[:16]}.so"
 
 
+def _prune_stale(dirname: str, keep: str) -> None:
+    """Remove superseded content-hash cdylib builds (package dir only)."""
+    try:
+        for name in os.listdir(dirname):
+            if (
+                name.startswith("libautomerge_tpu-")
+                and name.endswith(".so")
+                and name != keep
+            ):
+                try:
+                    os.remove(os.path.join(dirname, name))
+                except OSError:
+                    pass
+    except OSError:
+        pass
+
+
 def _embed_flags() -> tuple[list, list]:
     inc = sysconfig.get_path("include")
     libdir = sysconfig.get_config_var("LIBDIR") or ""
@@ -56,6 +73,8 @@ def build(out_dir: Optional[str] = None) -> Optional[str]:
         if r.returncode != 0 or not os.path.exists(tmp):
             return None
         os.replace(tmp, path)
+        if out_dir == _HERE:  # never prune shared/external output dirs
+            _prune_stale(_HERE, os.path.basename(path))
         return path
     except (OSError, subprocess.TimeoutExpired):
         return None

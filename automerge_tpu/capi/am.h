@@ -85,8 +85,12 @@ AMresult *am_merge(AMdoc *doc, AMdoc *other);         /* items: BYTES hashes */
 AMresult *am_get_heads(AMdoc *doc);                   /* items: BYTES */
 AMresult *am_actor_id(AMdoc *doc);                    /* item: BYTES */
 AMresult *am_set_actor_id(AMdoc *doc, const uint8_t *actor, size_t actor_len);
-/* Current-content equality (hydrated trees; histories may differ). */
+/* History-heads equality after autocommit (reference AMequal,
+ * automerge-c doc.rs:42-44): identical content with different histories
+ * compares NOT equal. For content equality use am_equal_content. */
 AMresult *am_equal(AMdoc *doc, AMdoc *other);         /* item: BOOL */
+/* Current-content equality (hydrated trees; histories may differ). */
+AMresult *am_equal_content(AMdoc *doc, AMdoc *other); /* item: BOOL */
 /* Uncommitted op count / discard the open transaction (count discarded). */
 AMresult *am_pending_ops(AMdoc *doc);                 /* item: UINT */
 AMresult *am_rollback(AMdoc *doc);                    /* item: UINT */
@@ -153,6 +157,7 @@ AMresult *am_list_items(AMdoc *doc, const char *obj);
 /* per entry: STR key then the value item (2 items each) */
 AMresult *am_map_entries(AMdoc *doc, const char *obj);
 /* value items for visible indices in [start, end) */
+/* end = SIZE_MAX means unbounded (reference AMlistRange convention). */
 AMresult *am_list_range(AMdoc *doc, const char *obj, size_t start, size_t end);
 /* (STR key, value item) pairs for keys in [begin, end); "" end = unbounded */
 AMresult *am_map_range(AMdoc *doc, const char *obj, const char *begin,

@@ -686,6 +686,11 @@ extern "C" AMresult *am_equal(AMdoc *d, AMdoc *other) {
   return dispatch("equal", args);
 }
 
+extern "C" AMresult *am_equal_content(AMdoc *d, AMdoc *other) {
+  AM_ARGS("(LL)", (long long)d->handle, (long long)other->handle);
+  return dispatch("equal_content", args);
+}
+
 extern "C" AMresult *am_pending_ops(AMdoc *d) {
   AM_ARGS("(L)", (long long)d->handle);
   return dispatch("pending_ops", args);
@@ -722,6 +727,11 @@ extern "C" AMresult *am_get_missing_deps(AMdoc *d, const uint8_t *heads,
 
 extern "C" AMresult *am_list_range(AMdoc *d, const char *o, size_t start,
                                    size_t end) {
+  // reference idiom: end = SIZE_MAX means unbounded (automerge-c
+  // AMlistRange) — clamp before the size_t -> Py_ssize_t narrowing,
+  // which would otherwise turn it into -1 and yield an empty range
+  if (end > (size_t)PY_SSIZE_T_MAX) end = (size_t)PY_SSIZE_T_MAX;
+  if (start > (size_t)PY_SSIZE_T_MAX) start = (size_t)PY_SSIZE_T_MAX;
   AM_ARGS("(Lsnn)", (long long)d->handle, o, (Py_ssize_t)start,
           (Py_ssize_t)end);
   return dispatch("list_range", args);

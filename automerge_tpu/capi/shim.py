@@ -465,8 +465,17 @@ def set_actor(h: int, actor: bytes) -> List[Item]:
 
 
 def equal(h: int, other: int) -> List[Item]:
-    """AMequal: current CONTENT equality (hydrated trees), the reference's
-    document-equality semantic — histories may differ."""
+    """AMequal: get_heads() equality after autocommit (reference:
+    automerge-c doc.rs:42-44 is_equal_to) — same history heads, not
+    content. Two docs with identical content but different histories are
+    NOT equal; see equal_content for the content semantic."""
+    return [(BOOL, 1 if sorted(_doc(h).get_heads()) == sorted(_doc(other).get_heads()) else 0)]
+
+
+def equal_content(h: int, other: int) -> List[Item]:
+    """am_equal_content: current-state content equality (hydrated trees) —
+    an extension beyond the reference's AMequal for callers that want
+    value comparison across divergent histories."""
     return [(BOOL, 1 if _doc(h).hydrate() == _doc(other).hydrate() else 0)]
 
 

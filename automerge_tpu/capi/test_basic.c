@@ -608,6 +608,16 @@ static void test_clone_equal_actor_rollback(void) {
   CHECK_OK(am_commit(c, NULL));
   CHECK(res_int(am_equal(d, c)) == 0);
   am_doc_free(c);
+  /* am_equal is HEADS equality (reference AMequal, doc.rs:42-44): two
+   * docs converging to identical content via different histories are not
+   * equal; am_equal_content compares the hydrated values instead */
+  uint8_t a3[1] = {3};
+  AMdoc *e = am_create(a3, 1);
+  CHECK_OK(am_map_put_int(e, AM_ROOT, "x", 1));
+  CHECK_OK(am_commit(e, NULL));
+  CHECK(res_int(am_equal(d, e)) == 0);
+  CHECK(res_int(am_equal_content(d, e)) == 1);
+  am_doc_free(e);
   am_doc_free(d);
 }
 

@@ -42,6 +42,20 @@ class NativeUnavailable(RuntimeError):
     pass
 
 
+def _prune_stale(dirname: str, prefix: str, keep: str) -> None:
+    """Remove superseded content-hash builds so artifacts don't accumulate
+    (only files matching ``prefix``*.so other than ``keep``)."""
+    try:
+        for name in os.listdir(dirname):
+            if name.startswith(prefix) and name.endswith(".so") and name != keep:
+                try:
+                    os.remove(os.path.join(dirname, name))
+                except OSError:
+                    pass
+    except OSError:
+        pass
+
+
 def _build(lib_path: str) -> bool:
     # compile to a temp path and rename into place: a killed/concurrent
     # build must never leave a partial file at the final (content-hash) name,
@@ -56,6 +70,11 @@ def _build(lib_path: str) -> bool:
         if r.returncode != 0 or not os.path.exists(tmp):
             return False
         os.replace(tmp, lib_path)
+        # prune only the package-local dir: the XDG cache fallback is
+        # shared across checkouts/venvs whose source hashes differ —
+        # deleting siblings there would ping-pong rebuilds between them
+        if os.path.dirname(lib_path) == _HERE:
+            _prune_stale(_HERE, "_codecs-", os.path.basename(lib_path))
         return True
     except (OSError, subprocess.TimeoutExpired):
         return False

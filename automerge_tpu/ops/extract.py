@@ -12,6 +12,7 @@ buffer and materialized lazily on readback.
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -198,6 +199,7 @@ def _np_u8(buf: bytes) -> np.ndarray:
 
 _POOL = None
 _POOL_INIT = False
+_POOL_LOCK = threading.Lock()
 
 
 def _decode_pool():
@@ -206,19 +208,22 @@ def _decode_pool():
     containers report many cpu_count cores they cannot use)."""
     global _POOL, _POOL_INIT
     if not _POOL_INIT:
-        _POOL_INIT = True
-        import os
+        with _POOL_LOCK:
+            if _POOL_INIT:  # lost the race; another thread built it
+                return _POOL
+            import os
 
-        try:
-            n = len(os.sched_getaffinity(0))
-        except AttributeError:  # non-Linux
-            n = os.cpu_count() or 1
-        if n > 1:
-            from concurrent.futures import ThreadPoolExecutor
+            try:
+                n = len(os.sched_getaffinity(0))
+            except AttributeError:  # non-Linux
+                n = os.cpu_count() or 1
+            if n > 1:
+                from concurrent.futures import ThreadPoolExecutor
 
-            _POOL = ThreadPoolExecutor(
-                max_workers=min(8, n), thread_name_prefix="am-decode"
-            )
+                _POOL = ThreadPoolExecutor(
+                    max_workers=min(8, n), thread_name_prefix="am-decode"
+                )
+            _POOL_INIT = True
     return _POOL
 
 

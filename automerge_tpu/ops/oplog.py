@@ -400,12 +400,23 @@ class OpLog:
                     "document has an open manual transaction; commit or "
                     "roll it back before building a device log"
                 )
+            # None means "follow the process default" — resolve it before
+            # comparing, else a default-encoding doc mixed with an
+            # explicit-encoding doc slips past the check
+            from ..types import get_text_encoding
+
+            d_enc = getattr(doc, "text_encoding", None) or get_text_encoding()
             if encoding is None:
-                encoding = getattr(doc, "text_encoding", None)
+                encoding = d_enc
+            elif d_enc != encoding:
+                raise ValueError(
+                    f"documents carry conflicting text encodings "
+                    f"({encoding!r} vs {d_enc!r}); width columns would "
+                    "silently disagree — re-encode one side first"
+                )
             changes.extend(a.stored for a in doc.history)
-        # width columns follow the (first) document's text encoding;
-        # merging documents with conflicting encodings is undefined, as in
-        # the reference where the unit is fixed per build
+        # width columns follow the documents' (verified-uniform) text
+        # encoding; in the reference the unit is fixed per build
         with using_text_encoding(encoding):
             return cls.from_changes(changes)
 

@@ -235,8 +235,9 @@ def main():
                 t_best = min(t_best, dt)
             kernel[f"t_kernel_{name}_s"] = round(t_best, 4)
             kernel[f"kernel_{name}_ops_per_sec"] = round(n / t_best, 1)
+            # per-variant: each variant's timing subtracts its own probe
+            kernel[f"sync_rtt_{name}_s"] = round(rtt, 4)
         kernel["kernel_chain"] = M
-        kernel["sync_rtt_s"] = round(rtt, 4)
         _, arrays = encode_transport(cols_np)
         kernel["transport_bytes_in"] = int(
             sum(a.nbytes for a in arrays.values())
