@@ -31,6 +31,7 @@ _SRCS = [
     os.path.join(_HERE, "extract_batch.cpp"),
     os.path.join(_HERE, "session.cpp"),
     os.path.join(_HERE, "merge_cols.cpp"),
+    os.path.join(_HERE, "assemble.cpp"),
 ]
 _SRC = _SRCS[0]
 
@@ -172,6 +173,21 @@ def load() -> Optional[ctypes.CDLL]:
     lib.am_join_rows_i64.restype = ctypes.c_longlong
     lib.am_join_rows_i64.argtypes = [
         i64p, ctypes.c_int64, i64p, ctypes.c_int64, ctypes.c_int32, i32p,
+    ]
+    lib.am_assemble_log.restype = ctypes.c_longlong
+    lib.am_assemble_log.argtypes = [
+        # per-change metadata (11 i64 arrays), col_ptrs, n_changes
+        i64p, i64p, i64p, i64p, i64p, i64p, i64p, i64p, i64p, i64p, i64p,
+        i64p, ctypes.c_int64,
+        # translation tables + actor_bits + global const-fill directives
+        i64p, i32p, i32p, ctypes.c_int32, i64p, i64p,
+        # row outputs
+        i64p, i64p, i32p, i32p, u8p, u8p, i32p, i64p, i32p, i32p, i32p,
+        i64p, i64p, i32p, i32p, ctypes.c_int64,
+        # pred outputs
+        i32p, i32p, ctypes.c_int64,
+        # obj table + meta
+        i64p, i64p,
     ]
     lib.am_merge_cols.restype = ctypes.c_longlong
     lib.am_merge_cols.argtypes = [

@@ -1,0 +1,527 @@
+"""Per-change decoded-column caches + single-pass native log assembly.
+
+The reference's change chunk is already columnar (change_op_columns.rs);
+decoding it on every merge is pure waste. Each StoredChange therefore
+keeps its decoded, chunk-local column arrays (``cached_cols``), attached
+on first decode — one batched native pass over all uncached changes —
+and a merge assembles the final Lamport-ordered, reference-resolved
+device columns with one native call (native/assemble.cpp):
+
+  counting sort over consecutive-counter runs  ->  O(N) Lamport order
+  column gathers through the emit permutation  ->  no concat middleman
+  change-span reference resolution             ->  O(log C) per ref, not
+                                                   a join against N rows
+
+This is the "commit-time column cache" the fan-in merge rides: replicas
+that built their changes locally (or decoded them once) ship ready
+columns into every subsequent merge.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import native
+from ..errors import AutomergeError
+from ..types import ACTOR_BITS, get_text_encoding
+
+
+class AssembleError(AutomergeError):
+    pass
+
+
+# The gather-heavy columns interleaved as one 40-byte record per op
+# (AoS): the assembler's permuted reads touch ONE cache line per row
+# instead of seven per-change column streams. i64 fields first keeps
+# them 8-aligned (40 % 8 == 0).
+HOT_DTYPE = np.dtype(
+    [
+        ("elem_ctr", "<i8"), ("vlen", "<i8"), ("voff", "<i8"),
+        ("action", "<i4"), ("elem_actor", "<i4"), ("vcode", "<i4"),
+        ("insert", "u1"), ("_pad", "V3"),
+    ]
+)
+assert HOT_DTYPE.itemsize == 40
+
+# shared all-minus-one buffer for changes without a key_str / mark_name
+# column (grown on demand, never shrunk; cache rows only READ [0, n))
+_NEG1_I32 = np.full(1024, -1, np.int32)
+
+
+def _neg1(n: int) -> np.ndarray:
+    global _NEG1_I32
+    if len(_NEG1_I32) < n:
+        _NEG1_I32 = np.full(max(n, 2 * len(_NEG1_I32)), -1, np.int32)
+    return _NEG1_I32
+
+
+class ChangeCols:
+    """One change's decoded, chunk-local op columns (actor columns hold
+    chunk-local indices; string columns hold ids into the attached
+    tables). Arrays are C-contiguous with the exact dtypes the native
+    assembler reads; ``ptr_row`` caches their addresses in the fixed
+    18-slot layout of am_assemble_log."""
+
+    __slots__ = (
+        "n", "q", "obj_ctr", "obj_actor", "obj_has", "key_sid",
+        "expand", "value_int", "width", "width_enc", "mark_sid",
+        "pred_num", "pred_ctr", "pred_actor", "key_table", "mark_table",
+        "vraw", "hot", "_ptrs", "_const",
+    )
+
+    # the gather-heavy columns live ONLY in the hot record (strided views
+    # for host-side consumers); the assembler reads them from the record
+    @property
+    def action(self) -> np.ndarray:
+        return self.hot["action"]
+
+    @property
+    def elem_ctr(self) -> np.ndarray:
+        return self.hot["elem_ctr"]
+
+    @property
+    def elem_actor(self) -> np.ndarray:
+        return self.hot["elem_actor"]
+
+    @property
+    def insert(self) -> np.ndarray:
+        return self.hot["insert"]
+
+    @property
+    def vcode(self) -> np.ndarray:
+        return self.hot["vcode"]
+
+    @property
+    def vlen(self) -> np.ndarray:
+        return self.hot["vlen"]
+
+    @property
+    def voff(self) -> np.ndarray:
+        return self.hot["voff"]
+
+    def const_scan(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(mask, value) per column slot: mask[k] when every row of
+        column k carries the same value. Computed once per cache."""
+        c = self._const
+        if c is None:
+            mask = np.zeros(18, bool)
+            val = np.zeros(18, np.int64)
+            n = self.n
+            cols = {
+                1: self.obj_ctr, 2: self.obj_actor, 3: self.obj_has,
+                4: self.key_sid[:n], 7: self.insert, 8: self.expand,
+                9: self.vcode, 10: self.vlen, 11: self.voff,
+                12: self.value_int, 13: self.width,
+                14: self.mark_sid[:n],
+            }
+            for k, a in cols.items():
+                if n == 0:
+                    continue  # empty changes don't constrain anything
+                v = a[0]
+                if n == 1 or (a == v).all():
+                    mask[k] = True
+                    val[k] = int(v)
+            c = (mask, val)
+            self._const = c
+        return c
+
+    def ptr_row(self) -> np.ndarray:
+        p = self._ptrs
+        if p is None:
+            # slots 0/5/6/7/9/10/11 are served by the hot record; the
+            # assembler never dereferences their cold pointers
+            cols = (
+                None, self.obj_ctr, self.obj_actor, self.obj_has,
+                self.key_sid, None, None, None,
+                self.expand, None, None, None,
+                self.value_int, self.width, self.mark_sid, self.pred_num,
+                self.pred_ctr, self.pred_actor, self.hot,
+            )
+            p = np.fromiter(
+                (0 if a is None else a.ctypes.data for a in cols),
+                dtype=np.int64,
+                count=19,
+            )
+            self._ptrs = p
+        return p
+
+    def ensure_width_encoding(self) -> None:
+        """Recompute text widths if the active encoding differs from the
+        one the cache was built under (reference: text_value.rs — the
+        index unit is a per-document property)."""
+        enc = get_text_encoding()
+        if enc == self.width_enc:
+            return
+        from .extract import _str_widths
+
+        w = _str_widths(self.vraw, self.voff, self.vlen, self.vcode, self.n)
+        self.width = np.ascontiguousarray(w, np.int32)
+        self.width_enc = enc
+        self._ptrs = None
+        self._const = None
+
+
+def _c32(a: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(a, np.int32)
+
+
+def _c64(a: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(a, np.int64)
+
+
+def _c8(a: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(a, np.uint8)
+
+
+def ensure_change_cols(changes: Sequence) -> List[ChangeCols]:
+    """Fetch-or-build every change's column cache.
+
+    Uncached changes are decoded in ONE batched native pass
+    (extract.batch_arrays) and the per-change views attached, so the
+    decode cost is paid once per change object, not per merge."""
+    caches: List[Optional[ChangeCols]] = [
+        getattr(ch, "cached_cols", None) for ch in changes
+    ]
+    missing = [i for i, c in enumerate(caches) if c is None]
+    if missing:
+        from .extract import batch_arrays
+
+        subset = [changes[i] for i in missing]
+        for ch in subset:
+            if ch.op_col_data is None:
+                raise AssembleError("change has no retained column data")
+        a = batch_arrays(subset)
+        built = _split_batch(a, subset)
+        for i, cc in zip(missing, built):
+            changes[i].cached_cols = cc
+            caches[i] = cc
+    enc = get_text_encoding()
+    for cc in caches:
+        if cc.width_enc != enc:
+            cc.ensure_width_encoding()
+    return caches  # type: ignore[return-value]
+
+
+def _split_batch(a: Dict, changes: Sequence) -> List[ChangeCols]:
+    """Slice one batch_arrays output into per-change ChangeCols views."""
+    n_changes = len(changes)
+    row_off = a["row_off"]
+    pred_row_off = a["pred_row_off"]
+    raw_off = a["raw_off"]
+    raw_ln = a["raw_ln"]
+    raw = a["vraw"]
+    enc = get_text_encoding()
+
+    # whole-batch conversions once; per-change slices are COPIED so a
+    # retained change never pins the whole batch's arrays through views
+    N = int(row_off[-1])
+    hot_all = np.empty(N, HOT_DTYPE)
+    # HEAD (no actor) is counter 0; a map op's slot is ignored by C
+    hot_all["elem_ctr"] = np.where(a["key_has_actor"], a["key_ctr"], 0)
+    hot_all["vlen"] = a["vlen"]
+    hot_all["voff"] = a["voff"] - raw_off[a["change_of_row"]]  # chunk-local
+    hot_all["action"] = a["action"]
+    hot_all["elem_actor"] = a["key_actor"]
+    hot_all["vcode"] = a["vcode"]
+    hot_all["insert"] = a["insert"]
+    obj_ctr = _c64(a["obj_ctr"])
+    obj_actor = _c32(a["obj_actor"])
+    obj_has = _c8(a["obj_has"])
+    key_sid = (
+        _c32(a["key_ids"]) if a["key_ids"] is not None else None
+    )
+    expand = _c8(a["expand"])
+    value_int = _c64(a["value_int"])
+    width = _c32(a["width"])
+    mark_sid = (
+        _c32(a["mark_ids"]) if a["mark_ids"] is not None else None
+    )
+    pred_num = _c32(a["pred_num"])
+    pred_ctr = _c64(a["pred_ctr"])
+    pred_actor = _c32(a["pred_actor"])
+    key_table = a["key_table"]
+    mark_table = a["mark_table"]
+
+    out = []
+    for c in range(n_changes):
+        lo, hi = int(row_off[c]), int(row_off[c + 1])
+        plo, phi = int(pred_row_off[c]), int(pred_row_off[c + 1])
+        rlo = int(raw_off[c])
+        cc = ChangeCols()
+        cc.n = hi - lo
+        cc.q = phi - plo
+        cc.hot = hot_all[lo:hi].copy()
+        cc.obj_ctr = obj_ctr[lo:hi].copy()
+        cc.obj_actor = obj_actor[lo:hi].copy()
+        cc.obj_has = obj_has[lo:hi].copy()
+        cc.key_sid = (
+            key_sid[lo:hi].copy() if key_sid is not None else _neg1(cc.n)
+        )
+        cc.expand = expand[lo:hi].copy()
+        cc.value_int = value_int[lo:hi].copy()
+        cc.width = width[lo:hi].copy()
+        cc.width_enc = enc
+        cc.mark_sid = (
+            mark_sid[lo:hi].copy() if mark_sid is not None else _neg1(cc.n)
+        )
+        cc.pred_num = pred_num[lo:hi].copy()
+        cc.pred_ctr = pred_ctr[plo:phi].copy()
+        cc.pred_actor = pred_actor[plo:phi].copy()
+        cc.key_table = key_table if key_sid is not None else None
+        cc.mark_table = mark_table if mark_sid is not None else None
+        cc.vraw = raw[rlo : rlo + int(raw_ln[c])]
+        cc._ptrs = None
+        cc._const = None
+        out.append(cc)
+    return out
+
+
+def _global_const(
+    caches, tab_all, tab_off, tab_size, prop_off, prop_size, prop_remap,
+    mark_off, mark_size, mark_remap, total_raw,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Aggregate per-change constant columns into the assembler's global
+    fill directives (see assemble.cpp g_flags docs): a column is fillable
+    iff every non-empty change is constant AND agrees on the (translated)
+    value."""
+    g_flags = np.zeros(18, np.int64)
+    g_vals = np.zeros(18, np.int64)
+    li = np.asarray([i for i, cc in enumerate(caches) if cc.n > 0], np.int64)
+    if not len(li):
+        return g_flags, g_vals
+    scans = [caches[int(i)].const_scan() for i in li]
+    ms = np.stack([m for m, _ in scans])
+    vs = np.stack([v for _, v in scans])
+    allc = ms.all(axis=0)
+    same = (vs == vs[0]).all(axis=0)
+    for k in (7, 8, 9, 10, 12, 13):
+        if allc[k] and same[k]:
+            g_flags[k] = 1
+            g_vals[k] = vs[0, k]
+    # voff is rebased by per-change raw offsets; fillable only when the
+    # whole value heap is empty (then every local offset is 0)
+    if allc[11] and same[11] and total_raw == 0:
+        g_flags[11] = 1
+        g_vals[11] = vs[0, 11]
+    # object id: translate each change's constant (ctr, local actor, has)
+    # through its actor table and require one global packed value
+    if allc[1] and allc[2] and allc[3]:
+        has = vs[:, 3] != 0
+        oa = vs[:, 2]
+        ts = tab_size[li]
+        if ((~has) | ((oa >= 0) & (oa < ts))).all() and (
+            (~has) | ((vs[:, 1] >= 0) & (vs[:, 1] < (1 << 43)))
+        ).all():
+            packed = np.where(
+                has,
+                (vs[:, 1] << ACTOR_BITS)
+                | tab_all[(tab_off[li] + np.minimum(oa, ts - 1))],
+                0,
+            )
+            if (packed == packed[0]).all():
+                g_flags[1] = 1
+                g_vals[1] = packed[0]
+    # key_sid: all-seq (1) or one shared global map prop (2)
+    if allc[4]:
+        s = vs[:, 4]
+        if (s == -1).all():
+            g_flags[4] = 1
+        elif (s >= 0).all():
+            po = prop_off[li]
+            if (po >= 0).all() and (s < prop_size[li]).all():
+                gp = prop_remap[po + s]
+                if (gp == gp[0]).all():
+                    g_flags[4] = 2
+                    g_vals[4] = gp[0]
+    # mark name: none anywhere, or one shared global mark id
+    if allc[14]:
+        m = vs[:, 14]
+        if (m == -1).all():
+            g_flags[14] = 1
+            g_vals[14] = -1
+        elif (m >= 0).all():
+            mo = mark_off[li]
+            if (mo >= 0).all() and (m < mark_size[li]).all():
+                gm = mark_remap[mo + m]
+                if (gm == gm[0]).all():
+                    g_flags[14] = 1
+                    g_vals[14] = gm[0]
+    return g_flags, g_vals
+
+
+def _remap_tables(
+    caches: Sequence[ChangeCols], table_attr: str
+) -> Tuple[List[str], np.ndarray, np.ndarray, np.ndarray]:
+    """Union per-change string tables into one global table.
+
+    Returns (global table, remap_all, off[c], size[c]) where
+    remap_all[off[c] + local_id] = global id. Tables are memoized by
+    object identity — synthesized/committed batches share one table
+    object across thousands of changes, so the union is built once."""
+    global_of: Dict[str, int] = {}
+    remap_of_table: Dict[int, Tuple[int, int]] = {}  # id(table) -> (off, size)
+    parts: List[np.ndarray] = []
+    off = np.full(len(caches), -1, np.int64)
+    size = np.zeros(len(caches), np.int64)
+    pos = 0
+    for c, cc in enumerate(caches):
+        table = getattr(cc, table_attr)
+        if table is None:
+            continue
+        key = id(table)
+        hit = remap_of_table.get(key)
+        if hit is None:
+            remap = np.fromiter(
+                (
+                    global_of.setdefault(s, len(global_of))
+                    for s in table
+                ),
+                dtype=np.int32,
+                count=len(table),
+            )
+            parts.append(remap)
+            hit = (pos, len(table))
+            remap_of_table[key] = hit
+            pos += len(table)
+        off[c], size[c] = hit
+    remap_all = (
+        np.concatenate(parts) if parts else np.zeros(1, np.int32)
+    )
+    table_list = list(global_of)
+    return table_list, _c32(remap_all), off, size
+
+
+def assemble_log(log, changes: Sequence, rank_of: Dict[bytes, int]):
+    """Fill ``log`` (an empty OpLog with actors/changes set) from cached
+    per-change columns via the native assembler. Raises AssembleError on
+    anything the C fast path rejects; callers fall back to the decode
+    paths, which report canonical errors for malformed input."""
+    lib = native.load()
+    if lib is None or not hasattr(lib, "am_assemble_log"):
+        raise native.NativeUnavailable("native assembler not available")
+    caches = ensure_change_cols(changes)
+    C = len(caches)
+    n_ops = np.fromiter((c.n for c in caches), np.int64, count=C)
+    q_ops = np.fromiter((c.q for c in caches), np.int64, count=C)
+    N = int(n_ops.sum())
+    Q = int(q_ops.sum())
+    start_op = np.fromiter((ch.start_op for ch in changes), np.int64, count=C)
+    if N and int((start_op + n_ops).max()) - 1 >= (1 << 43):
+        raise AssembleError("counter outside packed range")
+
+    # per-merge actor translation: chunk-local index -> global rank
+    tab_parts = [
+        [rank_of[bytes(a)] for a in ch.actors] for ch in changes
+    ]
+    tab_size = np.fromiter((len(t) for t in tab_parts), np.int64, count=C)
+    tab_off = np.concatenate([[0], np.cumsum(tab_size)])[:-1].astype(np.int64)
+    tab_all = np.fromiter(
+        (r for t in tab_parts for r in t), np.int64, count=int(tab_size.sum())
+    )
+    author = tab_all[tab_off] if C else np.empty(0, np.int64)
+
+    props, prop_remap, prop_off, prop_size = _remap_tables(caches, "key_table")
+    marks, mark_remap, mark_off, mark_size = _remap_tables(caches, "mark_table")
+
+    # value raw heap: concatenate per-change buffers; C rebases offsets
+    raw_base = np.zeros(C, np.int64)
+    pos = 0
+    for c, cc in enumerate(caches):
+        raw_base[c] = pos
+        pos += len(cc.vraw)
+    raw_all = b"".join(cc.vraw for cc in caches)
+
+    col_ptrs = np.empty((C, 19), np.int64)
+    for c, cc in enumerate(caches):
+        col_ptrs[c] = cc.ptr_row()
+
+    g_flags, g_vals = _global_const(
+        caches, tab_all, tab_off, tab_size, prop_off, prop_size, prop_remap,
+        mark_off, mark_size, mark_remap, len(raw_all),
+    )
+
+    # outputs
+    id_key = np.empty(N, np.int64)
+    obj_key = np.empty(N, np.int64)
+    prop = np.empty(N, np.int32)
+    action = np.empty(N, np.int32)
+    insert = np.empty(N, np.uint8)
+    expand = np.empty(N, np.uint8)
+    value_tag = np.empty(N, np.int32)
+    value_int = np.empty(N, np.int64)
+    width = np.empty(N, np.int32)
+    mark_idx = np.empty(N, np.int32)
+    vcode = np.empty(N, np.int32)
+    voff = np.empty(N, np.int64)
+    vlen = np.empty(N, np.int64)
+    elem_ref = np.empty(N, np.int32)
+    obj_dense = np.empty(N, np.int32)
+    pred_src = np.empty(max(Q, 1), np.int32)
+    pred_tgt = np.empty(max(Q, 1), np.int32)
+    obj_table_buf = np.empty(N + 1, np.int64)
+    out_meta = np.zeros(4, np.int64)
+
+    if N:
+        rc = lib.am_assemble_log(
+            native._i64(n_ops), native._i64(q_ops), native._i64(start_op),
+            native._i64(author), native._i64(tab_off), native._i64(tab_size),
+            native._i64(prop_off), native._i64(prop_size),
+            native._i64(mark_off), native._i64(mark_size),
+            native._i64(raw_base), native._i64(col_ptrs.reshape(-1)), C,
+            native._i64(tab_all), native._i32(prop_remap),
+            native._i32(mark_remap), ACTOR_BITS,
+            native._i64(g_flags), native._i64(g_vals),
+            native._i64(id_key), native._i64(obj_key), native._i32(prop),
+            native._i32(action), native._u8(insert), native._u8(expand),
+            native._i32(value_tag), native._i64(value_int),
+            native._i32(width), native._i32(mark_idx), native._i32(vcode),
+            native._i64(voff), native._i64(vlen), native._i32(elem_ref),
+            native._i32(obj_dense), N,
+            native._i32(pred_src), native._i32(pred_tgt), Q,
+            native._i64(obj_table_buf), native._i64(out_meta),
+        )
+        if rc < 0:
+            raise AssembleError(f"native assembler rejected input ({rc})")
+    else:
+        rc = 0
+        obj_table_buf[0] = 0
+        out_meta[0] = 1
+
+    from .extract import LazyValues
+
+    log.n = N
+    log.props = props
+    log.mark_names = marks
+    log.id_key = id_key
+    log.obj_key = obj_key
+    log.prop = prop
+    log.action = action
+    log.insert = insert.view(np.bool_)
+    log.expand = expand.view(np.bool_)
+    log.value_tag = value_tag
+    log.value_int = value_int
+    log.width = width
+    log.mark_name_idx = mark_idx
+    log.values = LazyValues(vcode, voff, vlen, raw_all)
+    log.elem_ref = elem_ref
+    log.pred_src = pred_src[:Q]
+    log.pred_tgt = pred_tgt[:Q]
+    if rc == 1:
+        # partial history: some object id has no make op in this log —
+        # fall back to the exact unique, still unioned with the make ids
+        # so childless objects resolve identically on both paths
+        # (mirrors oplog._finalize)
+        from .oplog import MAKE_ACTIONS
+
+        make_ids = id_key[np.isin(action, MAKE_ACTIONS)]
+        obj_table = np.unique(np.concatenate([[0], make_ids, obj_key]))
+        log.obj_table = obj_table
+        log.obj_dense = np.searchsorted(obj_table, obj_key).astype(np.int32)
+        log.n_objs = len(obj_table)
+    else:
+        log.n_objs = int(out_meta[0])
+        log.obj_table = obj_table_buf[: log.n_objs].copy()
+        log.obj_dense = obj_dense
+    return log

@@ -405,6 +405,8 @@ def batch_arrays(changes) -> Dict[str, object]:
         "n": N,
         "n_ops": n_ops,
         "row_off": row_off,
+        "raw_off": raw_off,
+        "raw_ln": raw_ln,
         "change_of_row": change_of_row,
         "action": action.astype(np.int32),
         "obj_ctr": np.where(obj_mask, obj_ctr, 0),

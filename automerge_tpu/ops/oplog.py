@@ -160,12 +160,28 @@ class OpLog:
             from .. import native
 
             fast = native.available() and all(
-                ch.op_col_data is not None for ch in deduped
+                ch.op_col_data is not None or ch.cached_cols is not None
+                for ch in deduped
             )
         if fast:
             from .. import native
+            from .assemble import AssembleError, assemble_log
             from .extract import ExtractError
 
+            try:
+                return assemble_log(log, deduped, rank_of)
+            except (
+                AssembleError, ExtractError, native.NativeUnavailable,
+                ValueError,
+            ) as e:
+                if os.environ.get("AUTOMERGE_TPU_DEBUG"):
+                    raise
+                warnings.warn(
+                    f"native log assembly failed ({e!r}); "
+                    "falling back to the batch extraction path",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
             try:
                 return cls._collect_fast(log, deduped, rank_of)
             except (ExtractError, native.NativeUnavailable, ValueError) as e:

@@ -101,6 +101,10 @@ class StoredChange:
     # Raw op-column bytes (spec -> bytes), kept for the vectorized
     # column-to-array extraction path (ops/extract.py).
     op_col_data: Optional[dict] = None
+    # Decoded chunk-local column arrays (ops/assemble.ChangeCols),
+    # attached at commit time or memoized on first decode so merges
+    # never re-decode the chunk (the "commit-time column cache").
+    cached_cols: Optional[object] = None
 
     @property
     def actors(self) -> List[bytes]:

@@ -1,0 +1,214 @@
+"""Native log assembly (ops/assemble.py) vs the decode fallback paths.
+
+The assembler is the merge hot path: per-change cached columns ->
+Lamport-ordered resolved device columns in one native call. These tests
+pin its output to the batch-extraction and per-op python paths on every
+workload shape, and exercise the edges (partial history, cache reuse,
+empty logs, degenerate counter ranges).
+"""
+
+from __future__ import annotations
+
+import warnings
+
+import numpy as np
+import pytest
+
+import automerge_tpu.ops.assemble as A
+from automerge_tpu import native
+from automerge_tpu.api import AutoDoc
+from automerge_tpu.ops import OpLog
+from automerge_tpu.types import ActorId, ObjType, ScalarValue
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native core unavailable"
+)
+
+FIELDS = (
+    "id_key", "obj_key", "prop", "elem_ref", "action", "insert",
+    "value_tag", "value_int", "width", "expand", "mark_name_idx",
+    "pred_src", "pred_tgt", "obj_dense", "obj_table",
+)
+
+
+def assemble(changes):
+    for ch in changes:
+        ch.cached_cols = None
+    import os
+
+    os.environ["AUTOMERGE_TPU_DEBUG"] = "1"
+    try:
+        return OpLog.from_changes(changes)
+    finally:
+        os.environ.pop("AUTOMERGE_TPU_DEBUG", None)
+
+
+def fallback(changes, slow=False):
+    if slow:
+        return OpLog.from_changes(changes, fast=False)
+    orig = A.assemble_log
+
+    def boom(*a, **k):
+        raise A.AssembleError("disabled for differential test")
+
+    A.assemble_log = boom
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            return OpLog.from_changes(changes)
+    finally:
+        A.assemble_log = orig
+
+
+def assert_logs_equal(log_a, log_b):
+    assert log_a.n == log_b.n
+    assert log_a.n_objs == log_b.n_objs
+    for f in FIELDS:
+        va = np.asarray(getattr(log_a, f))
+        vb = np.asarray(getattr(log_b, f))
+        assert np.array_equal(va, vb), f
+    # string tables may be ordered differently; resolved strings must match
+    pa = [log_a.props[i] if i >= 0 else None for i in log_a.prop]
+    pb = [log_b.props[i] if i >= 0 else None for i in log_b.prop]
+    assert pa == pb
+    ma = [log_a.mark_names[i] if i >= 0 else None for i in log_a.mark_name_idx]
+    mb = [log_b.mark_names[i] if i >= 0 else None for i in log_b.mark_name_idx]
+    assert ma == mb
+    step = max(log_a.n // 97, 1)
+    for r in range(0, log_a.n, step):
+        assert log_a.values[r] == log_b.values[r]
+
+
+def rich_doc():
+    d = AutoDoc(actor=ActorId(bytes([5]) * 16))
+    t = d.put_object("_root", "text", ObjType.TEXT)
+    d.splice_text(t, 0, 0, "hello \U0001F600 world")
+    d.mark(t, 0, 5, "bold", True)
+    m = d.put_object("_root", "cfg", ObjType.MAP)
+    d.put(m, "a", 1)
+    d.put(m, "c", ScalarValue("counter", 3))
+    d.increment(m, "c", 4)
+    lst = d.put_object("_root", "l", ObjType.LIST)
+    d.insert(lst, 0, "x")
+    d.insert(lst, 1, 2.5)
+    d.commit()
+    e = d.fork()
+    e.splice_text(t, 2, 3, "XYZ")
+    e.commit()
+    d.put(m, "a", 2)
+    d.commit()
+    d.merge(e)
+    return d, t
+
+
+def test_matches_fallback_on_rich_doc():
+    d, _ = rich_doc()
+    changes = [a.stored for a in d.doc.history]
+    log_a = assemble(changes)
+    assert_logs_equal(log_a, fallback(changes))
+    assert_logs_equal(log_a, fallback(changes, slow=True))
+
+
+def test_matches_fallback_after_save_load_roundtrip():
+    d, _ = rich_doc()
+    loaded = AutoDoc.load(d.save())
+    changes = [a.stored for a in loaded.doc.history]
+    assert_logs_equal(assemble(changes), fallback(changes))
+
+
+def test_partial_history_obj_fallback():
+    """A log missing the make op of a referenced object must still build,
+    with the object table unioned exactly like the python paths."""
+    d = AutoDoc(actor=ActorId(bytes([7]) * 16))
+    m = d.put_object("_root", "m", ObjType.MAP)
+    d.commit()
+    d.put(m, "x", 1)
+    d.put(m, "y", 2)
+    d.commit()
+    changes = [a.stored for a in d.doc.history]
+    partial = changes[1:]  # drop the change holding the make op
+    log_a = assemble(partial)
+    log_b = fallback(partial)
+    assert_logs_equal(log_a, log_b)
+    assert log_a.n_objs == 2  # root + the foreign object id
+
+
+def test_cache_reused_across_merges():
+    d, _ = rich_doc()
+    changes = [a.stored for a in d.doc.history]
+    log1 = assemble(changes)
+    caches = [ch.cached_cols for ch in changes]
+    assert all(c is not None for c in caches)
+    log2 = OpLog.from_changes(changes)
+    # same cache objects, not re-decoded
+    assert [ch.cached_cols for ch in changes] == caches
+    assert_logs_equal(log1, log2)
+
+
+def test_empty_and_single_change():
+    assert OpLog.from_changes([]).n == 0
+    d = AutoDoc(actor=ActorId(bytes([9]) * 16))
+    d.put("_root", "k", 1)
+    d.commit()
+    changes = [a.stored for a in d.doc.history]
+    assert_logs_equal(assemble(changes), fallback(changes))
+
+
+def test_degenerate_counter_range_uses_comparator_sort():
+    """A sparse counter range far beyond max(4N, 2^22) must route the
+    Lamport ordering through the comparator-sort branch and still match
+    the fallback exactly."""
+    from automerge_tpu.storage.change import (
+        ChangeOp, Key, StoredChange, build_change,
+    )
+
+    def synth(actor: bytes, start_op: int, keys):
+        ops = [
+            ChangeOp(
+                obj=(0, 0),
+                key=Key.map(k),
+                insert=False,
+                action=1,  # put
+                value=ScalarValue("int", i),
+            )
+            for i, k in enumerate(keys)
+        ]
+        return build_change(
+            StoredChange(
+                dependencies=[], actor=actor, other_actors=[], seq=1,
+                start_op=start_op, timestamp=0, message=None, ops=ops,
+            )
+        )
+
+    # interleaved ranks at wildly separated counters: range ~ 2^34 >>
+    # max(4N, 2^22) forces the std::sort path in assemble.cpp
+    changes = [
+        synth(b"\x01" * 8, 1, ["a", "b", "c"]),
+        synth(b"\x02" * 8, 1 << 34, ["d", "e"]),
+        synth(b"\x03" * 8, 5, ["f", "g", "h", "i"]),
+        synth(b"\x02" * 8 + b"x", (1 << 34) + 1, ["j"]),
+    ]
+    log_a = assemble(changes)
+    log_b = fallback(changes)
+    assert_logs_equal(log_a, log_b)
+    # sanity: ordering really is by (counter, actor-rank)
+    assert np.all(np.diff(np.asarray(log_a.id_key)) > 0)
+
+
+def test_conflicting_width_encoding_recomputed():
+    from automerge_tpu.types import using_text_encoding
+
+    d = AutoDoc(actor=ActorId(bytes([11]) * 16))
+    t = d.put_object("_root", "t", ObjType.TEXT)
+    d.splice_text(t, 0, 0, "a\U0001F600b")  # 4-byte emoji
+    d.commit()
+    changes = [a.stored for a in d.doc.history]
+    with using_text_encoding("utf8"):
+        log8 = assemble(changes)
+        w8 = log8.width[np.asarray(log8.value_tag) == 6]
+    # same cached changes, different active unit: widths must follow it
+    with using_text_encoding("utf16"):
+        log16 = OpLog.from_changes(changes)
+        w16 = log16.width[np.asarray(log16.value_tag) == 6]
+    assert w8.tolist() == [1, 4, 1]  # utf8 bytes
+    assert w16.tolist() == [1, 2, 1]  # utf16 units (surrogate pair)
