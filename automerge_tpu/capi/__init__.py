@@ -86,7 +86,7 @@ def build(out_dir: Optional[str] = None) -> Optional[str]:
                 pass
 
 
-TEST_SOURCES = ("test_am.c", "test_basic.c", "test_sync.c")
+TEST_SOURCES = ("test_am.c", "test_basic.c", "test_sync.c", "test_ported2.c")
 
 
 def build_test(

@@ -406,12 +406,18 @@ class Transaction:
         obj_id = self._obj(obj)
         info = self.doc.ops.get_obj(obj_id)
         if isinstance(info.data, MapObject):
-            key_idx = self.doc.props.lookup(prop) if isinstance(prop, str) else None
+            if not isinstance(prop, str):
+                raise AutomergeError(
+                    f"map delete requires a string key, got {prop!r}"
+                )
+            key_idx = self.doc.props.lookup(prop)
+            # deleting a missing key is a silent no-op (reference:
+            # transaction/inner.rs:422-423 — empty ops + Delete -> Ok(None))
             if key_idx is None:
-                raise AutomergeError(f"cannot delete missing key {prop!r}")
+                return
             pred = self._pred_for_map(obj_id, key_idx)
             if not pred:
-                raise AutomergeError(f"cannot delete missing key {prop!r}")
+                return
             op = Op(
                 id=self._next_id(),
                 action=Action.DELETE,

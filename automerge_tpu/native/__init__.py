@@ -32,6 +32,7 @@ _SRCS = [
     os.path.join(_HERE, "session.cpp"),
     os.path.join(_HERE, "merge_cols.cpp"),
     os.path.join(_HERE, "assemble.cpp"),
+    os.path.join(_HERE, "condense.cpp"),
 ]
 _SRC = _SRCS[0]
 
@@ -173,6 +174,11 @@ def load() -> Optional[ctypes.CDLL]:
     lib.am_join_rows_i64.restype = ctypes.c_longlong
     lib.am_join_rows_i64.argtypes = [
         i64p, ctypes.c_int64, i64p, ctypes.c_int64, ctypes.c_int32, i32p,
+    ]
+    lib.am_chain_condense.restype = ctypes.c_longlong
+    lib.am_chain_condense.argtypes = [
+        i32p, i32p, i32p, u8p, ctypes.c_int64, ctypes.c_int64,
+        i32p, i32p, i32p, i32p, i32p, i32p, i32p, i32p,
     ]
     lib.am_assemble_log.restype = ctypes.c_longlong
     lib.am_assemble_log.argtypes = [
@@ -586,6 +592,51 @@ def preorder_index(
     if r < 0:
         raise ValueError("cyclic element structure in preorder walk")
     return out
+
+
+def chain_condense(
+    first_child: np.ndarray, next_sib: np.ndarray, parent: np.ndarray,
+    is_elem: np.ndarray, P: int, n_objs: int,
+):
+    """Collapse first-child chains of the sibling forest (condense.cpp).
+
+    Returns (R, per-element {chain_id, offset}, per-chain {head, len,
+    tail_ans, cpar, centry} trimmed to R, start_chain[n_objs]). The
+    condensed graph is what the mesh ranks with O(R) collectives per
+    doubling step (parallel/sharding.py)."""
+    lib = load()
+    if lib is None or not hasattr(lib, "am_chain_condense"):
+        raise NativeUnavailable("native condense not available")
+    fc = np.ascontiguousarray(first_child, np.int32)
+    ns = np.ascontiguousarray(next_sib, np.int32)
+    pa = np.ascontiguousarray(parent, np.int32)
+    ie = np.ascontiguousarray(is_elem, np.uint8)
+    chain_id = np.empty(max(P, 1), np.int32)
+    offset = np.empty(max(P, 1), np.int32)
+    head = np.empty(max(P, 1), np.int32)
+    length = np.empty(max(P, 1), np.int32)
+    tail_ans = np.empty(max(P, 1), np.int32)
+    cpar = np.empty(max(P, 1), np.int32)
+    centry = np.empty(max(P, 1), np.int32)
+    start_chain = np.empty(max(n_objs, 1), np.int32)
+    R = lib.am_chain_condense(
+        _i32(fc), _i32(ns), _i32(pa), _u8(ie), P, n_objs,
+        _i32(chain_id), _i32(offset), _i32(head), _i32(length),
+        _i32(tail_ans), _i32(cpar), _i32(centry), _i32(start_chain),
+    )
+    if R < 0:
+        raise ValueError("cyclic element structure in chain condensation")
+    R = int(R)
+    return R, {
+        "chain_id": chain_id[:P],
+        "offset": offset[:P],
+        "head": head[:R],
+        "len": length[:R],
+        "tail_ans": tail_ans[:R],
+        "cpar": cpar[:R],
+        "centry": centry[:R],
+        "start_chain": start_chain[:n_objs],
+    }
 
 
 def _splice_error(code: int):

@@ -1,0 +1,97 @@
+// Chain condensation for document-order ranking.
+//
+// Preorder over the sibling forest is a linked list: succ(v) = first
+// child if any, else the next sibling of the nearest ancestor that has
+// one (the reference resolves the same order one op at a time through
+// query/insert.rs). Maximal FIRST-CHILD chains are contiguous runs of
+// that list, and — because a non-first child is always a chain head —
+// the condensed successor graph is chain-to-chain. Collapsing chains
+// shrinks the iterative ranking problem from N elements to R chains
+// (typing runs make R << N), which is what lets the multi-chip path
+// move O(R)-sized collectives per doubling step instead of O(N)
+// (parallel/sharding.py) and the all-device kernel gather R-sized
+// arrays (ops/merge.py).
+//
+// This pass is one sequential O(N) walk on the host; the iterative
+// (log-depth) ranking it feeds stays on the device mesh.
+
+#include <cstddef>
+#include <cstdint>
+#include <vector>
+
+extern "C" {
+
+// Node space follows ops/merge.py forest(): rows [0,P) are ops, [P,
+// P+n_objs) object roots, last slot the sentinel. parent_row is
+// node-space (root parents >= P); is_elem marks insert rows.
+//
+// Outputs (caller-allocated): per element chain_id/offset (-1/0 for
+// non-elements); per chain (capacity P): head row, length, tail_ans
+// (next sibling of the deepest sibling-bearing member — the climb's
+// within-chain answer), cpar (chain of the head's parent, -1 when the
+// parent is an object root = the climb terminates), centry (the
+// within-chain climb answer at the head's parent's offset);
+// start_chain[o] = chain of object o's first child (-1 when empty).
+// Returns R (chain count), or -1 on malformed structure.
+long long am_chain_condense(const int32_t* first_child,
+                            const int32_t* next_sib,
+                            const int32_t* parent_row,
+                            const uint8_t* is_elem, int64_t P,
+                            int64_t n_objs, int32_t* chain_id,
+                            int32_t* offset, int32_t* chain_head,
+                            int32_t* chain_len, int32_t* chain_tail_ans,
+                            int32_t* chain_cpar, int32_t* chain_centry,
+                            int32_t* start_chain) {
+  std::vector<int32_t> prefix_ans((size_t)P, -1);
+  for (int64_t v = 0; v < P; v++) {
+    chain_id[v] = -1;
+    offset[v] = 0;
+  }
+  int64_t R = 0;
+  for (int64_t v = 0; v < P; v++) {
+    if (!is_elem[v]) continue;
+    const int32_t p = parent_row[v];
+    // head: parent is an object root, or v is not its parent's first
+    // child (non-first children always start a chain)
+    const bool head = p >= P || first_child[p] != v;
+    if (!head) continue;
+    const int64_t c = R++;
+    chain_head[c] = (int32_t)v;
+    int32_t carry = -1;
+    int64_t u = v, o = 0;
+    for (;;) {
+      if (chain_id[u] != -1) return -1;  // fc cycle: malformed forest
+      chain_id[u] = (int32_t)c;
+      offset[u] = (int32_t)o;
+      if (next_sib[u] >= 0) carry = next_sib[u];
+      prefix_ans[u] = carry;
+      const int32_t fc = first_child[u];
+      if (fc < 0 || fc >= P) break;  // tail (roots never appear as fc)
+      u = fc;
+      o++;
+    }
+    chain_len[c] = (int32_t)(o + 1);
+    chain_tail_ans[c] = carry;
+  }
+  // every element must have been claimed by exactly one walk
+  for (int64_t v = 0; v < P; v++)
+    if (is_elem[v] && chain_id[v] < 0) return -1;
+  // second pass: parent links (the parent's chain may have any id)
+  for (int64_t c = 0; c < R; c++) {
+    const int32_t p = parent_row[chain_head[c]];
+    if (p >= P) {
+      chain_cpar[c] = -1;
+      chain_centry[c] = -1;
+    } else {
+      chain_cpar[c] = chain_id[p];
+      chain_centry[c] = prefix_ans[p];
+    }
+  }
+  for (int64_t o = 0; o < n_objs; o++) {
+    const int32_t fc = first_child[P + o];
+    start_chain[o] = (fc >= 0 && fc < P) ? chain_id[fc] : -1;
+  }
+  return R;
+}
+
+}  // extern "C"

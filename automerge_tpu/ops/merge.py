@@ -790,9 +790,12 @@ def merge_columns(cols_np, linearize: str = "auto", fetch=None, n_objs=None,
     # kernel (device) and the O(n) native host merge (merge_cols.cpp).
     # A remote accelerator behind a thin link is round-trip-bound — ~0.3s
     # of transport minimum — while the host engine runs ~25ms/M ops, so
-    # below AUTOMERGE_TPU_HOST_MERGE_MAX rows (default 4M, tuned for
-    # tunnel-attached devices; set 0 on PCIe/DMA-attached hosts) the host
-    # engine wins end to end. AUTOMERGE_TPU_ENGINE=jax|native overrides.
+    # below AUTOMERGE_TPU_HOST_MERGE_MAX rows (default 16M; set 0 on
+    # PCIe/DMA-attached hosts) the host engine wins end to end. On a
+    # tunnel-attached device the threshold only bounds host memory:
+    # transport cost per row exceeds the O(n) host merge cost per row at
+    # EVERY size, so there is no crossover where the device path wins
+    # e2e. AUTOMERGE_TPU_ENGINE=jax|native overrides.
     # The CPU backend keeps the jax path so tests exercise the kernel.
     engine = os.environ.get("AUTOMERGE_TPU_ENGINE", "auto")
 
@@ -813,7 +816,7 @@ def merge_columns(cols_np, linearize: str = "auto", fetch=None, n_objs=None,
             engine == "native"
             or (
                 len(cols_np["action"])
-                <= int(os.environ.get("AUTOMERGE_TPU_HOST_MERGE_MAX", 1 << 22))
+                <= int(os.environ.get("AUTOMERGE_TPU_HOST_MERGE_MAX", 1 << 24))
                 and _backend_is_accel()
             )
         )

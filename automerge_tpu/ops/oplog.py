@@ -532,19 +532,11 @@ def _pad(a: np.ndarray, size: int, fill) -> np.ndarray:
     return out
 
 
-def host_linearize(cols_np) -> np.ndarray:
-    """Document-order element indices computed host-side from the numpy
-    columns, overlapping the device kernel.
-
-    Element order depends ONLY on the insert forest (elem_ref / insert /
-    obj_dense) — never on visibility (historical views of one log share
-    one element order) — so the host can rank it from the same arrays it
-    just uploaded, with zero extra device traffic: a lexsort builds the
-    sibling lists (descending Lamport = descending row,
-    reference query/insert.rs) and the native preorder walk ranks them.
-    """
-    from .. import native
-
+def host_forest(cols_np):
+    """Sibling forest (is_elem, parent_row, first_child, next_sib) from
+    numpy columns — the host mirror of ops/merge.py forest(). Children
+    order is descending row (= descending Lamport, query/insert.rs),
+    built with one lexsort."""
     action = np.asarray(cols_np["action"])
     P = len(action)
     insert = np.asarray(cols_np["insert"]).astype(bool) & (action != PAD_ACTION)
@@ -573,5 +565,22 @@ def host_linearize(cols_np) -> np.ndarray:
         same = np.concatenate([sp[1:] == sp[:-1], [False]])
         nxt = np.concatenate([sr[1:], np.array([-1], np.int32)])
         next_sib[sr] = np.where(same, nxt, -1)
+    return insert, parent_row, first_child, next_sib
+
+
+def host_linearize(cols_np) -> np.ndarray:
+    """Document-order element indices computed host-side from the numpy
+    columns, overlapping the device kernel.
+
+    Element order depends ONLY on the insert forest (elem_ref / insert /
+    obj_dense) — never on visibility (historical views of one log share
+    one element order) — so the host can rank it from the same arrays it
+    just uploaded, with zero extra device traffic: a lexsort builds the
+    sibling lists and the native preorder walk ranks them.
+    """
+    from .. import native
+
+    insert, parent_row, first_child, next_sib = host_forest(cols_np)
+    P = len(insert)
     elem_index = native.preorder_index(first_child, next_sib, parent_row, P)
     return np.where(insert, elem_index, np.int32(-1))

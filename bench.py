@@ -120,7 +120,8 @@ def main():
     del doc, doc_b
 
     # ---- config 2: N-way fan-in merge (primary) ----------------------------
-    base_edits = env_int("BENCH_BASE_EDITS", 120_000)
+    # BASELINE.json sizes: forks of the FULL 259,778-edit trace document
+    base_edits = env_int("BENCH_BASE_EDITS", len(trace))
     n_replicas = env_int("BENCH_REPLICAS", 1024)
     fork_edits = env_int("BENCH_FORK_EDITS", 250)
     t0 = time.perf_counter()
@@ -211,16 +212,15 @@ def main():
         # that costs is measured separately and subtracted, and M chained
         # kernel launches amortize the residual.
         M = env_int("BENCH_KERNEL_CHAIN", 4)
-        variants = [("full", merge_kernel), ("core", merge_kernel_core)]
-        if scatter_geometry_ok(
-            len(cols_np["action"]), log.n_objs, len(log.props)
-        ):
-            variants.append(
-                ("scatter", scatter_kernel_core(log.n_objs, len(log.props)))
-            )
-        for name, fn in variants:
+
+        def _sync(o):
+            return float(np.asarray(o["obj_vis_len"][0]))
+
+        def time_kernel(fn, host_work=None):
+            """Warm + rtt-probe + best-of-reps of M chained launches;
+            ``host_work`` (if given) runs between dispatch and sync each
+            launch — the host-overlap the production pipeline uses."""
             out = fn(cols_dev)  # compile + warm
-            _sync = lambda o: float(np.asarray(o["obj_vis_len"][0]))
             _sync(out)
             t0 = time.perf_counter()
             _sync(out)
@@ -229,10 +229,24 @@ def main():
             for _ in range(env_int("BENCH_REPS", 2) + 1):
                 t0 = time.perf_counter()
                 for _ in range(M):
-                    out = fn(cols_dev)
+                    out = fn(cols_dev)  # async dispatch
+                    if host_work is not None:
+                        host_work()
                 _sync(out)
                 dt = max(time.perf_counter() - t0 - rtt, 1e-9) / M
                 t_best = min(t_best, dt)
+            return t_best, rtt
+
+        have_scatter = scatter_geometry_ok(
+            len(cols_np["action"]), log.n_objs, len(log.props)
+        )
+        variants = [("full", merge_kernel), ("core", merge_kernel_core)]
+        if have_scatter:
+            variants.append(
+                ("scatter", scatter_kernel_core(log.n_objs, len(log.props)))
+            )
+        for name, fn in variants:
+            t_best, rtt = time_kernel(fn)
             kernel[f"t_kernel_{name}_s"] = round(t_best, 4)
             kernel[f"kernel_{name}_ops_per_sec"] = round(n / t_best, 1)
             # per-variant: each variant's timing subtracts its own probe
@@ -242,15 +256,25 @@ def main():
         kernel["transport_bytes_in"] = int(
             sum(a.nbytes for a in arrays.values())
         )
-        # headline kernel number = the resolution kernel the hybrid
-        # pipeline actually runs on device: the sort-free scatter kernel
-        # when the group-table geometry allows it (production selects it
-        # the same way), else the sort-based core; "full" adds device-side
-        # linearization, which production overlaps on host instead
-        # (ops/merge.py host_linearize)
-        best_core = kernel.get(
-            "kernel_scatter_ops_per_sec", kernel["kernel_core_ops_per_sec"]
+        # "pipeline": what production actually runs — the resolution
+        # kernel on device OVERLAPPED with the host preorder ranking
+        # (ops/merge.py host_linearize supplies elem_index). This number
+        # INCLUDES document ordering, unlike the scatter/core variants,
+        # and is the reported kernel number.
+        from automerge_tpu.ops.oplog import host_linearize
+
+        pipe_fn = variants[-1][1] if have_scatter else merge_kernel_core
+        t_best, rtt = time_kernel(
+            pipe_fn, host_work=lambda: host_linearize(cols_np)
         )
+        kernel["t_kernel_pipeline_s"] = round(t_best, 4)
+        kernel["kernel_pipeline_ops_per_sec"] = round(n / t_best, 1)
+        kernel["sync_rtt_pipeline_s"] = round(rtt, 4)
+        # headline kernel number = the pipeline (resolution + ordering).
+        # The scatter/core variants above isolate the device resolution
+        # phase; "full" is the all-device path whose ranking gathers are
+        # the known-weak spot (BASELINE.md).
+        best_core = kernel["kernel_pipeline_ops_per_sec"]
         kernel["kernel_ops_per_sec"] = best_core
         kernel["kernel_vs_baseline"] = round(best_core / baseline_rate, 3)
         note(f"fanin kernel-only: {kernel}")
@@ -266,13 +290,19 @@ def main():
         "native_seq_apply_ops_per_sec": round(native_rate, 1),
         "host_python_ops_per_sec": round(host_rate, 1),
         "baseline_ops_per_sec": round(baseline_rate, 1),
+        # vs the measured decode+apply model (conservative: the model is
+        # faster than the Rust reference — no B-tree, no index upkeep)
         "vs_baseline": round(dev_rate / baseline_rate, 3),
+        # vs the pinned Rust apply_changes estimate (BASELINE.md) — the
+        # divisor BASELINE.json's >=50x target is phrased against
+        "vs_pin": round(dev_rate / RUST_PIN_APPLY, 3),
     }
     note(f"fanin: {results['fanin']}")
 
     # ---- config 3: Map+Counter commutative merge ---------------------------
+    # BASELINE.json size: 10k actors x 1k increments = ~10M ops
     mc_actors = env_int("BENCH_MC_ACTORS", 10_000)
-    mc_incs = env_int("BENCH_MC_INCS", 100)
+    mc_incs = env_int("BENCH_MC_INCS", 1_000)
     cdoc, keys = W.build_counter_base(64)
     t0 = time.perf_counter()
     mc_changes, mc_expected = W.synth_mapcounter(cdoc, keys, mc_actors, mc_incs)
@@ -298,8 +328,9 @@ def main():
     del mlog, mres, mdev, mc_changes, all_mc
 
     # ---- config 4: RGA stress ---------------------------------------------
+    # >=1M interleaved ops on one shared sequence (1k actors x 1k ops)
     rga_actors = env_int("BENCH_RGA_ACTORS", 1_000)
-    rga_ops = env_int("BENCH_RGA_OPS", 200)
+    rga_ops = env_int("BENCH_RGA_OPS", 1_000)
     rbase = W.build_base(trace, 3_000)
     rga_changes = W.synth_rga(rbase, rga_actors, rga_ops)
     all_rga = list(rbase.changes) + rga_changes
@@ -321,12 +352,14 @@ def main():
         "ops_per_sec": round(rga_rate, 1),
         "native_seq_apply_ops_per_sec": round(rlog.n / t_rn, 1),
         "vs_baseline": round(rga_rate / rga_baseline, 3),
+        "vs_pin": round(rga_rate / RUST_PIN_APPLY, 3),
     }
     note(f"rga: {results['rga']}")
     del rlog, rres, rdev, rga_changes, all_rga
 
     # ---- config 5: sync catch-up ------------------------------------------
-    sync_ops = env_int("BENCH_SYNC_OPS", 100_000)
+    # BASELINE.json size: 1M-op divergence
+    sync_ops = env_int("BENCH_SYNC_OPS", 1_000_000)
     sbase = W.build_base(trace, 2_000)
     n_sync_replicas = max(sync_ops // 2_000, 1)
     sync_changes = W.synth_fanin(sbase, trace, n_sync_replicas, 2_000, 2_000)

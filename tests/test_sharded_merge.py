@@ -174,3 +174,61 @@ def test_sharded_sort_fallback_path():
         log.padded_columns(), mesh, n_objs=log.n_objs, n_props=len(log.props)
     )
     _assert_res_equal(res, _single_device_res(log), log.n)
+
+
+def test_linearize_collectives_scale_with_chains_not_rows():
+    """The condensed linearization's per-doubling-step collectives must be
+    sized to the CONDENSED chain bucket (R2/n per shard), not to the row
+    capacity — the o(P) communication requirement. Captured by recording
+    every all_gather's shard shape at trace time."""
+    import numpy as np
+
+    import automerge_tpu.parallel.sharding as S
+    from automerge_tpu import bench as W
+
+    # early-trace slices are sequential typing runs -> long first-child
+    # chains -> strong condensation (the shape the optimization targets)
+    trace = W.load_trace(8_000)
+    base = W.build_base(trace, 6_000)
+    changes = list(base.changes) + W.synth_fanin(base, trace, 8, 200, 0)
+    log = OpLog.from_changes(changes)
+    cols = log.padded_columns()
+    Ptot = len(cols["action"])
+    n = 4
+    mesh = default_mesh(n)
+    n_objs2 = log.n_objs + 2
+    R2, cond_np = S.condense_host(cols, n_objs2, n)
+    assert R2 <= Ptot // 4, "workload must actually condense"
+
+    gathered = []
+    orig = jax.lax.all_gather
+
+    def spy(x, axis_name, **kw):
+        gathered.append(tuple(x.shape))
+        return orig(x, axis_name, **kw)
+
+    S._make_sharded_fn.cache_clear()
+    jax.lax.all_gather, patched = spy, True
+    try:
+        res = sharded_merge_columns(
+            cols, mesh, n_objs=log.n_objs, n_props=len(log.props)
+        )
+    finally:
+        jax.lax.all_gather = orig
+        S._make_sharded_fn.cache_clear()
+
+    # correctness unchanged
+    _assert_res_equal(res, _single_device_res(log), log.n)
+
+    Rl, Pl = R2 // n, Ptot // n
+    small = [s for s in gathered if s[0] <= Rl]
+    big = [s for s in gathered if s[0] >= Pl]
+    assert small, "condensed doubling ran no chain-sized collectives"
+    # the doubling loops (2 loops x ~log R2 steps x 2-3 arrays) all move
+    # chain-bucket slices; only O(1) full-row collectives remain (winner /
+    # conflicts / the single expansion gather), NOT one per doubling step
+    assert len(big) <= 4, (len(big), sorted(set(gathered)))
+    assert all(s[0] <= Rl or s[0] >= Pl for s in gathered), sorted(set(gathered))
+    # communication volume: bytes per doubling step bounded by the chain
+    # bucket, an order of magnitude under the row capacity here
+    assert Rl * 8 < Pl, (Rl, Pl)
