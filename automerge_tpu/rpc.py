@@ -98,6 +98,7 @@ class RpcServer:
     def __init__(self):
         self._docs: Dict[int, AutoDoc] = {}
         self._syncs: Dict[int, SyncState] = {}
+        self._patched = set()  # docs with an activated patch cursor
         self._next = 1
 
     # -- handle plumbing ----------------------------------------------------
@@ -136,6 +137,7 @@ class RpcServer:
 
     def free(self, p):
         self._docs.pop(p["doc"], None)
+        self._patched.discard(p["doc"])
         return None
 
     def fork(self, p):
@@ -258,12 +260,17 @@ class RpcServer:
 
     # patches
     def popPatches(self, p):
+        """Patches since the previous pop — local AND remote changes, via
+        the autocommit diff cursor (reference: autocommit.rs
+        diff_incremental; the wasm popPatches surfaces local edits too).
+        The first call pins the cursor at the current heads and returns
+        an empty list."""
         doc = self._doc(p)
-        if not doc.patch_log.is_active():
-            doc.patch_log.set_active(True)
-            doc.patch_log.reset(doc.doc)
+        if p["doc"] not in self._patched:
+            self._patched.add(p["doc"])
+            doc.update_diff_cursor()
             return []
-        return [self._patch_json(x) for x in doc.make_patches()]
+        return [self._patch_json(x) for x in doc.diff_incremental()]
 
     @staticmethod
     def _patch_json(patch) -> dict:

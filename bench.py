@@ -412,6 +412,64 @@ def main():
     }
     note(f"sync: {results['sync']}")
 
+    # ---- micro-bench guard: map put/save/load/apply + range iteration ------
+    # (reference: rust/automerge/benches/map.rs:48-263, benches/range.rs —
+    # the per-op paths the macro configs cannot isolate; regressions here
+    # show up as per-op time even when the batched merge path is healthy)
+    micro = {}
+    micro_max = env_int("BENCH_MICRO_MAX", 10_000)
+    reps = env_int("BENCH_REPS", 2)
+    for n_keys in (100, 1_000, 10_000):
+        if n_keys > micro_max:
+            continue
+        t_put = t_save = t_load = t_apply = float("inf")
+        for _ in range(max(reps, 1)):
+            mdoc = AutoDoc(actor=ActorId(bytes([11]) * 16))
+            t0 = time.perf_counter()
+            for i in range(n_keys):
+                mdoc.put("_root", f"k{i:06}", i)
+            mdoc.commit()
+            t_put = min(t_put, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            saved = mdoc.save()
+            t_save = min(t_save, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            loaded = AutoDoc.load(saved)
+            loaded.keys()  # materialization is lazy; end at readable
+            t_load = min(t_load, time.perf_counter() - t0)
+            changes = b"".join(
+                a.stored.raw_bytes for a in mdoc.doc.history
+            )
+            rcv = AutoDoc(actor=ActorId(bytes([12]) * 16))
+            t0 = time.perf_counter()
+            rcv.load_incremental(changes)
+            rcv.keys()
+            t_apply = min(t_apply, time.perf_counter() - t0)
+        micro[f"map_{n_keys}"] = {
+            "put_ops_per_sec": round(n_keys / t_put, 1),
+            "save_ms": round(t_save * 1000, 2),
+            "load_ms": round(t_load * 1000, 2),
+            "apply_ops_per_sec": round(n_keys / t_apply, 1),
+        }
+    # range iteration (benches/range.rs)
+    n_range = min(10_000, micro_max)
+    rdoc = AutoDoc(actor=ActorId(bytes([13]) * 16))
+    lst = rdoc.put_object("_root", "l", ObjType.LIST)
+    for i in range(n_range):
+        rdoc.insert(lst, i, i)
+    rdoc.commit()
+    t_range = float("inf")
+    for _ in range(max(reps, 1)):
+        t0 = time.perf_counter()
+        total = sum(1 for _ in rdoc.list_items(lst))
+        t_range = min(t_range, time.perf_counter() - t0)
+        assert total == n_range
+    micro[f"range_{n_range}"] = {
+        "iter_elems_per_sec": round(n_range / t_range, 1),
+    }
+    results["micro"] = micro
+    note(f"micro: {micro}")
+
     out = {
         "metric": "edit_trace_fanin_merge_ops_per_sec",
         "value": results["fanin"]["ops_per_sec"],

@@ -1,0 +1,774 @@
+/* Foreign-runtime RPC client: a pure-C embedder that drives the
+ * JSON-RPC stdio frontend (automerge_tpu/rpc.py) and maintains a LIVE
+ * materialized document tree by applying streamed patches — the role
+ * the reference's wasm interop layer plays for JS hosts
+ * (reference: rust/automerge-wasm/src/interop.rs:787-1001
+ * apply_patch_to_{map,array,text}: navigate the patch path into live
+ * foreign objects and mutate in place; conflict flags surfaced).
+ *
+ * The client spawns the server process given on its command line
+ * (e.g. `python -m automerge_tpu.rpc`), performs local edits, forks,
+ * concurrent merges and a full sync session, and after every patch
+ * batch DEEP-COMPARES its incrementally-maintained tree against the
+ * server's `materialize` snapshot — cross-runtime convergence, asserted
+ * from C. Exit 0 = every assertion held.
+ *
+ * No code is shared with the Python implementation: JSON parsing,
+ * the value tree and patch application are self-contained here.
+ */
+#define _POSIX_C_SOURCE 200809L
+#include <errno.h>
+#include <stdarg.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+/* ---------------- minimal JSON value tree -------------------------------- */
+
+typedef enum { J_NULL, J_BOOL, J_NUM, J_STR, J_ARR, J_OBJ } JType;
+
+typedef struct JVal {
+  JType t;
+  int b;
+  double num;
+  char *str;            /* J_STR (UTF-8) */
+  struct JVal **items;  /* J_ARR / J_OBJ values */
+  char **keys;          /* J_OBJ keys */
+  size_t n, cap;
+} JVal;
+
+static int checks = 0, failures = 0;
+#define CHECK(cond)                                                         \
+  do {                                                                      \
+    checks++;                                                               \
+    if (!(cond)) {                                                          \
+      failures++;                                                           \
+      fprintf(stderr, "CHECK failed at %s:%d: %s\n", __FILE__, __LINE__,    \
+              #cond);                                                       \
+    }                                                                       \
+  } while (0)
+
+static void *xmalloc(size_t n) {
+  void *p = calloc(1, n ? n : 1);
+  if (!p) {
+    fprintf(stderr, "oom\n");
+    exit(2);
+  }
+  return p;
+}
+
+static char *xstrdup(const char *s) {
+  char *d = xmalloc(strlen(s) + 1);
+  strcpy(d, s);
+  return d;
+}
+
+static JVal *jnew(JType t) {
+  JVal *v = xmalloc(sizeof(JVal));
+  v->t = t;
+  return v;
+}
+
+static void jfree(JVal *v) {
+  if (!v) return;
+  free(v->str);
+  for (size_t i = 0; i < v->n; i++) {
+    jfree(v->items[i]);
+    if (v->keys) free(v->keys[i]);
+  }
+  free(v->items);
+  free(v->keys);
+  free(v);
+}
+
+static void jgrow(JVal *v) {
+  if (v->n == v->cap) {
+    v->cap = v->cap ? v->cap * 2 : 4;
+    v->items = realloc(v->items, v->cap * sizeof(JVal *));
+    if (v->t == J_OBJ) v->keys = realloc(v->keys, v->cap * sizeof(char *));
+  }
+}
+
+static void jarr_insert(JVal *a, size_t idx, JVal *item) {
+  jgrow(a);
+  if (idx > a->n) idx = a->n;
+  memmove(a->items + idx + 1, a->items + idx,
+          (a->n - idx) * sizeof(JVal *));
+  a->items[idx] = item;
+  a->n++;
+}
+
+static void jarr_delete(JVal *a, size_t idx) {
+  if (idx >= a->n) return;
+  jfree(a->items[idx]);
+  memmove(a->items + idx, a->items + idx + 1,
+          (a->n - idx - 1) * sizeof(JVal *));
+  a->n--;
+}
+
+static JVal *jobj_get(const JVal *o, const char *key) {
+  for (size_t i = 0; i < o->n; i++)
+    if (strcmp(o->keys[i], key) == 0) return o->items[i];
+  return NULL;
+}
+
+static void jobj_put(JVal *o, const char *key, JVal *val) {
+  for (size_t i = 0; i < o->n; i++)
+    if (strcmp(o->keys[i], key) == 0) {
+      jfree(o->items[i]);
+      o->items[i] = val;
+      return;
+    }
+  jgrow(o);
+  o->keys[o->n] = xstrdup(key);
+  o->items[o->n] = val;
+  o->n++;
+}
+
+static void jobj_del(JVal *o, const char *key) {
+  for (size_t i = 0; i < o->n; i++)
+    if (strcmp(o->keys[i], key) == 0) {
+      jfree(o->items[i]);
+      free(o->keys[i]);
+      memmove(o->items + i, o->items + i + 1,
+              (o->n - i - 1) * sizeof(JVal *));
+      memmove(o->keys + i, o->keys + i + 1, (o->n - i - 1) * sizeof(char *));
+      o->n--;
+      return;
+    }
+}
+
+/* ---------------- JSON parser --------------------------------------------- */
+
+typedef struct {
+  const char *s;
+  size_t pos, len;
+  int err;
+} Parser;
+
+static void pskip(Parser *p) {
+  while (p->pos < p->len && strchr(" \t\r\n", p->s[p->pos])) p->pos++;
+}
+
+static JVal *pvalue(Parser *p);
+
+static int phex(Parser *p) {
+  int v = 0;
+  for (int i = 0; i < 4; i++) {
+    char c = p->pos < p->len ? p->s[p->pos++] : 0;
+    v <<= 4;
+    if (c >= '0' && c <= '9') v |= c - '0';
+    else if (c >= 'a' && c <= 'f') v |= c - 'a' + 10;
+    else if (c >= 'A' && c <= 'F') v |= c - 'A' + 10;
+    else { p->err = 1; return 0; }
+  }
+  return v;
+}
+
+static void utf8_push(char **buf, size_t *n, size_t *cap, long cp) {
+  char tmp[4];
+  int len;
+  if (cp < 0x80) { tmp[0] = (char)cp; len = 1; }
+  else if (cp < 0x800) {
+    tmp[0] = (char)(0xC0 | (cp >> 6));
+    tmp[1] = (char)(0x80 | (cp & 0x3F));
+    len = 2;
+  } else if (cp < 0x10000) {
+    tmp[0] = (char)(0xE0 | (cp >> 12));
+    tmp[1] = (char)(0x80 | ((cp >> 6) & 0x3F));
+    tmp[2] = (char)(0x80 | (cp & 0x3F));
+    len = 3;
+  } else {
+    tmp[0] = (char)(0xF0 | (cp >> 18));
+    tmp[1] = (char)(0x80 | ((cp >> 12) & 0x3F));
+    tmp[2] = (char)(0x80 | ((cp >> 6) & 0x3F));
+    tmp[3] = (char)(0x80 | (cp & 0x3F));
+    len = 4;
+  }
+  if (*n + 4 >= *cap) {
+    *cap = *cap ? *cap * 2 : 32;
+    *buf = realloc(*buf, *cap + 4);
+  }
+  memcpy(*buf + *n, tmp, len);
+  *n += len;
+}
+
+static char *pstring(Parser *p) {
+  if (p->s[p->pos] != '"') { p->err = 1; return NULL; }
+  p->pos++;
+  char *buf = NULL;
+  size_t n = 0, cap = 0;
+  while (p->pos < p->len) {
+    char c = p->s[p->pos++];
+    if (c == '"') {
+      utf8_push(&buf, &n, &cap, 0);
+      buf[n - 1] = '\0';
+      return buf;
+    }
+    if (c == '\\') {
+      char e = p->pos < p->len ? p->s[p->pos++] : 0;
+      switch (e) {
+        case '"': case '\\': case '/': utf8_push(&buf, &n, &cap, e); break;
+        case 'b': utf8_push(&buf, &n, &cap, '\b'); break;
+        case 'f': utf8_push(&buf, &n, &cap, '\f'); break;
+        case 'n': utf8_push(&buf, &n, &cap, '\n'); break;
+        case 'r': utf8_push(&buf, &n, &cap, '\r'); break;
+        case 't': utf8_push(&buf, &n, &cap, '\t'); break;
+        case 'u': {
+          long cp = phex(p);
+          if (cp >= 0xD800 && cp < 0xDC00 && p->pos + 1 < p->len &&
+              p->s[p->pos] == '\\' && p->s[p->pos + 1] == 'u') {
+            p->pos += 2;
+            long lo = phex(p);
+            cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+          }
+          utf8_push(&buf, &n, &cap, cp);
+          break;
+        }
+        default: p->err = 1; free(buf); return NULL;
+      }
+    } else {
+      utf8_push(&buf, &n, &cap, (unsigned char)c);
+    }
+  }
+  p->err = 1;
+  free(buf);
+  return NULL;
+}
+
+static JVal *pvalue(Parser *p) {
+  pskip(p);
+  if (p->pos >= p->len) { p->err = 1; return jnew(J_NULL); }
+  char c = p->s[p->pos];
+  if (c == '{') {
+    p->pos++;
+    JVal *o = jnew(J_OBJ);
+    pskip(p);
+    if (p->s[p->pos] == '}') { p->pos++; return o; }
+    for (;;) {
+      pskip(p);
+      char *k = pstring(p);
+      if (p->err) { free(k); return o; }
+      pskip(p);
+      if (p->s[p->pos] != ':') { p->err = 1; free(k); return o; }
+      p->pos++;
+      JVal *v = pvalue(p);
+      jgrow(o);
+      o->keys[o->n] = k;
+      o->items[o->n] = v;
+      o->n++;
+      pskip(p);
+      if (p->s[p->pos] == ',') { p->pos++; continue; }
+      if (p->s[p->pos] == '}') { p->pos++; return o; }
+      p->err = 1;
+      return o;
+    }
+  }
+  if (c == '[') {
+    p->pos++;
+    JVal *a = jnew(J_ARR);
+    pskip(p);
+    if (p->s[p->pos] == ']') { p->pos++; return a; }
+    for (;;) {
+      JVal *v = pvalue(p);
+      jarr_insert(a, a->n, v);
+      pskip(p);
+      if (p->s[p->pos] == ',') { p->pos++; continue; }
+      if (p->s[p->pos] == ']') { p->pos++; return a; }
+      p->err = 1;
+      return a;
+    }
+  }
+  if (c == '"') {
+    JVal *v = jnew(J_STR);
+    v->str = pstring(p);
+    if (!v->str) v->str = xstrdup("");
+    return v;
+  }
+  if (strncmp(p->s + p->pos, "true", 4) == 0) {
+    p->pos += 4;
+    JVal *v = jnew(J_BOOL);
+    v->b = 1;
+    return v;
+  }
+  if (strncmp(p->s + p->pos, "false", 5) == 0) {
+    p->pos += 5;
+    return jnew(J_BOOL);
+  }
+  if (strncmp(p->s + p->pos, "null", 4) == 0) {
+    p->pos += 4;
+    return jnew(J_NULL);
+  }
+  char *end = NULL;
+  JVal *v = jnew(J_NUM);
+  v->num = strtod(p->s + p->pos, &end);
+  if (end == p->s + p->pos) p->err = 1;
+  p->pos = end - p->s;
+  return v;
+}
+
+static JVal *jparse(const char *s) {
+  Parser p = {s, 0, strlen(s), 0};
+  JVal *v = pvalue(&p);
+  if (p.err) {
+    fprintf(stderr, "JSON parse error near byte %zu: %.40s\n", p.pos,
+            s + (p.pos < 40 ? 0 : p.pos - 40));
+    exit(2);
+  }
+  return v;
+}
+
+/* deep equality; numbers compared as doubles (ints <= 2^53 exact) */
+static int jequal(const JVal *a, const JVal *b) {
+  if (a->t != b->t) return 0;
+  switch (a->t) {
+    case J_NULL: return 1;
+    case J_BOOL: return a->b == b->b;
+    case J_NUM: return a->num == b->num;
+    case J_STR: return strcmp(a->str, b->str) == 0;
+    case J_ARR:
+      if (a->n != b->n) return 0;
+      for (size_t i = 0; i < a->n; i++)
+        if (!jequal(a->items[i], b->items[i])) return 0;
+      return 1;
+    case J_OBJ:
+      if (a->n != b->n) return 0;
+      for (size_t i = 0; i < a->n; i++) {
+        JVal *bv = jobj_get(b, a->keys[i]);
+        if (!bv || !jequal(a->items[i], bv)) return 0;
+      }
+      return 1;
+  }
+  return 0;
+}
+
+static void jdump(const JVal *v, FILE *f) {
+  switch (v->t) {
+    case J_NULL: fputs("null", f); break;
+    case J_BOOL: fputs(v->b ? "true" : "false", f); break;
+    case J_NUM: fprintf(f, "%g", v->num); break;
+    case J_STR: fprintf(f, "\"%s\"", v->str); break;
+    case J_ARR:
+      fputc('[', f);
+      for (size_t i = 0; i < v->n; i++) {
+        if (i) fputc(',', f);
+        jdump(v->items[i], f);
+      }
+      fputc(']', f);
+      break;
+    case J_OBJ:
+      fputc('{', f);
+      for (size_t i = 0; i < v->n; i++) {
+        if (i) fputc(',', f);
+        fprintf(f, "\"%s\":", v->keys[i]);
+        jdump(v->items[i], f);
+      }
+      fputc('}', f);
+      break;
+  }
+}
+
+/* ---------------- RPC transport ------------------------------------------- */
+
+static FILE *to_srv, *from_srv;
+static int next_id = 1;
+
+static void esc_into(char *dst, size_t cap, const char *s) {
+  size_t j = 0;
+  for (; *s; s++) {
+    if (j + 8 >= cap) { /* fail fast: truncation would corrupt the call */
+      fprintf(stderr, "esc_into: payload exceeds %zu-byte buffer\n", cap);
+      exit(2);
+    }
+    unsigned char c = (unsigned char)*s;
+    if (c == '"' || c == '\\') {
+      dst[j++] = '\\';
+      dst[j++] = c;
+    } else if (c < 0x20) {
+      j += snprintf(dst + j, cap - j, "\\u%04x", c);
+    } else {
+      dst[j++] = c;
+    }
+  }
+  dst[j] = '\0';
+}
+
+/* send {"id":n,"method":m,"params":{<fmt printf-built body>}}; returns the
+ * parsed "result" value (caller frees); asserts no error came back */
+static JVal *rpc(const char *method, const char *fmt, ...) {
+  char params[1 << 16];
+  va_list ap;
+  va_start(ap, fmt);
+  int plen = vsnprintf(params, sizeof params, fmt, ap);
+  va_end(ap);
+  if (plen < 0 || (size_t)plen >= sizeof params) {
+    fprintf(stderr, "rpc: params for %s exceed the request buffer\n", method);
+    exit(2);
+  }
+  fprintf(to_srv, "{\"id\":%d,\"method\":\"%s\",\"params\":{%s}}\n",
+          next_id++, method, params);
+  fflush(to_srv);
+  static char *line = NULL;
+  static size_t cap = 0;
+  ssize_t n = getline(&line, &cap, from_srv);
+  if (n <= 0) {
+    fprintf(stderr, "server closed the pipe (method %s)\n", method);
+    exit(2);
+  }
+  JVal *resp = jparse(line);
+  JVal *err = jobj_get(resp, "error");
+  if (err) {
+    fprintf(stderr, "RPC error for %s: ", method);
+    jdump(err, stderr);
+    fputc('\n', stderr);
+    exit(2);
+  }
+  JVal *res = jobj_get(resp, "result");
+  /* detach result from the envelope so the envelope can be freed */
+  for (size_t i = 0; i < resp->n; i++)
+    if (resp->items[i] == res) resp->items[i] = jnew(J_NULL);
+  jfree(resp);
+  return res ? res : jnew(J_NULL);
+}
+
+/* ---------------- live tree: patch application ----------------------------- */
+/* Mirrors interop.rs apply_patch semantics: navigate `path` from the root
+ * into live containers, then mutate in place. Text objects are UTF-8
+ * strings indexed by CODE POINT (the server's text unit). */
+
+static size_t cp_to_byte(const char *s, size_t cp_index) {
+  size_t i = 0, cp = 0;
+  while (s[i] && cp < cp_index) {
+    i++;
+    while ((s[i] & 0xC0) == 0x80) i++;
+    cp++;
+  }
+  return i;
+}
+
+static void text_splice(JVal *node, size_t pos, size_t del_cps,
+                        const char *ins) {
+  const char *old = node->str ? node->str : "";
+  size_t b0 = cp_to_byte(old, pos);
+  size_t b1 = b0 + cp_to_byte(old + b0, del_cps);
+  size_t nlen = strlen(old) - (b1 - b0) + strlen(ins);
+  char *out = xmalloc(nlen + 1);
+  memcpy(out, old, b0);
+  strcpy(out + b0, ins);
+  strcat(out, old + b1);
+  free(node->str);
+  node->str = out;
+}
+
+/* patch "value" payloads arrive as plain JSON subtrees (objects/lists
+ * materialized); adopt them directly as live nodes */
+static JVal *jclone(const JVal *v) {
+  JVal *c = jnew(v->t);
+  c->b = v->b;
+  c->num = v->num;
+  if (v->str) c->str = xstrdup(v->str);
+  for (size_t i = 0; i < v->n; i++) {
+    jgrow(c);
+    if (v->t == J_OBJ) c->keys[c->n] = xstrdup(v->keys[i]);
+    c->items[c->n] = jclone(v->items[i]);
+    c->n++;
+  }
+  return c;
+}
+
+static int conflicts_seen = 0;
+
+static void apply_patch(JVal *root, const JVal *patch) {
+  const JVal *path = jobj_get(patch, "path");
+  JVal *node = root;
+  for (size_t i = 0; path && i < path->n; i++) {
+    const JVal *step = path->items[i];  /* [parent_exid, key-or-index] */
+    const JVal *key = step->items[1];
+    if (node->t == J_OBJ && key->t == J_STR) {
+      node = jobj_get(node, key->str);
+    } else if (node->t == J_ARR && key->t == J_NUM) {
+      size_t idx = (size_t)key->num;
+      node = idx < node->n ? node->items[idx] : NULL;
+    } else {
+      node = NULL;
+    }
+    if (!node) {
+      fprintf(stderr, "patch path does not resolve\n");
+      exit(2);
+    }
+  }
+  const char *action = jobj_get(patch, "action")->str;
+  if (strcmp(action, "PutMap") == 0) {
+    const JVal *c = jobj_get(patch, "conflict");
+    if (c && c->t == J_BOOL && c->b) conflicts_seen++;
+    jobj_put(node, jobj_get(patch, "key")->str,
+             jclone(jobj_get(patch, "value")));
+  } else if (strcmp(action, "PutSeq") == 0) {
+    const JVal *c = jobj_get(patch, "conflict");
+    if (c && c->t == J_BOOL && c->b) conflicts_seen++;
+    size_t idx = (size_t)jobj_get(patch, "index")->num;
+    if (idx < node->n) {
+      jfree(node->items[idx]);
+      node->items[idx] = jclone(jobj_get(patch, "value"));
+    }
+  } else if (strcmp(action, "Insert") == 0) {
+    size_t idx = (size_t)jobj_get(patch, "index")->num;
+    const JVal *vals = jobj_get(patch, "values");
+    for (size_t i = 0; i < vals->n; i++)
+      jarr_insert(node, idx + i, jclone(vals->items[i]));
+  } else if (strcmp(action, "SpliceText") == 0) {
+    text_splice(node, (size_t)jobj_get(patch, "index")->num, 0,
+                jobj_get(patch, "value")->str);
+  } else if (strcmp(action, "DeleteMap") == 0) {
+    jobj_del(node, jobj_get(patch, "key")->str);
+  } else if (strcmp(action, "DeleteSeq") == 0) {
+    size_t idx = (size_t)jobj_get(patch, "index")->num;
+    size_t len = (size_t)jobj_get(patch, "length")->num;
+    if (node->t == J_STR) {
+      text_splice(node, idx, len, "");
+    } else {
+      for (size_t i = 0; i < len; i++) jarr_delete(node, idx);
+    }
+  } else if (strcmp(action, "IncrementPatch") == 0) {
+    const JVal *prop = jobj_get(patch, "prop");
+    JVal *target = NULL;
+    if (node->t == J_OBJ && prop->t == J_STR)
+      target = jobj_get(node, prop->str);
+    else if (node->t == J_ARR && prop->t == J_NUM &&
+             (size_t)prop->num < node->n)
+      target = node->items[(size_t)prop->num];
+    CHECK(target && target->t == J_NUM);
+    if (target && target->t == J_NUM)
+      target->num += jobj_get(patch, "value")->num;
+  } else if (strcmp(action, "MarkPatch") == 0) {
+    /* marks are tracked out-of-tree (materialize has no mark channel);
+     * verified against the `marks` RPC read below */
+  } else if (strcmp(action, "FlagConflict") == 0) {
+    conflicts_seen++;
+  } else {
+    fprintf(stderr, "unknown patch action %s\n", action);
+    exit(2);
+  }
+}
+
+static void apply_patch_batch(JVal *root, const JVal *patches) {
+  for (size_t i = 0; i < patches->n; i++)
+    apply_patch(root, patches->items[i]);
+}
+
+/* the convergence assertion: live tree == server materialize snapshot */
+static void check_converged(JVal *tree, int doc, const char *label) {
+  JVal *snap = rpc("materialize", "\"doc\":%d", doc);
+  if (!jequal(tree, snap)) {
+    failures++;
+    fprintf(stderr, "DIVERGED at %s\nlocal:  ", label);
+    jdump(tree, stderr);
+    fprintf(stderr, "\nserver: ");
+    jdump(snap, stderr);
+    fputc('\n', stderr);
+  } else {
+    checks++;
+  }
+  jfree(snap);
+}
+
+/* ---------------- scenario ------------------------------------------------- */
+
+static void pop_and_apply(JVal *tree, int doc) {
+  JVal *patches = rpc("popPatches", "\"doc\":%d", doc);
+  apply_patch_batch(tree, patches);
+  jfree(patches);
+}
+
+/* take an int field out of a result object, freeing the result */
+static int res_field_int(JVal *res, const char *field) {
+  JVal *f = jobj_get(res, field);
+  int v = f && f->t == J_NUM ? (int)f->num : -1;
+  jfree(res);
+  return v;
+}
+
+/* take a string field ("$obj" ids) out of a result object */
+static char *res_field_str(JVal *res, const char *field) {
+  JVal *f = jobj_get(res, field);
+  char *s = f && f->t == J_STR ? xstrdup(f->str) : xstrdup("");
+  jfree(res);
+  return s;
+}
+
+int main(int argc, char **argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s <server-cmd> [args...]\n", argv[0]);
+    return 2;
+  }
+  int in_pipe[2], out_pipe[2];
+  if (pipe(in_pipe) || pipe(out_pipe)) return 2;
+  pid_t pid = fork();
+  if (pid == 0) {
+    dup2(in_pipe[0], 0);
+    dup2(out_pipe[1], 1);
+    close(in_pipe[1]);
+    close(out_pipe[0]);
+    execvp(argv[1], argv + 1);
+    perror("execvp");
+    _exit(127);
+  }
+  close(in_pipe[0]);
+  close(out_pipe[1]);
+  to_srv = fdopen(in_pipe[1], "w");
+  from_srv = fdopen(out_pipe[0], "r");
+
+  /* -- doc A: local edits mirrored into the live tree through patches ---- */
+  int a = res_field_int(
+      rpc("create", "\"actor\":\"01010101010101010101010101010101\""), "doc");
+  CHECK(a > 0);
+  JVal *tree = jnew(J_OBJ);
+  jfree(rpc("popPatches", "\"doc\":%d", a)); /* pin the patch cursor */
+
+  char *t = res_field_str(
+      rpc("putObject", "\"doc\":%d,\"obj\":\"_root\",\"prop\":\"t\","
+          "\"type\":\"text\"", a),
+      "$obj");
+  jfree(rpc("spliceText",
+            "\"doc\":%d,\"obj\":\"%s\",\"pos\":0,\"text\":\"hello world\"",
+            a, t));
+  char *cfg = res_field_str(
+      rpc("putObject", "\"doc\":%d,\"obj\":\"_root\",\"prop\":\"cfg\","
+          "\"type\":\"map\"", a),
+      "$obj");
+  jfree(rpc("put", "\"doc\":%d,\"obj\":\"%s\",\"prop\":\"n\",\"value\":7",
+            a, cfg));
+  jfree(rpc("put", "\"doc\":%d,\"obj\":\"%s\",\"prop\":\"c\","
+            "\"value\":{\"$counter\":10}", a, cfg));
+  char *lst = res_field_str(
+      rpc("putObject", "\"doc\":%d,\"obj\":\"_root\",\"prop\":\"l\","
+          "\"type\":\"list\"", a),
+      "$obj");
+  jfree(rpc("insert", "\"doc\":%d,\"obj\":\"%s\",\"index\":0,"
+            "\"value\":\"first\"", a, lst));
+  jfree(rpc("insert", "\"doc\":%d,\"obj\":\"%s\",\"index\":1,"
+            "\"value\":2.5", a, lst));
+  pop_and_apply(tree, a);
+  check_converged(tree, a, "initial build");
+
+  /* incremental edits: splice, delete, increment, nested object */
+  jfree(rpc("spliceText",
+            "\"doc\":%d,\"obj\":\"%s\",\"pos\":5,\"del\":6,"
+            "\"text\":\", patched \\u00e9!\"", a, t));
+  jfree(rpc("increment",
+            "\"doc\":%d,\"obj\":\"%s\",\"prop\":\"c\",\"by\":5", a, cfg));
+  jfree(rpc("delete", "\"doc\":%d,\"obj\":\"%s\",\"prop\":\"n\"", a, cfg));
+  char *sub = res_field_str(
+      rpc("insertObject", "\"doc\":%d,\"obj\":\"%s\",\"index\":1,"
+          "\"type\":\"map\"", a, lst),
+      "$obj");
+  jfree(rpc("put", "\"doc\":%d,\"obj\":\"%s\",\"prop\":\"deep\","
+            "\"value\":true", a, sub));
+  jfree(rpc("delete", "\"doc\":%d,\"obj\":\"%s\",\"index\":0", a, lst));
+  pop_and_apply(tree, a);
+  check_converged(tree, a, "incremental edits");
+
+  /* counter survived as a number and incremented */
+  {
+    JVal *cfg_node = jobj_get(tree, "cfg");
+    JVal *cval = cfg_node ? jobj_get(cfg_node, "c") : NULL;
+    CHECK(cval && cval->t == J_NUM && cval->num == 15);
+  }
+
+  /* -- concurrent fork + merge: remote patches, conflict flags ----------- */
+  int b = res_field_int(
+      rpc("fork", "\"doc\":%d,\"actor\":"
+          "\"02020202020202020202020202020202\"", a),
+      "doc");
+  CHECK(b > 0);
+  jfree(rpc("put", "\"doc\":%d,\"obj\":\"%s\",\"prop\":\"who\","
+            "\"value\":\"A\"", a, cfg));
+  jfree(rpc("put", "\"doc\":%d,\"obj\":\"%s\",\"prop\":\"who\","
+            "\"value\":\"B\"", b, cfg));
+  jfree(rpc("spliceText", "\"doc\":%d,\"obj\":\"%s\",\"pos\":0,"
+            "\"text\":\">> \"", b, t));
+  jfree(rpc("commit", "\"doc\":%d", a));
+  jfree(rpc("commit", "\"doc\":%d", b));
+  jfree(rpc("merge", "\"doc\":%d,\"other\":%d", a, b));
+  int conflicts_before = conflicts_seen;
+  pop_and_apply(tree, a);
+  check_converged(tree, a, "after merge");
+  CHECK(conflicts_seen > conflicts_before); /* "who" conflicted */
+
+  /* -- marks: tracked via the marks read, MarkPatch observed -------------- */
+  jfree(rpc("mark", "\"doc\":%d,\"obj\":\"%s\",\"start\":0,\"end\":5,"
+            "\"name\":\"bold\",\"value\":true", a, t));
+  JVal *patches = rpc("popPatches", "\"doc\":%d", a);
+  int saw_mark = 0;
+  for (size_t i = 0; i < patches->n; i++) {
+    JVal *act = jobj_get(patches->items[i], "action");
+    if (act && strcmp(act->str, "MarkPatch") == 0) saw_mark = 1;
+  }
+  apply_patch_batch(tree, patches);
+  jfree(patches);
+  CHECK(saw_mark);
+  JVal *marks = rpc("marks", "\"doc\":%d,\"obj\":\"%s\"", a, t);
+  CHECK(marks->n == 1);
+  if (marks->n == 1) {
+    JVal *m0 = marks->items[0];
+    CHECK(strcmp(jobj_get(m0, "name")->str, "bold") == 0);
+    CHECK(jobj_get(m0, "start")->num == 0);
+    CHECK(jobj_get(m0, "end")->num == 5);
+  }
+  jfree(marks);
+
+  /* -- sync session into a fresh peer, mirrored by its own live tree ----- */
+  int c = res_field_int(
+      rpc("create", "\"actor\":\"03030303030303030303030303030303\""),
+      "doc");
+  JVal *tree_c = jnew(J_OBJ);
+  jfree(rpc("popPatches", "\"doc\":%d", c));
+  int sa = res_field_int(rpc("syncStateNew", ""), "sync");
+  int sc = res_field_int(rpc("syncStateNew", ""), "sync");
+  for (int round = 0; round < 40; round++) {
+    JVal *ma = rpc("generateSyncMessage", "\"doc\":%d,\"sync\":%d", a, sa);
+    JVal *mc = rpc("generateSyncMessage", "\"doc\":%d,\"sync\":%d", c, sc);
+    int quiet = ma->t == J_NULL && mc->t == J_NULL;
+    if (ma->t == J_STR) {
+      char esc[1 << 15];
+      esc_into(esc, sizeof esc, ma->str);
+      jfree(rpc("receiveSyncMessage",
+                "\"doc\":%d,\"sync\":%d,\"data\":\"%s\"", c, sc, esc));
+    }
+    if (mc->t == J_STR) {
+      char esc[1 << 15];
+      esc_into(esc, sizeof esc, mc->str);
+      jfree(rpc("receiveSyncMessage",
+                "\"doc\":%d,\"sync\":%d,\"data\":\"%s\"", a, sa, esc));
+    }
+    jfree(ma);
+    jfree(mc);
+    if (quiet) break;
+  }
+  pop_and_apply(tree_c, c);
+  check_converged(tree_c, c, "synced peer");
+  CHECK(jequal(tree, tree_c)); /* both live trees converged cross-doc */
+
+  jfree(rpc("shutdown", ""));
+  fclose(to_srv);
+  fclose(from_srv);
+  int status = 0;
+  waitpid(pid, &status, 0);
+  free(t);
+  free(cfg);
+  free(lst);
+  free(sub);
+  jfree(tree);
+  jfree(tree_c);
+
+  if (failures) {
+    fprintf(stderr, "rpc_client: %d/%d assertions FAILED\n", failures,
+            checks);
+    return 1;
+  }
+  printf("rpc_client: all assertions passed (%d)\n", checks);
+  return 0;
+}

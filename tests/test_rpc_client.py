@@ -1,0 +1,42 @@
+"""Cross-runtime convergence: the pure-C RPC client (clients/c) drives
+the JSON-RPC stdio frontend from a separate process, maintains a live
+materialized tree by applying streamed patches (the reference's
+interop.rs applyPatch role), and asserts convergence against the
+server's materialize snapshots from C.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SRC = os.path.join(REPO, "clients", "c", "rpc_client.c")
+
+
+@pytest.mark.skipif(shutil.which("gcc") is None, reason="no C toolchain")
+@pytest.mark.skipif(os.name != "posix", reason="fork/exec pipes")
+def test_c_client_live_patch_convergence(tmp_path):
+    exe = str(tmp_path / "rpc_client")
+    r = subprocess.run(
+        ["gcc", "-O1", "-Wall", "-Werror", "-o", exe, SRC],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [exe, sys.executable, "-m", "automerge_tpu.rpc"],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env=env,
+        cwd=REPO,
+    )
+    assert r.returncode == 0, f"stdout: {r.stdout}\nstderr: {r.stderr}"
+    assert "all assertions passed" in r.stdout
