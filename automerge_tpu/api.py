@@ -331,17 +331,24 @@ class AutoDoc:
         self.commit()
         return self.doc.diff(before_heads, after_heads)
 
-    def diff_incremental(self):
+    def diff_incremental(self, commit: bool = True):
         """Patches since the last diff_incremental / update_diff_cursor call
-        (reference: autocommit.rs diff cursor)."""
-        self.commit()
+        (reference: autocommit.rs diff cursor).
+
+        ``commit=False`` diffs only up to the last COMMITTED state — the
+        open transaction is left intact (its message/timestamp survive a
+        later explicit commit) and its patches surface on the pop after
+        that commit."""
+        if commit:
+            self.commit()
         before = self._diff_cursor
         after = self.doc.get_heads()
         self._diff_cursor = after
         return self.doc.diff(before, after)
 
-    def update_diff_cursor(self) -> None:
-        self.commit()
+    def update_diff_cursor(self, commit: bool = True) -> None:
+        if commit:
+            self.commit()
         self._diff_cursor = self.doc.get_heads()
 
     def reset_diff_cursor(self) -> None:

@@ -86,7 +86,10 @@ def build(out_dir: Optional[str] = None) -> Optional[str]:
                 pass
 
 
-TEST_SOURCES = ("test_am.c", "test_basic.c", "test_sync.c", "test_ported2.c")
+TEST_SOURCES = (
+    "test_am.c", "test_basic.c", "test_sync.c", "test_ported2.c",
+    "test_ported3.c",
+)
 
 
 def build_test(

@@ -268,9 +268,12 @@ class RpcServer:
         doc = self._doc(p)
         if p["doc"] not in self._patched:
             self._patched.add(p["doc"])
-            doc.update_diff_cursor()
+            doc.update_diff_cursor(commit=False)
             return []
-        return [self._patch_json(x) for x in doc.diff_incremental()]
+        # commit=False: popping must never close an open transaction (a
+        # later explicit commit keeps its message); pending ops' patches
+        # arrive on the pop after that commit
+        return [self._patch_json(x) for x in doc.diff_incremental(commit=False)]
 
     @staticmethod
     def _patch_json(patch) -> dict:

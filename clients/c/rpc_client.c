@@ -60,6 +60,15 @@ static void *xmalloc(size_t n) {
   return p;
 }
 
+static void *xrealloc(void *p, size_t n) {
+  void *q = realloc(p, n ? n : 1);
+  if (!q) {
+    fprintf(stderr, "oom\n");
+    exit(2);
+  }
+  return q;
+}
+
 static char *xstrdup(const char *s) {
   char *d = xmalloc(strlen(s) + 1);
   strcpy(d, s);
@@ -87,8 +96,8 @@ static void jfree(JVal *v) {
 static void jgrow(JVal *v) {
   if (v->n == v->cap) {
     v->cap = v->cap ? v->cap * 2 : 4;
-    v->items = realloc(v->items, v->cap * sizeof(JVal *));
-    if (v->t == J_OBJ) v->keys = realloc(v->keys, v->cap * sizeof(char *));
+    v->items = xrealloc(v->items, v->cap * sizeof(JVal *));
+    if (v->t == J_OBJ) v->keys = xrealloc(v->keys, v->cap * sizeof(char *));
   }
 }
 
@@ -190,7 +199,7 @@ static void utf8_push(char **buf, size_t *n, size_t *cap, long cp) {
   }
   if (*n + 4 >= *cap) {
     *cap = *cap ? *cap * 2 : 32;
-    *buf = realloc(*buf, *cap + 4);
+    *buf = xrealloc(*buf, *cap + 4);
   }
   memcpy(*buf + *n, tmp, len);
   *n += len;
@@ -578,6 +587,9 @@ static void check_converged(JVal *tree, int doc, const char *label) {
 /* ---------------- scenario ------------------------------------------------- */
 
 static void pop_and_apply(JVal *tree, int doc) {
+  /* popPatches never closes an open transaction; flush pending local
+   * edits first so their patches are in this batch */
+  jfree(rpc("commit", "\"doc\":%d", doc));
   JVal *patches = rpc("popPatches", "\"doc\":%d", doc);
   apply_patch_batch(tree, patches);
   jfree(patches);
@@ -701,6 +713,7 @@ int main(int argc, char **argv) {
   /* -- marks: tracked via the marks read, MarkPatch observed -------------- */
   jfree(rpc("mark", "\"doc\":%d,\"obj\":\"%s\",\"start\":0,\"end\":5,"
             "\"name\":\"bold\",\"value\":true", a, t));
+  jfree(rpc("commit", "\"doc\":%d", a));
   JVal *patches = rpc("popPatches", "\"doc\":%d", a);
   int saw_mark = 0;
   for (size_t i = 0; i < patches->n; i++) {

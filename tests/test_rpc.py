@@ -176,3 +176,21 @@ def test_server_survives_hostile_and_binary_inputs():
     assert resps[7]["result"]["b"] == {"$bytes": "AAEC"}  # bytes wrapped
     assert resps[8]["result"][0]["name"] == "blob"
     assert resps[9]["result"] is None                     # clean shutdown
+
+
+def test_pop_patches_preserves_open_transaction():
+    """popPatches must not force-commit: an explicit commit after a pop
+    keeps its message, and the pending ops' patches arrive on the NEXT
+    pop (reference: wasm popPatches never closes the transaction)."""
+    srv = RpcServer()
+    d = call(srv, "create", actor="0a" * 16)["doc"]
+    call(srv, "popPatches", doc=d)  # pin cursor
+    call(srv, "put", doc=d, obj="_root", prop="x", value=1)
+    # pop with the transaction still open: nothing committed yet
+    assert call(srv, "popPatches", doc=d) == []
+    h = call(srv, "commit", doc=d, message="my edit")
+    assert h is not None
+    doc = srv._docs[d]
+    assert doc.doc.history[-1].stored.message == "my edit"
+    patches = call(srv, "popPatches", doc=d)
+    assert any(p["action"] == "PutMap" and p.get("key") == "x" for p in patches)
