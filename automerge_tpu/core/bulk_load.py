@@ -139,7 +139,7 @@ def _export_via_device(stored, flat):
     if log.n != len(flat["op_id"]):
         raise ValueError("device export: op count mismatch with flat history")
     res = merge_columns(
-        log.padded_columns(), fetch=("elem_index",), n_objs=log.n_objs
+        log.columns(), fetch=("elem_index",), n_objs=log.n_objs
     )
     elem_index = np.asarray(res["elem_index"][: log.n])
 

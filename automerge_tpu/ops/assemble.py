@@ -244,6 +244,10 @@ def _split_batch(a: Dict, changes: Sequence) -> List[ChangeCols]:
     key_table = a["key_table"]
     mark_table = a["mark_table"]
 
+    # structured-dtype slice copies go through numpy's per-field slow path
+    # (~17x a plain copy); copying through a flat byte view is a memcpy
+    hot_bytes = hot_all.view(np.uint8).reshape(N, HOT_DTYPE.itemsize)
+
     out = []
     for c in range(n_changes):
         lo, hi = int(row_off[c]), int(row_off[c + 1])
@@ -252,7 +256,7 @@ def _split_batch(a: Dict, changes: Sequence) -> List[ChangeCols]:
         cc = ChangeCols()
         cc.n = hi - lo
         cc.q = phi - plo
-        cc.hot = hot_all[lo:hi].copy()
+        cc.hot = hot_bytes[lo:hi].copy().view(HOT_DTYPE).reshape(cc.n)
         cc.obj_ctr = obj_ctr[lo:hi].copy()
         cc.obj_actor = obj_actor[lo:hi].copy()
         cc.obj_has = obj_has[lo:hi].copy()

@@ -120,7 +120,7 @@ class DeviceDoc:
         return cls(
             log,
             merge_columns(
-                log.padded_columns(), fetch=cls.READ_FETCH, n_objs=log.n_objs,
+                log.columns(), fetch=cls.READ_FETCH, n_objs=log.n_objs,
                 n_props=len(log.props),
             ),
         )

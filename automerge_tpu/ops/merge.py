@@ -829,6 +829,22 @@ def merge_columns(cols_np, linearize: str = "auto", fetch=None, n_objs=None,
         )
         return {k: out[k] for k in need}
 
+    # the jit kernels need bucket-padded shapes; callers may hand over the
+    # raw (unpadded) columns dict — the host engine above consumed it
+    # as-is, the device path pads here (idempotent for padded input)
+    from .oplog import pad_columns
+
+    n_objs_eff = (
+        n_objs
+        if n_objs is not None
+        else (
+            int(np.asarray(cols_np["obj_dense"]).max()) + 1
+            if len(cols_np["action"])
+            else 1
+        )
+    )
+    cols_np = pad_columns(cols_np, n_objs_eff)
+
     transport = os.environ.get("AUTOMERGE_TPU_TRANSPORT")
     if transport is None:
         transport = (

@@ -438,6 +438,27 @@ class OpLog:
 
     # -- device prep -----------------------------------------------------
 
+    def columns(self, covered: np.ndarray = None):
+        """The device-facing column dict WITHOUT capacity padding — the
+        host merge engine consumes it as-is (merge_columns pads lazily
+        when it routes to the jit kernel, whose shapes must bucket).
+        """
+        if covered is None:
+            covered = np.ones(self.n, np.bool_)
+        return {
+            "action": self.action,
+            "insert": np.asarray(self.insert, np.bool_),
+            "prop": self.prop,
+            "elem_ref": self.elem_ref,
+            "obj_dense": self.obj_dense,
+            "value_tag": self.value_tag,
+            "value_i32": self.value_int.astype(np.int32),
+            "width": self.width,
+            "covered": np.asarray(covered, np.bool_),
+            "pred_src": self.pred_src,
+            "pred_tgt": self.pred_tgt,
+        }
+
     def padded_columns(self, min_capacity: int = 16, covered: np.ndarray = None):
         """Pad to power-of-two capacities for shape-stable jit.
 
@@ -448,23 +469,9 @@ class OpLog:
         ``covered`` is the per-row clock mask for historical reads
         (default: every op covered — the current-state resolution).
         """
-        p = _capacity(self.n, min_capacity)
-        q = _capacity(len(self.pred_src), min_capacity)
-        if covered is None:
-            covered = np.ones(self.n, np.bool_)
-        return {
-            "action": _pad(self.action, p, PAD_ACTION),
-            "insert": _pad(self.insert, p, False),
-            "prop": _pad(self.prop, p, -1),
-            "elem_ref": _pad(self.elem_ref, p, ELEM_MAP),
-            "obj_dense": _pad(self.obj_dense, p, np.int32(self.n_objs)),
-            "value_tag": _pad(self.value_tag, p, TAG_NULL),
-            "value_i32": _pad(self.value_int.astype(np.int32), p, 0),
-            "width": _pad(self.width, p, 0),
-            "covered": _pad(np.asarray(covered, np.bool_), p, False),
-            "pred_src": _pad(self.pred_src, q, 0),
-            "pred_tgt": _pad(self.pred_tgt, q, -1),
-        }
+        return pad_columns(
+            self.columns(covered=covered), self.n_objs, min_capacity
+        )
 
     def covered_mask(self, clock_max_op: np.ndarray) -> np.ndarray:
         """Vectorized ``Clock::covers`` (reference: clock.rs:71-77): row i is
@@ -527,9 +534,39 @@ def _capacity(n: int, minimum: int = 16) -> int:
 
 
 def _pad(a: np.ndarray, size: int, fill) -> np.ndarray:
+    if len(a) == size:
+        return a
     out = np.full(size, fill, dtype=a.dtype)
     out[: len(a)] = a
     return out
+
+
+def pad_columns(cols, n_objs: int, min_capacity: int = 16):
+    """Pad a columns() dict to jit-bucket capacities (idempotent: already
+    bucket-sized arrays pass through untouched)."""
+    p = _capacity(len(cols["action"]), min_capacity)
+    q = _capacity(len(cols["pred_src"]), min_capacity)
+    fills = {
+        "action": PAD_ACTION,
+        "insert": False,
+        "prop": -1,
+        "elem_ref": ELEM_MAP,
+        "obj_dense": np.int32(n_objs),
+        "value_tag": TAG_NULL,
+        "value_i32": 0,
+        "width": 0,
+        "covered": False,
+        "pred_src": 0,
+        "pred_tgt": -1,
+    }
+    return {
+        k: _pad(
+            np.asarray(v),
+            q if k.startswith("pred_") else p,
+            fills.get(k, 0),
+        )
+        for k, v in cols.items()
+    }
 
 
 def host_forest(cols_np):

@@ -141,14 +141,14 @@ def main():
             fetch=DeviceDoc.READ_FETCH, n_objs=log.n_objs,
             n_props=len(log.props),
         )
-        res = merge_columns(log.padded_columns(), **kw)
+        res = merge_columns(log.columns(), **kw)
         best = (float("inf"), float("inf"))
         for _ in range(reps):
             t0 = time.perf_counter()
             log = OpLog.from_changes(chs)
             t_ex = time.perf_counter() - t0
             t0 = time.perf_counter()
-            res = merge_columns(log.padded_columns(), **kw)
+            res = merge_columns(log.columns(), **kw)
             t_mg = time.perf_counter() - t0
             if t_ex + t_mg < sum(best):
                 best = (t_ex, t_mg)
