@@ -34,6 +34,7 @@ __all__ = [
     "clone",
     "diff",
     "get_changes",
+    "get_conflicts",
     "get_last_local_change",
     "marks",
     "fork",
@@ -194,6 +195,27 @@ def diff(doc: Doc, before: List[bytes], after: List[bytes]):
     return doc._auto.diff(list(before), list(after))
 
 
+def get_conflicts(doc, prop):
+    """Conflicting values at ``prop`` as {opid-exid: value}, or None when
+    at most one writer is visible (reference: stable.ts:829 getConflicts
+    via conflicts.ts conflictAt — the keys are the writers' op ids, the
+    values every concurrent candidate including the winner).
+
+    ``doc`` is a Doc (root) or a nested Map/List proxy obtained through
+    subscripting, matching the JS idiom ``getConflicts(doc.pets[0],
+    "name")``."""
+    if isinstance(doc, Doc):
+        auto, obj = doc._auto, "_root"
+    elif isinstance(doc, (MapProxy, ListProxy)):
+        auto, obj = doc._auto, doc._obj
+    else:
+        raise TypeError("get_conflicts needs a Doc or a map/list proxy")
+    all_vals = auto.get_all(obj, prop)
+    if len(all_vals) <= 1:
+        return None
+    return {exid: _render(auto, rendered) for rendered, exid in all_vals}
+
+
 def marks(doc: Doc, key: str):
     """Mark spans of a text field: ``doc[key].marks()`` (next.ts marks).
     Nested texts are reached through the proxies: ``doc["a"]["b"].marks()``."""
@@ -260,11 +282,8 @@ def change_at(doc: Doc, heads: List[bytes], fn: Callable) -> Doc:
 # -- proxies ------------------------------------------------------------------
 
 
-def _read_value(auto: AutoDoc, obj: str, key):
-    got = auto.get(obj, key)
-    if got is None:
-        raise KeyError(key) if isinstance(key, str) else IndexError(key)
-    rendered, _ = got
+def _render(auto: AutoDoc, rendered):
+    """One rendered (kind, payload) from get/get_all -> proxy or value."""
     if rendered[0] == "obj":
         t, exid = rendered[1], rendered[2]
         if t in (ObjType.MAP, ObjType.TABLE):
@@ -275,6 +294,13 @@ def _read_value(auto: AutoDoc, obj: str, key):
     if rendered[0] == "counter":
         return rendered[1]
     return rendered[1].to_py()
+
+
+def _read_value(auto: AutoDoc, obj: str, key):
+    got = auto.get(obj, key)
+    if got is None:
+        raise KeyError(key) if isinstance(key, str) else IndexError(key)
+    return _render(auto, got[0])
 
 
 def write_value(

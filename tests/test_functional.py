@@ -168,3 +168,25 @@ def test_marks_on_nested_text():
     d = am.from_dict({"a": {"b": am.Text("nested")}})
     d = am.change(d, lambda r: r["a"]["b"].mark(0, 3, "em", True))
     assert [m.name for m in d["a"]["b"].marks()] == ["em"]
+
+
+def test_get_conflicts():
+    """stable.ts getConflicts: concurrent writers at one prop surface as
+    {opid: value}; single-writer props return None."""
+    import automerge_tpu.functional as F
+
+    d1 = F.init(actor=b"\x01" * 16)
+    d1 = F.change(d1, lambda d: d.__setitem__("pets", [{"name": "Lassie"}]))
+    d2 = F.load(F.save(d1), actor=b"\x02" * 16)
+    d2 = F.change(d2, lambda d: d["pets"][0].__setitem__("name", "Beethoven"))
+    d1 = F.change(d1, lambda d: d["pets"][0].__setitem__("name", "Babe"))
+    d3 = F.merge(d1, d2)
+    conflicts = F.get_conflicts(d3["pets"][0], "name")
+    assert conflicts is not None
+    assert sorted(conflicts.values()) == ["Babe", "Beethoven"]
+    assert all("@" in k for k in conflicts)  # opid-shaped keys
+    # non-conflicting prop
+    assert F.get_conflicts(d3, "pets") is None
+    # resolving the conflict clears it
+    d4 = F.change(d3, lambda d: d["pets"][0].__setitem__("name", "Rex"))
+    assert F.get_conflicts(d4["pets"][0], "name") is None
