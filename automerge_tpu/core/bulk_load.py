@@ -50,16 +50,17 @@ def flatten_changes(changes: Sequence) -> Dict[str, object]:
 
 
 def _flatten_fast(changes: Sequence) -> Dict[str, object]:
-    """Vectorized flatten: native batch column decode + rank translation
-    via the shared ops/extract.ranked_batch helper."""
-    from ..ops.extract import ranked_batch
+    """Vectorized flatten via the commit-time ChangeCols caches
+    (ops/assemble.ranked_from_caches): changes decoded once per object
+    lifetime, flattened with numpy concats + rank gathers."""
+    from ..ops.assemble import ranked_from_caches
 
     actor_bytes = sorted({bytes(a) for ch in changes for a in ch.actors})
     rank_of = {a: i for i, a in enumerate(actor_bytes)}
     if len(actor_bytes) >= (1 << ACTOR_BITS):
         raise ValueError("too many actors for packed id encoding")
 
-    r = ranked_batch(changes, rank_of)
+    r = ranked_from_caches(list(changes), rank_of)
     a = r["a"]
     return {
         "op_id": r["id_key"].astype(np.int64),
@@ -471,15 +472,28 @@ def stale_text(doc, obj_exid: str, state):
 
     sel = win[win >= 0]
     a = rb["a"]
-    vc = a["vcode"][sel].tolist()
-    off = a["voff"][sel].tolist()
-    ln = a["vlen"][sel].tolist()
+    vc = a["vcode"][sel]
+    off = a["voff"][sel].astype(np.int64)
+    ln = a["vlen"][sel].astype(np.int64)
     raw = a["vraw"]
+    if len(sel) == 0:
+        return ""
+    if bool((vc == 6).all()):
+        # pure-string text (the overwhelmingly common case): gather every
+        # value slice with one flat index build + one utf-8 decode instead
+        # of a per-element python loop
+        tot = int(ln.sum())
+        if tot == 0:
+            return ""
+        base = np.concatenate([[0], np.cumsum(ln)[:-1]])
+        idx = np.arange(tot, dtype=np.int64) + np.repeat(off - base, ln)
+        return np.frombuffer(raw, np.uint8)[idx].tobytes().decode("utf-8")
+    vcl, offl, lnl = vc.tolist(), off.tolist(), ln.tolist()
     parts = []
-    for i in range(len(vc)):
-        if vc[i] == 6:
-            o = off[i]
-            parts.append(raw[o : o + ln[i]].decode("utf-8"))
+    for i in range(len(vcl)):
+        if vcl[i] == 6:
+            o = offl[i]
+            parts.append(raw[o : o + lnl[i]].decode("utf-8"))
         else:
             parts.append("￼")
     return "".join(parts)

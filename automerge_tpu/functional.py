@@ -206,10 +206,10 @@ def get_conflicts(doc, prop):
     "name")``."""
     if isinstance(doc, Doc):
         auto, obj = doc._auto, "_root"
-    elif isinstance(doc, (MapProxy, ListProxy)):
+    elif isinstance(doc, (MapProxy, ListProxy, TextProxy)):
         auto, obj = doc._auto, doc._obj
     else:
-        raise TypeError("get_conflicts needs a Doc or a map/list proxy")
+        raise TypeError("get_conflicts needs a Doc or an object proxy")
     all_vals = auto.get_all(obj, prop)
     if len(all_vals) <= 1:
         return None

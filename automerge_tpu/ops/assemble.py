@@ -397,6 +397,184 @@ def _remap_tables(
     return table_list, _c32(remap_all), off, size
 
 
+def ranked_from_caches(changes: Sequence, rank_of: Dict[bytes, int]):
+    """extract.ranked_batch's output shape built from the commit-time
+    ChangeCols caches — no chunk re-decode. Concat (change) order, packed
+    ids rank-translated, string tables unioned globally. Serves the host
+    flatten path (core/bulk_load.py) so a replica that just decoded its
+    changes once never decodes them again for store rebuilds or stale
+    reads.
+
+    Semantic note vs ranked_batch: the cache schema encodes HEAD as
+    elem_ctr == 0 (ChangeCols erases the has-actor flag at build — same
+    convention the native assembler reads), while ranked_batch reads the
+    has-actor flag from the raw chunk columns. The two agree on every
+    well-formed chunk (op counters start at 1, so ctr 0 never names a
+    real element); a malformed ctr-0-with-actor key decodes as HEAD here.
+    The caller supplies rank_of (it also owns the actor-capacity check).
+    """
+    caches = ensure_change_cols(changes)
+    C = len(caches)
+
+    n_ops = np.fromiter((c.n for c in caches), np.int64, count=C)
+    row_off = np.concatenate([[0], np.cumsum(n_ops)]).astype(np.int64)
+    N = int(row_off[-1])
+    cor = np.repeat(np.arange(C, dtype=np.int64), n_ops)
+    start_op = np.fromiter((ch.start_op for ch in changes), np.int64, count=C)
+
+    tab_parts = [[rank_of[bytes(x)] for x in ch.actors] for ch in changes]
+    tab_size = np.fromiter((len(t) for t in tab_parts), np.int64, count=C)
+    tab_off = np.concatenate([[0], np.cumsum(tab_size)])[:-1].astype(np.int64)
+    tab_all = np.fromiter(
+        (r for t in tab_parts for r in t), np.int64,
+        count=int(tab_size.sum()),
+    )
+    author = tab_all[tab_off] if C else np.empty(0, np.int64)
+    clip = max(len(tab_all) - 1, 0)
+
+    def cat(field, dtype, sliced=False):
+        """Concatenate one cached column across changes. ``sliced`` is for
+        the sid columns whose backing buffer (the shared -1 filler) can
+        exceed the change's row count."""
+        if not C:
+            return np.empty(0, dtype)
+        if sliced:
+            arrs = [getattr(c, field)[: c.n] for c in caches]
+        else:
+            arrs = [getattr(c, field) for c in caches]
+        out = np.concatenate(arrs)
+        return out if out.dtype == dtype else out.astype(dtype)
+
+    within = np.arange(N, dtype=np.int64) - row_off[:-1][cor]
+    id_key = ((start_op[cor] + within) << ACTOR_BITS) | author[cor]
+
+    obj_has = cat("obj_has", np.bool_)
+    obj_actor = cat("obj_actor", np.int64)
+    obj_ctr = cat("obj_ctr", np.int64)
+    if N and np.any(obj_actor[obj_has] >= tab_size[cor][obj_has]):
+        raise AssembleError("actor index out of chunk-local table range")
+    obj = np.where(
+        obj_has,
+        (obj_ctr << ACTOR_BITS)
+        | tab_all[(tab_off[cor] + obj_actor).clip(max=clip)],
+        np.int64(0),
+    )
+
+    key_tables, prop_remap, prop_off, _ = _remap_tables(caches, "key_table")
+    sid = cat("key_sid", np.int64, sliced=True)
+    any_keys = any(c.key_table is not None for c in caches)
+    prop_ids = (
+        np.where(
+            sid >= 0,
+            prop_remap[(prop_off[cor] + sid).clip(min=0, max=max(len(prop_remap) - 1, 0))],
+            np.int32(-1),
+        ).astype(np.int32)
+        if any_keys
+        else None
+    )
+    mark_tables, mark_remap, mark_off, _ = _remap_tables(caches, "mark_table")
+    msid = cat("mark_sid", np.int64, sliced=True)
+    any_marks = any(c.mark_table is not None for c in caches)
+    mark_ids = (
+        np.where(
+            msid >= 0,
+            mark_remap[(mark_off[cor] + msid).clip(min=0, max=max(len(mark_remap) - 1, 0))],
+            np.int32(-1),
+        ).astype(np.int32)
+        if any_marks
+        else None
+    )
+
+    elem_ctr = cat("elem_ctr", np.int64)
+    elem_actor = cat("elem_actor", np.int64)
+    if N:
+        seq_rows = sid < 0
+        if np.any(
+            (elem_ctr[seq_rows] != 0)
+            & (elem_actor[seq_rows] >= tab_size[cor][seq_rows])
+        ):
+            raise AssembleError("actor index out of chunk-local table range")
+    elem = np.where(
+        sid >= 0,
+        np.int64(-1),
+        np.where(
+            elem_ctr == 0,
+            np.int64(0),
+            (elem_ctr << ACTOR_BITS)
+            | tab_all[(tab_off[cor] + elem_actor).clip(max=clip)],
+        ),
+    )
+
+    q_ops = np.fromiter((c.q for c in caches), np.int64, count=C)
+    pred_row_off = np.concatenate([[0], np.cumsum(q_ops)]).astype(np.int64)
+    Q = int(pred_row_off[-1])
+    pred_num = cat("pred_num", np.int64)
+    pred_src = np.repeat(np.arange(N, dtype=np.int64), pred_num)
+    corq = np.repeat(np.arange(C, dtype=np.int64), q_ops)
+    pred_ctr = (
+        np.concatenate([np.asarray(c.pred_ctr, np.int64) for c in caches])
+        if C
+        else np.empty(0, np.int64)
+    )
+    pred_actor = (
+        np.concatenate([np.asarray(c.pred_actor, np.int64) for c in caches])
+        if C
+        else np.empty(0, np.int64)
+    )
+    if Q and np.any(pred_actor >= tab_size[corq]):
+        raise AssembleError("pred actor index out of chunk-local table range")
+    pred_key = (pred_ctr << ACTOR_BITS) | tab_all[
+        (tab_off[corq] + pred_actor).clip(max=clip)
+    ]
+
+    raw_ln = np.fromiter((len(c.vraw) for c in caches), np.int64, count=C)
+    raw_off = np.concatenate([[0], np.cumsum(raw_ln)])[:-1].astype(np.int64)
+    vraw = b"".join(c.vraw for c in caches)
+    voff = cat("voff", np.int64) + raw_off[cor]
+
+    a = {
+        "n": N,
+        "n_ops": n_ops,
+        "row_off": row_off,
+        "raw_off": raw_off,
+        "raw_ln": raw_ln,
+        "change_of_row": cor,
+        "action": cat("action", np.int32),
+        "insert": cat("insert", np.bool_),
+        "expand": cat("expand", np.bool_),
+        "vcode": cat("vcode", np.int32),
+        "voff": voff,
+        "vlen": cat("vlen", np.int64),
+        "vraw": vraw,
+        "value_int": cat("value_int", np.int64),
+        "width": cat("width", np.int32),
+        "key_ids": prop_ids,
+        "key_table": key_tables,
+        "mark_ids": mark_ids,
+        "mark_table": mark_tables,
+        "pred_num": pred_num,
+        "pred_ctr": pred_ctr,
+        "pred_actor": pred_actor,
+        "pred_row_off": pred_row_off,
+        "key_has_actor": None,  # consumed pre-translation only
+        "key_ctr": None,
+        "key_actor": None,
+        "obj_ctr": obj_ctr,
+        "obj_actor": obj_actor,
+        "obj_has": obj_has,
+    }
+    return {
+        "a": a,
+        "id_key": id_key,
+        "obj": obj,
+        "prop_ids": prop_ids if prop_ids is not None else np.full(N, -1, np.int32),
+        "elem": elem,
+        "pred_src": pred_src,
+        "pred_key": pred_key,
+        "rank_of": rank_of,
+    }
+
+
 def assemble_log(log, changes: Sequence, rank_of: Dict[bytes, int]):
     """Fill ``log`` (an empty OpLog with actors/changes set) from cached
     per-change columns via the native assembler. Raises AssembleError on
