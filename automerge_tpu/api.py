@@ -320,6 +320,12 @@ class AutoDoc:
         self.commit()
         return self.doc.get_changes(have_deps)
 
+    def get_missing_deps(self, heads: List[bytes] = ()) -> List[bytes]:
+        """Hashes named as deps (or in ``heads``) but absent from history
+        (reference: automerge.rs get_missing_deps)."""
+        self.commit()
+        return self.doc.get_missing_deps(list(heads))
+
     def get_last_local_change(self):
         self.commit()
         idxs = self.doc.states.get(self.doc.actors.lookup(self.doc.actor), [])

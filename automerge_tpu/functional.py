@@ -19,6 +19,7 @@ before mutating), not by copying state.
 
 from __future__ import annotations
 
+import copy as _copy
 from typing import Callable, List, Optional
 
 from .api import AutoDoc
@@ -32,10 +33,33 @@ __all__ = [
     "change",
     "change_at",
     "clone",
+    "decode_change",
+    "decode_sync_message",
+    "decode_sync_state",
+    "delete_at",
     "diff",
+    "dump",
+    "empty_change",
+    "encode_change",
+    "encode_sync_message",
+    "encode_sync_state",
+    "equals",
+    "free",
+    "generate_sync_message",
+    "get_all_changes",
     "get_changes",
     "get_conflicts",
+    "get_cursor",
+    "get_cursor_position",
+    "get_history",
     "get_last_local_change",
+    "get_missing_deps",
+    "get_object_id",
+    "init_sync_state",
+    "insert_at",
+    "is_automerge",
+    "load_incremental",
+    "mark",
     "marks",
     "fork",
     "from_dict",
@@ -44,19 +68,29 @@ __all__ = [
     "init",
     "load",
     "merge",
+    "receive_sync_message",
     "save",
+    "save_incremental",
+    "save_since",
+    "splice",
     "to_dict",
+    "unmark",
+    "view",
 ]
 
 
 class Doc:
     """An immutable document value. Read like a dict; mutate via change()."""
 
-    __slots__ = ("_auto", "_superseded")
+    __slots__ = ("_auto", "_superseded", "_saved_heads")
 
     def __init__(self, auto: AutoDoc):
         object.__setattr__(self, "_auto", auto)
         object.__setattr__(self, "_superseded", False)
+        # save_incremental() bookkeeping: heads as of the last save()/
+        # save_incremental() on this value line (stable.ts saveIncremental
+        # keeps the same cursor inside the wasm handle).
+        object.__setattr__(self, "_saved_heads", [])
 
     # reads (delegate to a read-only proxy of the root)
     def __getitem__(self, key):
@@ -129,11 +163,19 @@ def from_dict(contents: dict, actor: Optional[bytes] = None) -> Doc:
 
 
 def load(data: bytes, actor: Optional[bytes] = None) -> Doc:
-    return Doc(AutoDoc.load(data, actor=ActorId(actor) if actor else None))
+    doc = Doc(AutoDoc.load(data, actor=ActorId(actor) if actor else None))
+    # loaded history counts as saved: save_incremental() right after load()
+    # returns nothing, like the wasm handle (stable.ts load + saveIncremental)
+    object.__setattr__(doc, "_saved_heads", doc._auto.get_heads())
+    return doc
 
 
 def save(doc: Doc) -> bytes:
-    return doc._auto.save()
+    data = doc._auto.save()
+    # save() resets the incremental cursor, like the wasm handle
+    # (stable.ts saveIncremental returns nothing new after a save()).
+    object.__setattr__(doc, "_saved_heads", doc._auto.get_heads())
+    return data
 
 
 def clone(doc: Doc, actor: Optional[bytes] = None) -> Doc:
@@ -163,7 +205,7 @@ def merge(doc: Doc, other: Doc) -> Doc:
     except BaseException:
         _untake(doc)
         raise
-    return Doc(merged)
+    return _progress(doc, merged)
 
 
 def get_changes(doc: Doc, have_deps: List[bytes] = ()) -> List[bytes]:
@@ -186,7 +228,7 @@ def apply_changes(doc: Doc, changes) -> Doc:
     except BaseException:
         _untake(doc)
         raise
-    return Doc(out)
+    return _progress(doc, out)
 
 
 def diff(doc: Doc, before: List[bytes], after: List[bytes]):
@@ -247,6 +289,14 @@ def _untake(doc: Doc) -> None:
     object.__setattr__(doc, "_superseded", False)
 
 
+def _progress(doc: Doc, auto: AutoDoc) -> Doc:
+    """Wrap ``auto`` as the successor value of ``doc``, carrying the
+    incremental-save cursor forward (stable.ts progressDocument)."""
+    out = Doc(auto)
+    object.__setattr__(out, "_saved_heads", list(doc._saved_heads))
+    return out
+
+
 def change(doc: Doc, fn_or_message, fn: Callable = None) -> Doc:
     """Apply ``fn(root_proxy)`` as one transaction on a NEW document value
     (reference: stable.ts:355 change())."""
@@ -261,7 +311,7 @@ def change(doc: Doc, fn_or_message, fn: Callable = None) -> Doc:
     except BaseException:
         _untake(doc)
         raise
-    return Doc(auto)
+    return _progress(doc, auto)
 
 
 def change_at(doc: Doc, heads: List[bytes], fn: Callable) -> Doc:
@@ -276,7 +326,7 @@ def change_at(doc: Doc, heads: List[bytes], fn: Callable) -> Doc:
     except BaseException:
         _untake(doc)
         raise
-    return Doc(auto)
+    return _progress(doc, auto)
 
 
 # -- proxies ------------------------------------------------------------------
@@ -507,3 +557,315 @@ class TextProxy:
 
 def to_dict(doc: Doc):
     return doc._auto.hydrate()
+
+
+# -- lifecycle extras (stable.ts parity) --------------------------------------
+
+
+def free(doc: Doc) -> None:
+    """No-op: memory is GC-managed here (stable.ts:281 free() exists only
+    for the wasm heap)."""
+
+
+def is_automerge(value) -> bool:
+    """True when ``value`` is a functional document value (stable.ts:1171)."""
+    return isinstance(value, Doc)
+
+
+def view(doc: Doc, heads: List[bytes]) -> Doc:
+    """A read-only value of the document as of ``heads`` (stable.ts:235).
+    change() on a view raises, exactly like the reference; clone() it to
+    get a writable copy at those heads."""
+    v = Doc(doc._auto.fork_at(list(heads)))
+    object.__setattr__(v, "_superseded", True)  # writes must go via clone()
+    return v
+
+
+def empty_change(doc: Doc, message: Optional[str] = None,
+                 timestamp: Optional[int] = None) -> Doc:
+    """A new value with one change containing no ops — useful to ACK merged
+    history (stable.ts:579 emptyChange)."""
+    auto = _take(doc)
+    try:
+        # "" and absent encode identically in the chunk; a non-None message
+        # is what arms the empty-commit path.
+        auto.transaction(message=message or "", timestamp=timestamp).commit()
+    except BaseException:
+        _untake(doc)
+        raise
+    return _progress(doc, auto)
+
+
+def equals(a, b) -> bool:
+    """Deep value equality over documents and plain values (stable.ts:999) —
+    history and actor ids are NOT compared, only contents. Doc.__eq__
+    already hydrates both sides for every Doc/plain combination."""
+    return a == b
+
+
+def get_object_id(value) -> Optional[str]:
+    """The exid of an object value, '_root' for a Doc, None for scalars
+    (stable.ts:864 getObjectId)."""
+    if isinstance(value, Doc):
+        return "_root"
+    if isinstance(value, (MapProxy, ListProxy, TextProxy)):
+        return value._obj
+    return None
+
+
+def dump(doc: Doc, file=None) -> None:
+    """Debug-print the op store (stable.ts:1157 dump)."""
+    doc._auto.doc.dump(file)
+
+
+# -- incremental save / load --------------------------------------------------
+
+
+def save_incremental(doc: Doc) -> bytes:
+    """The changes made since the last save()/save_incremental() on this
+    value line, as raw chunk bytes (stable.ts:711 saveIncremental). The
+    cursor travels with the value through change()/merge()."""
+    data = doc._auto.save_incremental_after(list(doc._saved_heads))
+    object.__setattr__(doc, "_saved_heads", doc._auto.get_heads())
+    return data
+
+
+def load_incremental(doc: Doc, data: bytes) -> Doc:
+    """A new value with the raw chunk bytes applied; the input is consumed
+    like merge() (stable.ts:673 loadIncremental)."""
+    out = _take(doc)
+    try:
+        out.load_incremental(data, on_partial="error")
+    except BaseException:
+        _untake(doc)
+        raise
+    return _progress(doc, out)
+
+
+def save_since(doc: Doc, heads: List[bytes]) -> bytes:
+    """Changes not covered by ``heads`` as raw chunk bytes
+    (stable.ts:1183 saveSince)."""
+    return doc._auto.save_incremental_after(list(heads))
+
+
+def get_all_changes(doc: Doc) -> List[bytes]:
+    """Every change in the document's history (stable.ts:895)."""
+    return get_changes(doc, [])
+
+
+def get_missing_deps(doc: Doc, heads: List[bytes] = ()) -> List[bytes]:
+    """Dependency hashes referenced but not present (stable.ts:1143)."""
+    return doc._auto.get_missing_deps(list(heads))
+
+
+# -- history ------------------------------------------------------------------
+
+
+class HistoryState:
+    """One entry of get_history(): a lazily-decoded change plus the lazily-
+    materialised document snapshot after it (stable.ts:942 State<T>)."""
+
+    __slots__ = ("_raw", "_index")
+
+    def __init__(self, raw: List[bytes], index: int):
+        self._raw = raw
+        self._index = index
+
+    @property
+    def change(self) -> dict:
+        return decode_change(self._raw[self._index])
+
+    @property
+    def snapshot(self) -> Doc:
+        return apply_changes(init(), self._raw[: self._index + 1])
+
+    def __repr__(self):
+        return f"HistoryState(#{self._index}: {self.change['hash']})"
+
+
+def get_history(doc: Doc) -> List[HistoryState]:
+    """The document's change history in causal order, with lazy snapshots
+    (stable.ts:942 getHistory — snapshot i applies changes 0..i to an
+    empty doc, exactly like the reference)."""
+    raw = get_all_changes(doc)
+    return [HistoryState(raw, i) for i in range(len(raw))]
+
+
+# -- change codec -------------------------------------------------------------
+
+
+def decode_change(data: bytes) -> dict:
+    """Parse one raw change chunk into its JSON form (stable.ts:1126
+    decodeChange): actor/seq/startOp/time/message/deps/hash/ops."""
+    from .expanded import expand_change
+    from .storage.change import parse_change
+
+    change_, _ = parse_change(bytes(data))
+    return expand_change(change_)
+
+
+def encode_change(expanded: dict) -> bytes:
+    """Build the raw chunk bytes for a JSON-form change (stable.ts:1121
+    encodeChange); decode_change(encode_change(x)) preserves the hash."""
+    from .expanded import collapse_change
+
+    return collapse_change(expanded).raw_bytes
+
+
+# -- sync ---------------------------------------------------------------------
+
+
+def init_sync_state():
+    """Fresh per-peer sync state (stable.ts:1116 initSyncState)."""
+    from .sync.protocol import SyncState
+
+    return SyncState()
+
+
+def encode_sync_state(state) -> bytes:
+    """Persistable form of a sync state — only the durable part
+    (shared heads) survives, like the reference (stable.ts:1016)."""
+    return state.encode()
+
+
+def decode_sync_state(data: bytes):
+    """Inverse of encode_sync_state (stable.ts:1028)."""
+    from .sync.protocol import SyncState
+
+    return SyncState.decode(data)
+
+
+def generate_sync_message(doc: Doc, state):
+    """(new_state, message_bytes | None): the next message for the peer
+    tracked by ``state`` (stable.ts:1046 — returns a fresh state instead
+    of mutating the argument, matching the functional idiom)."""
+    new_state = _copy.deepcopy(state)
+    msg = doc._auto.generate_sync_message(new_state)
+    return new_state, (msg.encode() if msg is not None else None)
+
+
+def receive_sync_message(doc: Doc, state, message):
+    """(new_doc, new_state) after applying a peer's sync message; the doc
+    input is consumed like merge() (stable.ts:1074)."""
+    from .sync.protocol import Message
+
+    out = _take(doc)
+    new_state = _copy.deepcopy(state)
+    try:
+        msg = Message.decode(message) if isinstance(message, (bytes, bytearray)) else message
+        out.receive_sync_message(new_state, msg)
+    except BaseException:
+        _untake(doc)
+        raise
+    return _progress(doc, out), new_state
+
+
+def encode_sync_message(message) -> bytes:
+    """Message object -> wire bytes (stable.ts:1131)."""
+    return message.encode()
+
+
+def decode_sync_message(data: bytes):
+    """Wire bytes -> Message object for inspection (stable.ts:1136)."""
+    from .sync.protocol import Message
+
+    return Message.decode(data)
+
+
+# -- path-addressed edits & cursors (next.ts parity) --------------------------
+
+
+def _resolve_path(root, path):
+    cur = root
+    for p in path:
+        cur = cur[p]
+    return cur
+
+
+def insert_at(list_proxy, index: int, *values):
+    """Insert values into a list or text draft inside change()
+    (stable.ts:108 insertAt — splice semantics, so a negative index is
+    normalised ONCE against the pre-insert length)."""
+    if isinstance(list_proxy, TextProxy):
+        list_proxy.insert(index if index >= 0 else len(list_proxy) + index,
+                          "".join(values))
+        return
+    if not isinstance(list_proxy, ListProxy):
+        raise TypeError("insert_at needs a list or text draft from change()")
+    if index < 0:
+        index += len(list_proxy)
+    for off, v in enumerate(values):
+        list_proxy.insert(index + off, v)
+
+
+def delete_at(list_proxy, index: int, num: int = 1):
+    """Delete ``num`` values from a list/text draft (stable.ts:122)."""
+    if isinstance(list_proxy, TextProxy):
+        list_proxy.delete(index, num)
+        return
+    if not isinstance(list_proxy, ListProxy):
+        raise TypeError("delete_at needs a list or text draft from change()")
+    for _ in range(num):
+        del list_proxy[index]
+
+
+def splice(draft, path: list, index, delete: int, new_text: str = ""):
+    """Splice a text (or list) found at ``path`` under a change() draft
+    (next.ts:289 splice). ``index`` may be a cursor string."""
+    target = _resolve_path(draft, path)
+    if not isinstance(target, (TextProxy, ListProxy)):
+        raise TypeError("splice needs a text or list at the given path")
+    if isinstance(index, str):
+        index = target._auto.get_cursor_position(target._obj, index)
+    if isinstance(target, TextProxy):
+        target.splice(index, delete, new_text)
+    else:
+        delete_at(target, index, delete)
+        insert_at(target, index, *new_text)
+
+
+def get_cursor(doc, path: list, index: int) -> str:
+    """A stable cursor for position ``index`` of the text/list at ``path``
+    (next.ts:336 getCursor)."""
+    target = _resolve_path(doc, path)
+    if not isinstance(target, (TextProxy, ListProxy)):
+        raise TypeError("get_cursor needs a text or list at the given path")
+    return target._auto.get_cursor(target._obj, index)
+
+
+def get_cursor_position(doc, path: list, cursor: str) -> int:
+    """The current index of ``cursor`` in the text/list at ``path``
+    (next.ts:366 getCursorPosition)."""
+    target = _resolve_path(doc, path)
+    if not isinstance(target, (TextProxy, ListProxy)):
+        raise TypeError("get_cursor_position needs a text or list at the given path")
+    return target._auto.get_cursor_position(target._obj, cursor)
+
+
+def mark(draft, path: list, range_, name: str, value):
+    """Mark a span of the text at ``path`` inside change() (next.ts:387).
+    ``range_`` is (start, end) or {'start':..., 'end':..., 'expand':...}."""
+    target = _resolve_path(draft, path)
+    if not isinstance(target, TextProxy):
+        raise TypeError("mark needs a text at the given path")
+    if isinstance(range_, dict):
+        start, end = range_["start"], range_["end"]
+        expand = range_.get("expand", "after")
+    else:
+        start, end = range_
+        expand = "after"
+    target.mark(start, end, name, value, expand)
+
+
+def unmark(draft, path: list, range_, name: str):
+    """Remove a mark from a span (next.ts:413 unmark)."""
+    target = _resolve_path(draft, path)
+    if not isinstance(target, TextProxy):
+        raise TypeError("unmark needs a text at the given path")
+    if isinstance(range_, dict):
+        start, end = range_["start"], range_["end"]
+        expand = range_.get("expand", "none")
+    else:
+        start, end = range_
+        expand = "none"
+    target.unmark(start, end, name, expand)

@@ -1,0 +1,320 @@
+"""Export-for-export parity of the functional API with the reference's JS
+wrapper (reference: javascript/src/stable.ts:194-1183 exports, plus
+javascript/src/next.ts:289-350 splice/getCursor/getCursorPosition and
+next.ts:387-438 mark/unmark). Each test mirrors the reference semantics of
+one export; the checklist test pins the mapping so a future rename breaks
+loudly.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+import automerge_tpu.functional as am
+
+
+# stable.ts export -> functional.py name (None = deliberately absent with a
+# reason in the comment).
+STABLE_EXPORTS = {
+    "init": "init",  # stable.ts:194
+    "view": "view",  # stable.ts:235
+    "clone": "clone",  # stable.ts:260
+    "free": "free",  # stable.ts:281
+    "from": "from_dict",  # stable.ts:301 ("from" is a Python keyword)
+    "change": "change",  # stable.ts:355
+    "changeAt": "change_at",  # stable.ts:449
+    "emptyChange": "empty_change",  # stable.ts:579
+    "load": "load",  # stable.ts:621
+    "loadIncremental": "load_incremental",  # stable.ts:673
+    "saveIncremental": "save_incremental",  # stable.ts:711
+    "save": "save",  # stable.ts:731
+    "merge": "merge",  # stable.ts:750
+    "getActorId": "get_actor",  # stable.ts:768
+    "getConflicts": "get_conflicts",  # stable.ts:829
+    "getLastLocalChange": "get_last_local_change",  # stable.ts:852
+    "getObjectId": "get_object_id",  # stable.ts:864
+    "getChanges": "get_changes",  # stable.ts:883
+    "getAllChanges": "get_all_changes",  # stable.ts:895
+    "applyChanges": "apply_changes",  # stable.ts:911
+    "getHistory": "get_history",  # stable.ts:942
+    "diff": "diff",  # stable.ts:964
+    "equals": "equals",  # stable.ts:999
+    "encodeSyncState": "encode_sync_state",  # stable.ts:1016
+    "decodeSyncState": "decode_sync_state",  # stable.ts:1028
+    "generateSyncMessage": "generate_sync_message",  # stable.ts:1046
+    "receiveSyncMessage": "receive_sync_message",  # stable.ts:1074
+    "initSyncState": "init_sync_state",  # stable.ts:1116
+    "encodeChange": "encode_change",  # stable.ts:1121
+    "decodeChange": "decode_change",  # stable.ts:1126
+    "encodeSyncMessage": "encode_sync_message",  # stable.ts:1131
+    "decodeSyncMessage": "decode_sync_message",  # stable.ts:1136
+    "getMissingDeps": "get_missing_deps",  # stable.ts:1143
+    "getHeads": "get_heads",  # stable.ts:1151
+    "dump": "dump",  # stable.ts:1157
+    "toJS": "to_dict",  # stable.ts:1163
+    "isAutomerge": "is_automerge",  # stable.ts:1171
+    "saveSince": "save_since",  # stable.ts:1183
+    "insertAt": "insert_at",  # stable.ts:108
+    "deleteAt": "delete_at",  # stable.ts:122
+}
+
+NEXT_EXPORTS = {
+    "splice": "splice",  # next.ts:289
+    "getCursor": "get_cursor",  # next.ts:336
+    "getCursorPosition": "get_cursor_position",  # next.ts:366
+    "mark": "mark",  # next.ts:387
+    "unmark": "unmark",  # next.ts:413
+    "marks": "marks",  # next.ts:438
+}
+
+
+def test_export_checklist():
+    for js_name, py_name in {**STABLE_EXPORTS, **NEXT_EXPORTS}.items():
+        assert hasattr(am, py_name), f"{js_name} -> {py_name} missing"
+        assert py_name in am.__all__, f"{py_name} not exported in __all__"
+
+
+def _two_docs():
+    d1 = am.from_dict({"k": 1}, actor=bytes([1]) * 16)
+    d2 = am.merge(am.init(actor=bytes([2]) * 16), d1)
+    d2 = am.change(d2, lambda d: d.update({"other": "x"}))
+    return am.clone(d1, actor=bytes([1]) * 16), d2
+
+
+# -- view / clone / free ------------------------------------------------------
+
+
+def test_view_reads_at_heads_and_rejects_change():
+    d1 = am.from_dict({"n": 1}, actor=bytes([3]) * 16)
+    h1 = am.get_heads(d1)
+    d2 = am.change(d1, lambda d: d.update({"n": 2}))
+    v = am.view(d2, h1)
+    assert v.to_py() == {"n": 1}
+    assert am.get_heads(v) == h1
+    # change on a view raises, like the reference's
+    # "Attempting to change an outdated document"
+    with pytest.raises(RuntimeError):
+        am.change(v, lambda d: d.update({"n": 3}))
+    # clone() gives a writable copy at those heads (stable.ts view docs)
+    w = am.change(am.clone(v), lambda d: d.update({"n": 3}))
+    assert w.to_py() == {"n": 3}
+    am.free(v)  # no-op, exists for parity
+
+
+def test_is_automerge():
+    assert am.is_automerge(am.init())
+    assert not am.is_automerge({"k": 1})
+    assert not am.is_automerge(None)
+
+
+# -- emptyChange --------------------------------------------------------------
+
+
+def test_empty_change_creates_opless_change():
+    d1 = am.from_dict({"k": 1}, actor=bytes([4]) * 16)
+    n_before = len(am.get_all_changes(d1))
+    d2 = am.empty_change(d1, "acknowledged")
+    raw = am.get_all_changes(d2)
+    assert len(raw) == n_before + 1
+    last = am.decode_change(raw[-1])
+    assert last["ops"] == []
+    assert last["message"] == "acknowledged"
+    assert d2.to_py() == {"k": 1}
+    # message is optional, like emptyChange(doc) in the reference
+    d3 = am.empty_change(d2)
+    assert len(am.get_all_changes(d3)) == n_before + 2
+
+
+# -- equals -------------------------------------------------------------------
+
+
+def test_equals_compares_contents_not_history():
+    a = am.from_dict({"x": [1, 2]}, actor=bytes([5]) * 16)
+    b = am.from_dict({"x": [1, 2]}, actor=bytes([6]) * 16)
+    assert am.equals(a, b)  # different actors/history, same value
+    assert am.equals(a, {"x": [1, 2]})  # plain values allowed
+    assert not am.equals(a, {"x": [1]})
+    assert am.equals(1, 1) and not am.equals(1, 2)
+
+
+# -- object ids ---------------------------------------------------------------
+
+
+def test_get_object_id():
+    d = am.from_dict({"m": {"n": 1}, "l": [1]}, actor=bytes([7]) * 16)
+    assert am.get_object_id(d) == "_root"
+    assert am.get_object_id(d["m"]) not in (None, "_root")
+    assert am.get_object_id(d["l"]) not in (None, "_root")
+    assert am.get_object_id(42) is None  # scalars have no id (stable.ts:864)
+
+
+# -- incremental save/load + saveSince ---------------------------------------
+
+
+def test_save_incremental_cursor_travels_with_value():
+    d1 = am.from_dict({"a": 1}, actor=bytes([8]) * 16)
+    first = am.save_incremental(d1)
+    assert first  # everything so far
+    # cursor advanced: nothing new on the same value
+    assert am.save_incremental(d1) == b""
+    # a change() later, the successor's incremental save has ONLY the delta
+    d2 = am.change(d1, lambda d: d.update({"b": 2}))
+    delta = am.save_incremental(d2)
+    # the delta is exactly the changes since d1's heads — only the new one
+    assert delta == am.save_since(d2, am.get_heads(d1))
+    assert delta != first
+    # receiver folds: init + first + delta == sender
+    r = am.load_incremental(am.load_incremental(am.init(), first), delta)
+    assert r.to_py() == {"a": 1, "b": 2}
+
+
+def test_save_resets_incremental_cursor():
+    d1 = am.from_dict({"a": 1}, actor=bytes([9]) * 16)
+    am.save(d1)
+    assert am.save_incremental(d1) == b""
+
+
+def test_save_since():
+    d1 = am.from_dict({"a": 1}, actor=bytes([10]) * 16)
+    h1 = am.get_heads(d1)
+    d2 = am.change(d1, lambda d: d.update({"b": 2}))
+    delta = am.save_since(d2, h1)
+    assert delta and am.save_since(d2, am.get_heads(d2)) == b""
+    base = am.load(am.save(am.clone(d1)))
+    assert am.load_incremental(base, delta).to_py() == {"a": 1, "b": 2}
+
+
+# -- history ------------------------------------------------------------------
+
+
+def test_get_history_lazy_change_and_snapshot():
+    d = am.from_dict({"n": 1}, actor=bytes([11]) * 16)
+    d = am.change(d, lambda x: x.update({"n": 2}))
+    d = am.change(d, lambda x: x.update({"n": 3}))
+    hist = am.get_history(d)
+    assert len(hist) == 3
+    assert [h.snapshot.to_py()["n"] for h in hist] == [1, 2, 3]
+    assert [h.change["seq"] for h in hist] == [1, 2, 3]
+    assert hist[-1].change["hash"] == am.get_heads(d)[0].hex()
+
+
+# -- change codec -------------------------------------------------------------
+
+
+def test_encode_decode_change_roundtrip():
+    d = am.from_dict({"k": "v", "l": [1]}, actor=bytes([12]) * 16)
+    raw = am.get_all_changes(d)[0]
+    decoded = am.decode_change(raw)
+    assert decoded["actor"] == (bytes([12]) * 16).hex()
+    assert decoded["seq"] == 1
+    assert am.encode_change(decoded) == raw  # hash-preserving roundtrip
+
+
+# -- missing deps -------------------------------------------------------------
+
+
+def test_get_missing_deps():
+    d1 = am.from_dict({"a": 1}, actor=bytes([13]) * 16)
+    d2 = am.change(am.clone(d1), lambda x: x.update({"b": 2}))
+    raw2 = am.get_all_changes(d2)[-1]
+    assert am.get_missing_deps(d1) == []
+    # naming an unknown head reports it missing (stable.ts:1143 semantics)
+    unknown = am.get_heads(d2)
+    assert am.get_missing_deps(d1, unknown) == unknown
+    assert raw2  # and applying it clears the gap
+    d1b = am.load_incremental(d1, raw2)
+    assert am.get_missing_deps(d1b, am.get_heads(d2)) == []
+
+
+# -- functional sync quartet --------------------------------------------------
+
+
+def test_functional_sync_round_trip():
+    a, b = _two_docs()
+    sa, sb = am.init_sync_state(), am.init_sync_state()
+    # run the protocol to quiescence, values and states threaded functionally
+    for _ in range(20):
+        sa, msg = am.generate_sync_message(a, sa)
+        if msg is not None:
+            b, sb = am.receive_sync_message(b, sb, msg)
+        sb, msg_b = am.generate_sync_message(b, sb)
+        if msg_b is not None:
+            a, sa = am.receive_sync_message(a, sa, msg_b)
+        if msg is None and msg_b is None:
+            break
+    assert a.to_py() == b.to_py()
+
+
+def test_generate_sync_message_does_not_mutate_input_state():
+    a, _ = _two_docs()
+    s0 = am.init_sync_state()
+    s1, msg = am.generate_sync_message(a, s0)
+    assert msg is not None
+    assert s0.last_sent_heads == [] and not s0.in_flight  # input untouched
+    assert s1.last_sent_heads == am.get_heads(a)
+
+
+def test_sync_state_and_message_codecs():
+    a, b = _two_docs()
+    sa = am.init_sync_state()
+    sa, msg = am.generate_sync_message(a, sa)
+    decoded = am.decode_sync_message(msg)
+    assert am.encode_sync_message(decoded) == msg
+    # persist/restore the durable part of the state
+    restored = am.decode_sync_state(am.encode_sync_state(sa))
+    assert restored.shared_heads == sa.shared_heads
+
+
+# -- insertAt / deleteAt / splice / cursors / marks ---------------------------
+
+
+def test_insert_at_delete_at():
+    d = am.from_dict({"l": [1, 4]}, actor=bytes([14]) * 16)
+    d = am.change(d, lambda x: am.insert_at(x["l"], 1, 2, 3))
+    assert d.to_py()["l"] == [1, 2, 3, 4]
+    d = am.change(d, lambda x: am.delete_at(x["l"], 1, 2))
+    assert d.to_py()["l"] == [1, 4]
+
+
+def test_insert_at_negative_index_normalised_once():
+    # splice semantics: -1 resolves against the PRE-insert length, once
+    d = am.from_dict({"l": [1, 2, 3]}, actor=bytes([17]) * 16)
+    d = am.change(d, lambda x: am.insert_at(x["l"], -1, "a", "b"))
+    assert d.to_py()["l"] == [1, 2, "a", "b", 3]
+
+
+def test_insert_at_delete_at_on_text():
+    # stable.ts insertAt/deleteAt work on Text too
+    d = am.from_dict({"t": am.Text("ad")}, actor=bytes([18]) * 16)
+    d = am.change(d, lambda x: am.insert_at(x["t"], 1, "b", "c"))
+    assert d.to_py()["t"] == "abcd"
+    d = am.change(d, lambda x: am.delete_at(x["t"], 1, 2))
+    assert d.to_py()["t"] == "ad"
+
+
+def test_load_marks_history_saved():
+    d = am.from_dict({"a": 1}, actor=bytes([19]) * 16)
+    d2 = am.load(am.save(d))
+    # nothing new to save incrementally right after load (wasm semantics)
+    assert am.save_incremental(d2) == b""
+
+
+def test_splice_by_path_and_cursor():
+    d = am.from_dict({"note": am.Text("hello world")}, actor=bytes([15]) * 16)
+    d = am.change(d, lambda x: am.splice(x, ["note"], 5, 6, "!"))
+    assert d.to_py()["note"] == "hello!"
+    # a cursor taken before an earlier insert still lands on the same char
+    cur = am.get_cursor(d, ["note"], 5)
+    d = am.change(d, lambda x: am.splice(x, ["note"], 0, 0, ">> "))
+    assert am.get_cursor_position(d, ["note"], cur) == 8
+    d = am.change(d, lambda x: am.splice(x, ["note"], cur, 1))
+    assert d.to_py()["note"] == ">> hello"
+
+
+def test_mark_unmark_by_path():
+    d = am.from_dict({"t": am.Text("abcdef")}, actor=bytes([16]) * 16)
+    d = am.change(d, lambda x: am.mark(x, ["t"], (1, 4), "bold", True))
+    spans = am.marks(d, "t")
+    assert any(m.name == "bold" for m in spans)
+    d = am.change(d, lambda x: am.unmark(x, ["t"], (1, 4), "bold"))
+    assert not [m for m in am.marks(d, "t") if m.name == "bold" and m.value]

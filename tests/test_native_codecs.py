@@ -49,25 +49,25 @@ CASES = [
     [0] * 100,
     list(range(50)),
     [2**40, 2**40, -(2**40), 0, None],
+    [-1, -1, -5, None, -(2**33)],
 ]
 
+# Negative values are only representable in the signed (SLEB) codec, so the
+# unsigned variant is only generated for non-negative cases.
+SIGNED_CASES = [(case, signed) for case in CASES for signed in (False, True)
+                if signed or not any(v is not None and v < 0 for v in case)]
 
-@pytest.mark.parametrize("case", CASES)
-@pytest.mark.parametrize("signed", [False, True])
+
+@pytest.mark.parametrize("case,signed", SIGNED_CASES)
 def test_rle_encode_identical(case, signed):
-    if not signed and any(v is not None and v < 0 for v in case):
-        pytest.skip("negative values need signed")
     kind = "int" if signed else "uint"
     expected = py_rle_encode(case, kind)
     vals, mask = arrays_from(case)
     assert native.rle_encode_array(vals, mask, signed) == expected
 
 
-@pytest.mark.parametrize("case", CASES)
-@pytest.mark.parametrize("signed", [False, True])
+@pytest.mark.parametrize("case,signed", SIGNED_CASES)
 def test_rle_decode_identical(case, signed):
-    if not signed and any(v is not None and v < 0 for v in case):
-        pytest.skip("negative values need signed")
     kind = "int" if signed else "uint"
     buf = py_rle_encode(case, kind)
     vals, mask = native.rle_decode_array(buf, signed, len(case) + 8)
