@@ -38,6 +38,10 @@ class AutoDoc:
         # (obj, tx, closure) memo for the per-edit splice hot path; valid
         # only while the same autocommit transaction is live
         self._splice_cache = None
+        # same shape for the per-op map-put hot path; _put_block pins the
+        # transaction whose values proved session-ineligible
+        self._put_cache = None
+        self._put_block = None
         self._diff_cursor: List[bytes] = []
         # persistent observer log (reference: autocommit.rs owns a PatchLog);
         # inactive until an observer is attached so the hot path pays nothing
@@ -109,7 +113,9 @@ class AutoDoc:
     def commit(self, message: Optional[str] = None, timestamp: Optional[int] = None) -> Optional[bytes]:
         tx = self._tx
         self._tx = None
-        self._splice_cache = None  # the closure retains the whole tx
+        self._splice_cache = None  # the closures retain the whole tx
+        self._put_cache = None
+        self._put_block = None
         if tx is None:
             return None
         if message is not None:
@@ -129,6 +135,8 @@ class AutoDoc:
         tx = self._tx
         self._tx = None
         self._splice_cache = None
+        self._put_cache = None
+        self._put_block = None
         return tx.rollback() if tx is not None else 0
 
     def pending_ops(self) -> int:
@@ -167,7 +175,34 @@ class AutoDoc:
     # -- mutation (delegates through the open transaction) ------------------
 
     def put(self, obj: str, prop, value) -> None:
-        self._ensure_tx().put(obj, prop, value)
+        c = self._put_cache
+        if c is not None and c[0] == obj and c[1] is self._tx:
+            r = c[2](prop, value)
+            if r > 0:
+                return
+            self._put_cache = None
+            if r < 0:
+                # key/value not session-eligible: stop rebuilding for this
+                # (transaction, object) or every such put would pay an
+                # O(keys) preload
+                self._put_block = (self._tx, obj)
+        tx = self._ensure_tx()
+        if self._put_block != (tx, obj):
+            # build the session BEFORE the first generic put: a pure-session
+            # transaction commits straight from arrays (no prefix rows)
+            fn = tx.fast_put_fn(obj)
+            if fn is None:
+                # ineligible object (conflicted key, wide ranks, no native):
+                # memoize or every put repeats the O(keys) eligibility scan
+                self._put_block = (tx, obj)
+            else:
+                r = fn(prop, value)
+                if r > 0:
+                    self._put_cache = (obj, tx, fn)
+                    return
+                if r < 0:
+                    self._put_block = (tx, obj)
+        tx.put(obj, prop, value)
 
     def put_object(self, obj: str, prop, obj_type: ObjType) -> str:
         return self._ensure_tx().put_object(obj, prop, obj_type)

@@ -21,6 +21,7 @@ from ..storage.change import (
     StoredChange,
     build_change,
     chunk_local_ops,
+    encode_map_tail_cols,
     encode_ops_with_tail,
 )
 from ..types import (
@@ -79,6 +80,10 @@ class Transaction:
         # non-splice access touches the document mid-transaction).
         self.enable_sessions = False
         self._sessions: Dict[OpId, object] = {}
+        # native map-put sessions (native/map_session.cpp): obj_id -> session.
+        # Same lifecycle as text sessions; per-op puts route through the
+        # fastcall map_put entry (api.AutoDoc.put cache).
+        self._msessions: Dict[OpId, object] = {}
         self._session_ops = 0
         self._had_session_ops = False
         doc.open_transactions.add(self)
@@ -105,6 +110,9 @@ class Transaction:
                     for ent in self._sessions.values():
                         ent[0].close()
                     self._sessions.clear()
+                    for ent in self._msessions.values():
+                        ent[0].close()
+                    self._msessions.clear()
                     self.doc._ops_stale = True
             except Exception:
                 pass
@@ -234,6 +242,79 @@ class Transaction:
 
         return fast
 
+    def fast_put_fn(self, obj: str):
+        """A minimal per-put closure for the map hot path, or None.
+
+        The map analogue of fast_splice_fn: collapses AutoDoc -> Transaction
+        -> MapSession into one closure frame and one METH_FASTCALL C call
+        that dispatches the value type, encodes the column payload, and
+        resolves pred (the key's current winner) natively. Returns an int:
+        1 = handled, 0 = session gone (caller may rebuild after the generic
+        path), -1 = key/value not session-eligible (caller must stop
+        rebuilding for this transaction or every ineligible value would pay
+        an O(keys) session preload)."""
+        from .. import native
+
+        fc = native.fastcall()
+        if fc is None or not hasattr(fc, "map_put"):
+            return None
+        if not self.enable_sessions or self.scope is not None or self._done:
+            return None
+        if self.actor_idx >= (1 << self._ID_RANK_BITS):
+            return None
+        obj_id = self._obj(obj)
+        ent = self._msessions.get(obj_id)
+        if ent is None:
+            lib = native.load()
+            if lib is None or not hasattr(lib, "am_map_create"):
+                return None
+            info = self.doc.ops.get_obj(obj_id)
+            if not isinstance(info.data, MapObject):
+                return None
+            import numpy as np
+
+            bits = self._ID_RANK_BITS
+            lim = 1 << bits
+            props = self.doc.props
+            keys: List[str] = []
+            winners: List[int] = []
+            for key_idx, run in info.data.props.items():
+                vis = [o for o in run if o.visible_at(None)]
+                if not vis:
+                    continue
+                if len(vis) > 1:
+                    return None  # conflicted key: python path handles preds
+                w = vis[0]
+                if w.id[1] >= lim:
+                    return None
+                keys.append(props.get(key_idx))
+                winners.append((w.id[0] << bits) | w.id[1])
+            sess = native.MapSession(self.actor_idx)
+            sess.init(keys, np.asarray(winners, np.int64))
+            ent = [sess, 0]  # [session, drained watermark]
+            self._msessions[obj_id] = ent
+        sess = ent[0]
+        if not sess._h:
+            return None
+        h = sess._h
+        fput = fc.map_put
+        start = self.start_op
+
+        def fast(key, value) -> int:
+            if sess._h is None or self._done:
+                return 0
+            n = fput(
+                h,
+                start + len(self.operations) + self._session_ops,
+                key, value,
+            )
+            if n < 0:
+                return -1
+            self._session_ops += n
+            return 1
+
+        return fast
+
     def _drain_all(self, drop: bool = False) -> None:
         """Materialize pending (undrained) session ops through the python
         per-op path (id order), so the op store reflects them.
@@ -242,11 +323,11 @@ class Transaction:
         state and the store now agree, and the drained watermark prevents
         re-materialization; ``drop=True`` (python mutations, which could
         invalidate session state) closes sessions entirely."""
-        if not self._sessions:
+        if not self._sessions and not self._msessions:
             return
         bits = self._ID_RANK_BITS
         mask = (1 << bits) - 1
-        rows = []  # (id_int, obj_id, export dict, row index)
+        rows = []  # (id_int, is_map, obj_id, export dict, row index)
         for obj_id, ent in list(self._sessions.items()):
             e = ent[0].export(ent[1])
             ent[1] += len(e["id"])
@@ -254,28 +335,53 @@ class Transaction:
                 ent[0].close()
                 del self._sessions[obj_id]
             for k in range(len(e["id"])):
-                rows.append((int(e["id"][k]), obj_id, e, k))
+                rows.append((int(e["id"][k]), False, obj_id, e, k))
+        for obj_id, ent in list(self._msessions.items()):
+            e = ent[0].export(ent[1])
+            ent[1] += len(e["id"])
+            if drop:
+                ent[0].close()
+                del self._msessions[obj_id]
+            # per-row payload offsets: prefix-sum of the vmeta byte lengths
+            offs = [0]
+            for vm in e["vmeta"]:
+                offs.append(offs[-1] + (int(vm) >> 4))
+            e["raw_off"] = offs
+            for k in range(len(e["id"])):
+                rows.append((int(e["id"][k]), True, obj_id, e, k))
         self._session_ops = 0
         rows.sort(key=lambda r: r[0])
-        for id_int, obj_id, e, k in rows:
+        for id_int, is_map, obj_id, e, k in rows:
             opid = (id_int >> bits, id_int & mask)
-            ref = int(e["elem_ref"][k])
-            elem = HEAD if ref == 0 else (ref >> bits, ref & mask)
-            if e["is_del"][k]:
+            if is_map:
+                key_idx = self.doc.props.cache(e["keys"][int(e["key_idx"][k])])
+                vm = int(e["vmeta"][k])
+                off = e["raw_off"][k]
+                p = int(e["pred"][k])
+                op = Op(
+                    id=opid,
+                    action=Action.PUT,
+                    value=_scalar_from_vmeta(vm, e["raw"][off:off + (vm >> 4)]),
+                    key=key_idx,
+                    pred=[] if p == 0 else [(p >> bits, p & mask)],
+                )
+            elif e["is_del"][k]:
+                ref = int(e["elem_ref"][k])
                 p = int(e["pred"][k])
                 op = Op(
                     id=opid,
                     action=Action.DELETE,
                     value=ScalarValue.null(),
-                    elem=elem,
+                    elem=HEAD if ref == 0 else (ref >> bits, ref & mask),
                     pred=[(p >> bits, p & mask)],
                 )
             else:
+                ref = int(e["elem_ref"][k])
                 op = Op(
                     id=opid,
                     action=Action.PUT,
                     value=ScalarValue("str", chr(int(e["cp"][k]))),
-                    elem=elem,
+                    elem=HEAD if ref == 0 else (ref >> bits, ref & mask),
                     insert=True,
                 )
             self.doc.ops.insert_op(obj_id, op)
@@ -302,6 +408,9 @@ class Transaction:
         for s2 in self._sessions.values():
             s2[0].close()
         self._sessions.clear()
+        for s2 in self._msessions.values():
+            s2[0].close()
+        self._msessions.clear()
         self._had_session_ops = True
 
         refs = e["elem_ref"]
@@ -894,6 +1003,9 @@ class Transaction:
         for ent in self._sessions.values():
             ent[0].close()
         self._sessions.clear()
+        for ent in self._msessions.values():
+            ent[0].close()
+        self._msessions.clear()
         self._session_ops = 0
         for obj_id, op in reversed(self.operations):
             self.doc.ops.remove_op(obj_id, op)
@@ -925,23 +1037,107 @@ class Transaction:
     # which keeps the commit-per-keystroke pattern O(tail) instead of O(doc)
     SMALL_TAIL_OPS = 256
 
+    def _export_change_map_session(self, obj_id: OpId, ent) -> StoredChange:
+        """Array-native commit for a pure map-session transaction: encode
+        the session's undrained puts straight into change columns
+        (storage/change.encode_map_tail_cols) without materializing per-op
+        python objects. Guarded by the caller: ``self.operations`` empty."""
+        import numpy as np
+
+        doc = self.doc
+        author = self.actor_idx
+        bits = self._ID_RANK_BITS
+        mask = (1 << bits) - 1
+        e = ent[0].export(ent[1])
+        for s2 in self._sessions.values():
+            s2[0].close()
+        self._sessions.clear()
+        for s2 in self._msessions.values():
+            s2[0].close()
+        self._msessions.clear()
+        self._had_session_ops = True
+
+        preds = e["pred"]
+        extra = set((preds[preds != 0] & mask).tolist())
+        if obj_id != ROOT_OBJ:
+            extra.add(obj_id[1])
+        _, other, local = chunk_local_ops(
+            [], author, lambda g: doc.actors.get(g).bytes,
+            extra_refs=sorted(extra),
+        )
+        lut = np.full(max(local) + 1, -1, np.int64)
+        for g, l in local.items():
+            lut[g] = l
+
+        tail = {
+            "obj_ctr": 0 if obj_id == ROOT_OBJ else obj_id[0],
+            "obj_actor": -1 if obj_id == ROOT_OBJ else local[obj_id[1]],
+            "key_idx": e["key_idx"],
+            "keys": e["keys"],
+            "val_meta": e["vmeta"],
+            "val_raw": e["raw"],
+            "pred_ctr": np.where(preds == 0, -1, preds >> bits).astype(np.int64),
+            "pred_actor": np.where(preds == 0, 0, lut[preds & mask]).astype(np.int64),
+        }
+        cols = encode_map_tail_cols(tail)
+        n_total = len(e["key_idx"])
+        ts = self.timestamp if self.timestamp is not None else 0
+        stored = StoredChange(
+            dependencies=list(self.deps),
+            actor=doc.actors.get(author).bytes,
+            other_actors=[doc.actors.get(g).bytes for g in other],
+            seq=self.seq,
+            start_op=self.start_op,
+            timestamp=ts,
+            message=self.message,
+            ops=LazyOps({}, n_total),
+        )
+        built = build_change(stored, cols=cols)
+        built.ops = LazyOps(built.op_col_data, n_total)
+        return built
+
     def _export_change(self) -> StoredChange:
         live = {
-            o: ent for o, ent in self._sessions.items()
+            (False, o): ent for o, ent in self._sessions.items()
             if ent[0].op_count() > ent[1]
         }
+        live.update({
+            (True, o): ent for o, ent in self._msessions.items()
+            if ent[0].op_count() > ent[1]
+        })
         undrained = sum(ent[0].op_count() - ent[1] for ent in live.values())
-        if live and (len(live) > 1 or undrained <= self.SMALL_TAIL_OPS):
+        if live and (
+            len(live) > 1
+            or (
+                undrained <= self.SMALL_TAIL_OPS
+                # ...but only when the tail is also a small FRACTION of the
+                # document: the session-export path marks the op store stale
+                # (next read rebuilds from the whole history), which beats
+                # per-op drain only when the tail isn't most of the doc
+                and undrained * 4 < self.doc.max_op
+            )
+        ):
             # multi-session commits interleave objects; small tails are
             # cheaper applied incrementally than via a stale-store rebuild
             self._drain_all(drop=True)
             live = {}
         if live:
-            ((obj_id, ent),) = live.items()
-            return self._export_change_session(obj_id, ent)
+            (((is_map, obj_id), ent),) = live.items()
+            if is_map:
+                if self.operations:
+                    # the map tail encoder takes no prefix rows; mixed
+                    # commits go through the materialized path
+                    self._drain_all(drop=True)
+                else:
+                    return self._export_change_map_session(obj_id, ent)
+            else:
+                return self._export_change_session(obj_id, ent)
         for ent in self._sessions.values():
             ent[0].close()
         self._sessions.clear()
+        for ent in self._msessions.values():
+            ent[0].close()
+        self._msessions.clear()
         doc = self.doc
         author = self.actor_idx
         rows = self._change_rows()
@@ -966,6 +1162,31 @@ class Transaction:
         return [
             self.doc.actors.cache(ActorId(a)) for a in change.actors
         ]
+
+
+def _scalar_from_vmeta(vmeta: int, raw: bytes) -> ScalarValue:
+    """Decode a map-session payload (value_meta code + raw bytes) back into
+    a ScalarValue for the materialized drain path."""
+    code = vmeta & 0xF
+    if code == 0:
+        return ScalarValue.null()
+    if code == 1:
+        return ScalarValue("bool", False)
+    if code == 2:
+        return ScalarValue("bool", True)
+    if code == 4:
+        from ..utils.leb128 import decode_sleb
+
+        return ScalarValue("int", decode_sleb(raw, 0)[0])
+    if code == 5:
+        import struct
+
+        return ScalarValue("f64", struct.unpack("<d", raw)[0])
+    if code == 6:
+        return ScalarValue("str", raw.decode("utf-8"))
+    if code == 7:
+        return ScalarValue("bytes", raw)
+    raise AutomergeError(f"unexpected map-session value code {code}")
 
 
 def _sv_width(v: ScalarValue, enc: int) -> int:

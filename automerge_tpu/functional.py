@@ -799,7 +799,11 @@ def insert_at(list_proxy, index: int, *values):
 
 
 def delete_at(list_proxy, index: int, num: int = 1):
-    """Delete ``num`` values from a list/text draft (stable.ts:122)."""
+    """Delete ``num`` values from a list/text draft (stable.ts:122
+    deleteAt — splice semantics, so a negative index is normalised ONCE
+    against the pre-delete length)."""
+    if index < 0:
+        index += len(list_proxy)
     if isinstance(list_proxy, TextProxy):
         list_proxy.delete(index, num)
         return

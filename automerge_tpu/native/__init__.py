@@ -30,6 +30,7 @@ _SRCS = [
     os.path.join(_HERE, "apply.cpp"),
     os.path.join(_HERE, "extract_batch.cpp"),
     os.path.join(_HERE, "session.cpp"),
+    os.path.join(_HERE, "map_session.cpp"),
     os.path.join(_HERE, "merge_cols.cpp"),
     os.path.join(_HERE, "assemble.cpp"),
     os.path.join(_HERE, "condense.cpp"),
@@ -219,6 +220,24 @@ def load() -> Optional[ctypes.CDLL]:
         fn = getattr(lib, name)
         fn.restype = ctypes.c_longlong
         fn.argtypes = argtypes
+    lib.am_map_create.restype = vp
+    lib.am_map_create.argtypes = [ctypes.c_int64]
+    lib.am_map_destroy.restype = None
+    lib.am_map_destroy.argtypes = [vp]
+    for name, argtypes in (
+        ("am_map_init", [vp, u8p, i64p, i64p, ctypes.c_int64]),
+        ("am_map_op_count", [vp]),
+        ("am_map_put", [vp, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64,
+                        ctypes.c_int32, ctypes.c_int64, ctypes.c_double, u8p,
+                        ctypes.c_int64]),
+        ("am_map_export_sizes", [vp, ctypes.c_int64, i64p, i64p]),
+        ("am_map_export", [vp, ctypes.c_int64, i64p, i64p, i64p, i64p, u8p]),
+        ("am_map_keytab_sizes", [vp, i64p, i64p]),
+        ("am_map_keytab", [vp, u8p, i64p]),
+    ):
+        fn = getattr(lib, name)
+        fn.restype = ctypes.c_longlong
+        fn.argtypes = argtypes
     _lib = lib
     return _lib
 
@@ -275,6 +294,7 @@ def fastcall():
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
         mod.setup(ctypes.cast(lib.am_edit_splice, ctypes.c_void_p).value)
+        mod.setup_map(ctypes.cast(lib.am_map_put, ctypes.c_void_p).value)
         _fastcall = mod
     except Exception:
         return None
@@ -799,3 +819,87 @@ class EditSession:
             if n <= cap:
                 return out[:n]
             cap = n
+
+
+class MapSession:
+    """The native map-put session (map_session.cpp): owns one map object's
+    visible-winner state inside a transaction; per-op puts resolve pred and
+    encode the value payload in C (fastcall map_put entry)."""
+
+    __slots__ = ("_lib", "_h")
+
+    def __init__(self, rank: int):
+        lib = load()
+        if lib is None or not hasattr(lib, "am_map_create"):
+            raise NativeUnavailable("native map session not available")
+        self._lib = lib
+        self._h = lib.am_map_create(rank)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.am_map_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def init(self, keys, winner_ids: np.ndarray) -> None:
+        """Preload existing visible keys (utf-8 strings) with winner ids."""
+        raws = [k.encode("utf-8") for k in keys]
+        offs = np.zeros(len(raws) + 1, np.int64)
+        if raws:
+            np.cumsum([len(r) for r in raws], out=offs[1:])
+        buf = _inbuf(b"".join(raws))
+        w = np.ascontiguousarray(winner_ids, np.int64)
+        if len(w) == 0:
+            w = np.zeros(1, np.int64)
+        self._lib.am_map_init(self._h, _u8(buf), _i64(offs), _i64(w), len(raws))
+
+    def op_count(self) -> int:
+        return int(self._lib.am_map_op_count(self._h))
+
+    def put(self, ctr: int, key: str, code: int, ival: int = 0,
+            fval: float = 0.0, raw: bytes = b"") -> int:
+        """ctypes put (tests / non-fastcall paths); the hot path goes
+        through fastcall.map_put instead."""
+        kb = key.encode("utf-8")
+        rb = _inbuf(raw)
+        return int(self._lib.am_map_put(
+            self._h, ctr, kb, len(kb), code, ival, fval, _u8(rb), len(raw)
+        ))
+
+    def export(self, start: int = 0):
+        """Emitted ops [start:] in id order: dict of numpy arrays plus the
+        raw value payload blob and the interned key table."""
+        n_rows = np.zeros(1, np.int64)
+        raw_bytes = np.zeros(1, np.int64)
+        self._lib.am_map_export_sizes(self._h, start, _i64(n_rows), _i64(raw_bytes))
+        n = int(n_rows[0])
+        rb = int(raw_bytes[0])
+        ids = np.empty(max(n, 1), np.int64)
+        key_idx = np.empty(max(n, 1), np.int64)
+        preds = np.empty(max(n, 1), np.int64)
+        vmeta = np.empty(max(n, 1), np.int64)
+        raw = np.empty(max(rb, 1), np.uint8)
+        self._lib.am_map_export(
+            self._h, start, _i64(ids), _i64(key_idx), _i64(preds),
+            _i64(vmeta), _u8(raw),
+        )
+        nk = np.zeros(1, np.int64)
+        kb = np.zeros(1, np.int64)
+        self._lib.am_map_keytab_sizes(self._h, _i64(nk), _i64(kb))
+        kbytes = np.empty(max(int(kb[0]), 1), np.uint8)
+        koffs = np.empty(int(nk[0]) + 1, np.int64)
+        self._lib.am_map_keytab(self._h, _u8(kbytes), _i64(koffs))
+        blob = kbytes[: int(kb[0])].tobytes()
+        keys = [
+            blob[int(koffs[i]):int(koffs[i + 1])].decode("utf-8")
+            for i in range(int(nk[0]))
+        ]
+        return {
+            "id": ids[:n], "key_idx": key_idx[:n], "pred": preds[:n],
+            "vmeta": vmeta[:n], "raw": raw[:rb].tobytes(), "keys": keys,
+        }

@@ -22,13 +22,23 @@ typedef int32_t i32;
 
 typedef i64 (*splice_fn_t)(void *, i64, i64, i64, const i32 *, const i32 *,
                            i64);
+typedef i64 (*map_put_fn_t)(void *, i64, const char *, i64, i32, i64, double,
+                            const uint8_t *, i64);
 
 static splice_fn_t g_splice = NULL;
+static map_put_fn_t g_map_put = NULL;
 
 static PyObject *setup(PyObject *self, PyObject *args) {
   unsigned long long addr;
   if (!PyArg_ParseTuple(args, "K", &addr)) return NULL;
   g_splice = (splice_fn_t)(uintptr_t)addr;
+  Py_RETURN_NONE;
+}
+
+static PyObject *setup_map(PyObject *self, PyObject *args) {
+  unsigned long long addr;
+  if (!PyArg_ParseTuple(args, "K", &addr)) return NULL;
+  g_map_put = (map_put_fn_t)(uintptr_t)addr;
   Py_RETURN_NONE;
 }
 
@@ -82,10 +92,80 @@ static PyObject *splice(PyObject *self, PyObject *const *args,
   return PyLong_FromLongLong(n);
 }
 
+/* map_put(handle:int, ctr:int, key:str, value) -> int
+ *
+ * The per-op map hot path: dispatches the Python value to the session's
+ * column payload form (value_meta type code + raw bytes) without building
+ * a ScalarValue, then records the op natively (map_session.cpp am_map_put:
+ * pred = the key's current winner). Returns ops emitted (1), or -3 when
+ * the key/value shape isn't session-eligible (empty key, non-str key,
+ * big int, exotic type) — the caller falls back to the generic path,
+ * which raises the proper typed errors. */
+static PyObject *map_put(PyObject *self, PyObject *const *args,
+                         Py_ssize_t nargs) {
+  if (nargs != 4) {
+    PyErr_SetString(PyExc_TypeError, "map_put expects 4 arguments");
+    return NULL;
+  }
+  if (g_map_put == NULL) {
+    PyErr_SetString(PyExc_RuntimeError, "fastcall.setup_map() not called");
+    return NULL;
+  }
+  void *h = (void *)(uintptr_t)PyLong_AsUnsignedLongLong(args[0]);
+  i64 ctr = PyLong_AsLongLong(args[1]);
+  if (PyErr_Occurred()) return NULL;
+  PyObject *key = args[2];
+  PyObject *val = args[3];
+  if (!PyUnicode_Check(key)) return PyLong_FromLong(-3);
+  Py_ssize_t klen;
+  const char *kbuf = PyUnicode_AsUTF8AndSize(key, &klen);
+  if (kbuf == NULL) return NULL;
+  if (klen == 0) return PyLong_FromLong(-3); /* empty key: python path raises */
+
+  i32 code;
+  i64 ival = 0;
+  double fval = 0.0;
+  const uint8_t *raw = NULL;
+  i64 raw_len = 0;
+  if (val == Py_None) {
+    code = 0; /* null */
+  } else if (PyBool_Check(val)) {
+    code = (val == Py_True) ? 2 : 1;
+  } else if (PyLong_Check(val)) {
+    int overflow = 0;
+    ival = PyLong_AsLongLongAndOverflow(val, &overflow);
+    if (overflow || (ival == -1 && PyErr_Occurred())) {
+      PyErr_Clear();
+      return PyLong_FromLong(-3); /* bigint: python path */
+    }
+    code = 4; /* int (sleb) — matches ScalarValue.from_py(int) */
+  } else if (PyFloat_Check(val)) {
+    fval = PyFloat_AS_DOUBLE(val);
+    code = 5;
+  } else if (PyUnicode_Check(val)) {
+    Py_ssize_t n;
+    raw = (const uint8_t *)PyUnicode_AsUTF8AndSize(val, &n);
+    if (raw == NULL) return NULL;
+    raw_len = n;
+    code = 6;
+  } else if (PyBytes_Check(val)) {
+    raw = (const uint8_t *)PyBytes_AS_STRING(val);
+    raw_len = PyBytes_GET_SIZE(val);
+    code = 7;
+  } else {
+    return PyLong_FromLong(-3); /* Counter/ScalarValue/objects: python path */
+  }
+  i64 n = g_map_put(h, ctr, kbuf, (i64)klen, code, ival, fval, raw, raw_len);
+  return PyLong_FromLongLong(n);
+}
+
 static PyMethodDef methods[] = {
     {"setup", setup, METH_VARARGS, "Install the am_edit_splice address."},
+    {"setup_map", setup_map, METH_VARARGS, "Install the am_map_put address."},
     {"splice", (PyCFunction)(void (*)(void))splice, METH_FASTCALL,
      "splice(handle, ctr0, pos, ndel, text, enc) -> ops emitted"},
+    {"map_put", (PyCFunction)(void (*)(void))map_put, METH_FASTCALL,
+     "map_put(handle, ctr, key, value) -> ops emitted"},
     {NULL, NULL, 0, NULL}};
 
 static struct PyModuleDef module = {PyModuleDef_HEAD_INIT, "am_fastcall",

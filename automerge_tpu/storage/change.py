@@ -315,6 +315,65 @@ def encode_ops_with_tail(prefix_ops: Sequence[ChangeOp], tail) -> List[Tuple[int
     ]
 
 
+def encode_map_tail_cols(tail) -> List[Tuple[int, bytes]]:
+    """Encode op columns for a pure map-put change from the native map
+    session (no prefix rows) — identical bytes to ``encode_change_ops``
+    over the materialized op list, at array speed.
+
+    ``tail`` fields (chunk-local actor indices, one row per op):
+      obj_ctr/obj_actor   ints (the session's object; obj_actor -1 = root)
+      key_idx (i64 into keys), keys (string table)
+      val_meta (i64: (byte_len << 4) | type_code), val_raw (bytes)
+      pred_ctr/pred_actor (i64, -1 = no pred)
+    """
+    import numpy as np
+
+    from .. import native
+    from ..types import Action
+
+    n = len(tail["key_idx"])
+    ones = np.ones(n, np.uint8)
+    zero_mask = np.zeros(n, np.uint8)
+    zeros = np.zeros(n, np.int64)
+
+    root = int(tail["obj_actor"]) < 0
+    obj_mask = zero_mask if root else ones
+    obj_ctr = zeros if root else np.full(n, int(tail["obj_ctr"]), np.int64)
+    obj_actor = zeros if root else np.full(n, int(tail["obj_actor"]), np.int64)
+
+    action = np.full(n, int(Action.PUT), np.int64)
+    t_pred_ctr = np.asarray(tail["pred_ctr"], np.int64)
+    has_pred = t_pred_ctr >= 0
+    pred_ctr = t_pred_ctr[has_pred]
+    pred_actor = np.asarray(tail["pred_actor"], np.int64)[has_pred]
+    ones_p = np.ones(len(pred_ctr), np.uint8)
+
+    expand = MaybeBooleanEncoder()
+    expand.append_run(False, n)
+    mark_name = RleEncoder("str")
+    mark_name.append_null_run(n)
+
+    return [
+        (COL_OBJ_ACTOR, native.rle_encode_array(obj_actor, obj_mask, False)),
+        (COL_OBJ_CTR, native.rle_encode_array(obj_ctr, obj_mask, False)),
+        (COL_KEY_ACTOR, native.rle_encode_array(zeros, zero_mask, False)),
+        (COL_KEY_CTR, native.delta_encode_array(zeros, zero_mask)),
+        (COL_KEY_STR, native.rle_encode_strtab(
+            np.asarray(tail["key_idx"], np.int64), tail["keys"])),
+        (COL_INSERT, native.bool_encode_array(zero_mask)),
+        (COL_ACTION, native.rle_encode_array(action, ones, False)),
+        (COL_VAL_META, native.rle_encode_array(
+            np.asarray(tail["val_meta"], np.int64), ones, False)),
+        (COL_VAL_RAW, bytes(tail["val_raw"])),
+        (COL_PRED_GROUP, native.rle_encode_array(
+            has_pred.astype(np.int64), ones, False)),
+        (COL_PRED_ACTOR, native.rle_encode_array(pred_actor, ones_p, False)),
+        (COL_PRED_CTR, native.delta_encode_array(pred_ctr, ones_p)),
+        (COL_EXPAND, expand.finish()),
+        (COL_MARK_NAME, mark_name.finish()),
+    ]
+
+
 def encode_change_cols_arrays(a) -> List[Tuple[int, bytes]]:
     """Full-array change-op column encode — byte-identical to
     ``encode_change_ops`` over the materialized ChangeOp list (the fast

@@ -283,6 +283,15 @@ def test_insert_at_negative_index_normalised_once():
     assert d.to_py()["l"] == [1, 2, "a", "b", 3]
 
 
+def test_delete_at_negative_index_normalised_once():
+    d = am.from_dict({"l": [1, 2, 3, 4], "t": am.Text("abcd")},
+                     actor=bytes([20]) * 16)
+    d = am.change(d, lambda x: am.delete_at(x["l"], -2, 2))
+    assert d.to_py()["l"] == [1, 2]
+    d = am.change(d, lambda x: am.delete_at(x["t"], -2, 2))
+    assert d.to_py()["t"] == "ab"
+
+
 def test_insert_at_delete_at_on_text():
     # stable.ts insertAt/deleteAt work on Text too
     d = am.from_dict({"t": am.Text("ad")}, actor=bytes([18]) * 16)

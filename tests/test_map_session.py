@@ -1,0 +1,249 @@
+"""Differential guard over the native map-put session (fastcall map_put ->
+map_session.cpp -> encode_map_tail_cols).
+
+The per-op map hot path replaces the reference's local_map_op flow
+(reference: rust/automerge/src/transaction/inner.rs:399-451 pred lookup +
+op insert + succ marking) with a native session; its change chunks must be
+byte-identical to the per-op python path, and every ineligible shape must
+fall back to that path with identical results.
+"""
+
+import random
+
+import pytest
+
+from automerge_tpu import native
+from automerge_tpu.api import AutoDoc
+from automerge_tpu.types import ActorId, ObjType, ScalarValue
+
+pytestmark = pytest.mark.skipif(
+    not native.available() or native.fastcall() is None,
+    reason="native map session unavailable",
+)
+
+
+def _python_twin(build):
+    """Run ``build`` against the session-enabled AutoDoc and a manual-tx
+    (python-only) twin; both must produce identical bytes."""
+    fast = AutoDoc(actor=ActorId(bytes([21]) * 16))
+    build(fast, fast)
+    h_fast = fast.commit()
+
+    slow = AutoDoc(actor=ActorId(bytes([21]) * 16))
+    tx = slow.transaction()
+    build(slow, tx)
+    h_slow = tx.commit()
+
+    assert h_fast == h_slow
+    assert fast.save() == slow.save()
+    assert fast.hydrate() == slow.hydrate()
+    return fast
+
+
+def test_all_scalar_kinds_byte_identical():
+    def build(doc, w):
+        w.put("_root", "i", 7)
+        w.put("_root", "neg", -12345)
+        w.put("_root", "s", "héllo \U0001f680")
+        w.put("_root", "f", 2.5)
+        w.put("_root", "t", True)
+        w.put("_root", "fa", False)
+        w.put("_root", "n", None)
+        w.put("_root", "by", b"\x00\xff")
+
+    d = _python_twin(build)
+    assert d.hydrate()["neg"] == -12345
+
+
+def test_overwrites_set_pred_chain():
+    def build(doc, w):
+        w.put("_root", "k", 1)
+        w.put("_root", "k", 2)
+        w.put("_root", "k", "three")
+
+    d = _python_twin(build)
+    assert d.hydrate() == {"k": "three"}
+    # reload sees exactly one visible op (preds consumed the others)
+    r = AutoDoc.load(d.save())
+    assert r.get_all("_root", "k") == d.get_all("_root", "k")
+
+
+def test_preloaded_winners_cross_commit():
+    """The second transaction's session preloads committed winners; its
+    overwrites must name them as preds, same as the python path."""
+
+    def base(doc):
+        for i in range(20):
+            doc.put("_root", f"k{i}", i)
+        doc.commit()
+
+    fast = AutoDoc(actor=ActorId(bytes([22]) * 16))
+    base(fast)
+    for i in range(0, 20, 2):
+        fast.put("_root", f"k{i}", i * 100)
+    fast.commit()
+
+    slow = AutoDoc(actor=ActorId(bytes([22]) * 16))
+    base(slow)
+    tx = slow.transaction()
+    for i in range(0, 20, 2):
+        tx.put("_root", f"k{i}", i * 100)
+    tx.commit()
+
+    assert fast.save() == slow.save()
+    assert fast.hydrate() == slow.hydrate()
+
+
+def test_nested_map_session():
+    def build(doc, w):
+        pass
+
+    d = AutoDoc(actor=ActorId(bytes([23]) * 16))
+    m = d.put_object("_root", "m", ObjType.MAP)
+    d.commit()
+    for i in range(100):
+        d.put(m, f"x{i}", i)
+    d.commit()
+    assert d.hydrate()["m"]["x42"] == 42
+    r = AutoDoc.load(d.save())
+    assert r.hydrate() == d.hydrate()
+
+
+@pytest.mark.filterwarnings("ignore:.*(log assembly|extraction|native save).*:RuntimeWarning")
+def test_ineligible_values_fall_back():
+    """Counters, bigints, non-str keys: generic path, identical results.
+    (>2^63 ints overflow the i64 array paths and warn through the graceful
+    per-op fallback — a pre-existing, tested fallback, so silenced here.)"""
+
+    def build(doc, w):
+        w.put("_root", "a", 1)
+        w.put("_root", "c", ScalarValue("counter", 5))
+        w.put("_root", "big", 2**70)
+        w.put("_root", "u", ScalarValue("uint", 3))
+        w.put("_root", "b", 2)
+
+    d = _python_twin(build)
+    assert d.hydrate()["big"] == 2**70
+    d.increment("_root", "c", 2)
+    assert d.hydrate()["c"] == 7
+
+
+def test_empty_key_raises():
+    d = AutoDoc(actor=ActorId(bytes([24]) * 16))
+    d.put("_root", "ok", 1)  # session live
+    with pytest.raises(Exception, match="empty"):
+        d.put("_root", "", 2)
+
+
+def test_conflicted_key_uses_python_path():
+    """A key with two concurrent winners is session-ineligible; preds must
+    cover BOTH (the python path's multi-pred), so the conflict collapses."""
+    a = AutoDoc(actor=ActorId(bytes([1]) * 16))
+    a.put("_root", "k", "a")
+    a.commit()
+    b = a.fork(actor=ActorId(bytes([2]) * 16))
+    b.put("_root", "k", "b")
+    b.commit()
+    a.put("_root", "k", "a2")
+    a.commit()
+    a.merge(b)
+    assert len(a.get_all("_root", "k")) == 2  # conflicted
+    a.put("_root", "k", "resolved")
+    a.commit()
+    assert a.get_all("_root", "k")[0][0][1].to_py() == "resolved"
+    assert len(a.get_all("_root", "k")) == 1
+    r = AutoDoc.load(a.save())
+    assert len(r.get_all("_root", "k")) == 1
+
+
+def test_interleaved_map_and_text_sessions():
+    def build(doc, w):
+        w.put("_root", "k1", 1)
+        w.put("_root", "k2", 2)
+
+    d = AutoDoc(actor=ActorId(bytes([25]) * 16))
+    t = d.put_object("_root", "t", ObjType.TEXT)
+    d.commit()
+    d.splice_text(t, 0, 0, "ab")
+    d.put("_root", "k1", 1)
+    d.splice_text(t, 2, 0, "cd")
+    d.put("_root", "k2", 2)
+    d.commit()
+    assert d.text(t) == "abcd"
+    assert d.hydrate()["k1"] == 1 and d.hydrate()["k2"] == 2
+    r = AutoDoc.load(d.save())
+    assert r.hydrate() == d.hydrate()
+
+
+def test_reads_mid_transaction_drain():
+    d = AutoDoc(actor=ActorId(bytes([26]) * 16))
+    d.put("_root", "k", 1)
+    assert d.get("_root", "k")[0][1].to_py() == 1  # drains, session stays
+    d.put("_root", "k", 2)
+    assert sorted(d.keys()) == ["k"]
+    d.commit()
+    assert d.hydrate() == {"k": 2}
+
+
+def test_rollback_discards_session_ops():
+    d = AutoDoc(actor=ActorId(bytes([27]) * 16))
+    d.put("_root", "keep", 1)
+    d.commit()
+    d.put("_root", "drop", 2)
+    d.rollback()
+    assert d.hydrate() == {"keep": 1}
+    r = AutoDoc.load(d.save())
+    assert r.hydrate() == {"keep": 1}
+
+
+def test_observer_patches_cover_session_ops():
+    d = AutoDoc(actor=ActorId(bytes([28]) * 16))
+    seen = []
+    d.set_patch_callback(lambda ps: seen.extend(ps))
+    for i in range(5):
+        d.put("_root", f"k{i}", i)
+    d.commit()
+    assert len(seen) == 5
+    assert all(p.obj == "_root" for p in seen)
+
+
+def test_merge_convergence_with_session_changes():
+    a = AutoDoc(actor=ActorId(bytes([3]) * 16))
+    for i in range(200):
+        a.put("_root", f"k{i:03}", i)
+    a.commit()
+    b = a.fork(actor=ActorId(bytes([4]) * 16))
+    for i in range(0, 200, 3):
+        b.put("_root", f"k{i:03}", -i)
+    b.commit()
+    for i in range(0, 200, 5):
+        a.put("_root", f"k{i:03}", i * 7)
+    a.commit()
+    c = a.fork(actor=ActorId(bytes([5]) * 16))
+    a.merge(b)
+    b.merge(c)
+    assert a.hydrate() == b.hydrate()
+    assert a.save_and_verify() is not None
+
+
+@pytest.mark.filterwarnings("ignore:.*(log assembly|extraction|native save).*:RuntimeWarning")
+def test_randomized_differential():
+    rng = random.Random(99)
+    vals = [None, True, False, 0, 1, -1, 2**40, -(2**40), 1.5, "", "x",
+            "é\U0001f680", b"", b"\x00", 2**70, ScalarValue("counter", 1)]
+
+    def build(doc, w):
+        for i in range(300):
+            w.put("_root", f"k{rng.randrange(40):02}", rng.choice(vals))
+
+    rng_state = rng.getstate()
+    fast = AutoDoc(actor=ActorId(bytes([29]) * 16))
+    build(fast, fast)
+    h1 = fast.commit()
+    rng.setstate(rng_state)
+    slow = AutoDoc(actor=ActorId(bytes([29]) * 16))
+    tx = slow.transaction()
+    build(slow, tx)
+    h2 = tx.commit()
+    assert h1 == h2
+    assert fast.save() == slow.save()
