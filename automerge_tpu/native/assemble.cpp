@@ -70,10 +70,11 @@ extern "C" {
 //   9 vcode    i32[n]    10 vlen     i64[n]  11 voff      i64[n]
 //  12 value_int i64[n]   13 width    i32[n]  14 mark_sid  i32[n]
 //  15 pred_num i32[n]    16 pred_ctr i64[q]  17 pred_actor i32[q]
-//  18 hot: 40-byte AoS record {elem_ctr i64, vlen i64, voff i64,
-//     action i32, elem_actor i32, vcode i32, insert u8, pad[3]} — the
+//  18 hot: 24-byte AoS record {elem_ctr i64, voff u32, vlen u32,
+//     elem_actor i32, action u8, vcode u8, insert u8, pad} — the
 //     gather-heavy columns interleaved so a permuted row read touches
-//     one cache line, not seven per-change streams
+//     one cache line, not seven per-change streams (and at 24B, 2.6
+//     rows per line instead of 1.6)
 //
 // g_flags/g_vals (18 slots, indexed like the columns): globally-constant
 // columns the caller proved identical across every change — the
@@ -94,6 +95,11 @@ long long am_assemble_log(
     const int64_t* col_ptrs, int64_t n_changes, const int64_t* tab_all,
     const int32_t* prop_remap_all, const int32_t* mark_remap_all,
     int32_t actor_bits, const int64_t* g_flags, const int64_t* g_vals,
+    // per-change constant shortcuts (see assemble.py _per_change_const):
+    // c_obj_key[c] >= 0: every row of change c targets that packed object
+    // (-1 = varies); c_sid_arr[c] == -1: all rows seq-keyed, >= 0: one
+    // global map prop, -2 = varies
+    const int64_t* c_obj_key, const int64_t* c_sid_arr,
     // outputs, length N
     int64_t* id_key, int64_t* obj_key, int32_t* prop, int32_t* action,
     uint8_t* insert, uint8_t* expand, int32_t* value_tag,
@@ -164,25 +170,31 @@ long long am_assemble_log(
                      return author_rank[a] < author_rank[b];
                    });
   if (range <= std::max<int64_t>(4 * N, 1 << 22)) {
-    // counting sort over the counter range (the common, regular case)
-    std::vector<int64_t> bucket(range + 1, 0);
-    for (int64_t c = 0; c < C; c++)
-      for (int64_t i = 0; i < n_ops[c]; i++)
-        bucket[start_op[c] + i - min_ctr]++;
-    int64_t acc = 0;
+    // counting sort over the counter range (the common, regular case);
+    // i32 buckets halve the table's cache traffic (counts and positions
+    // both fit: N < 2^31)
+    // counts via an interval diff array (each change covers a consecutive
+    // counter range): O(C + range) instead of O(N) scattered increments
+    std::vector<int32_t> bucket(range + 1, 0);
+    for (int64_t c = 0; c < C; c++) {
+      if (!n_ops[c]) continue;
+      bucket[start_op[c] - min_ctr]++;
+      bucket[start_op[c] + n_ops[c] - min_ctr]--;
+    }
+    int32_t cover = 0, acc = 0;
     for (int64_t b = 0; b < range; b++) {
-      const int64_t t = bucket[b];
+      cover += bucket[b];
       bucket[b] = acc;
-      acc += t;
+      acc += cover;
     }
     for (int64_t ci = 0; ci < C; ci++) {
       const int64_t c = by_rank[ci];
       const int64_t base = row_off[c], s0 = start_op[c] - min_ctr;
       for (int64_t i = 0; i < n_ops[c]; i++) {
-        const int64_t pos = bucket[s0 + i]++;
+        const int32_t pos = bucket[s0 + i]++;
         src[pos] = (int32_t)(base + i);
         src_c[pos] = (int32_t)c;
-        newrow[base + i] = (int32_t)pos;
+        newrow[base + i] = pos;
       }
     }
   } else {
@@ -259,7 +271,9 @@ long long am_assemble_log(
   const bool c_vo = g_flags[11] != 0, c_vi = g_flags[12] != 0;
   const bool c_w = g_flags[13] != 0, c_mark = g_flags[14] != 0;
   if (c_obj) std::fill(obj_key, obj_key + N, g_vals[1]);
-  if (c_sid == 1) std::fill(prop, prop + N, (int32_t)-1);
+  // prop defaults to -1 via ONE memset; the gather loop then only writes
+  // map-prop rows (real logs are sequence-dominated)
+  if (c_sid != 2) std::memset(prop, 0xFF, (size_t)N * sizeof(int32_t));
   if (c_sid == 2) {
     std::fill(prop, prop + N, (int32_t)g_vals[4]);
     std::fill(elem_ref, elem_ref + N, ELEM_MAP);
@@ -278,35 +292,39 @@ long long am_assemble_log(
   if (c_w) std::fill(width, width + N, (int32_t)g_vals[13]);
   if (c_mark) std::fill(mark_idx, mark_idx + N, (int32_t)g_vals[14]);
 
-  // (make_prefix/obj_table fill alongside so pass 4 only resolves obj ids)
-  std::vector<int32_t> make_prefix(N + 1);
-  make_prefix[0] = 0;
+  // (obj_table fills alongside; make ranks resolve later by binary search
+  // over it — the table is tiny, and this drops the old N-row make_prefix
+  // stream entirely)
   obj_table[0] = 0;
   int64_t n_make = 0;
   for (int64_t j = 0; j < N; j++) {
     const int64_t c = src_c[j];
     const int64_t i = src[j] - row_off[c];
     const int64_t* ptrs = col_ptrs + c * 19;
-    const uint8_t* rec = (const uint8_t*)(uintptr_t)ptrs[18] + i * 40;
+    const uint8_t* rec = (const uint8_t*)(uintptr_t)ptrs[18] + i * 24;
     id_key[j] = ((start_op[c] + i) << AB) | author_rank[c];
-    const int32_t a = *(const int32_t*)(rec + 24);
+    const int32_t a = rec[20];
     action[j] = a;
     if (is_make_action(a)) obj_table[1 + n_make++] = id_key[j];
-    make_prefix[j + 1] = (int32_t)n_make;
-    if (!c_ins) insert[j] = rec[36];
+    if (!c_ins) insert[j] = rec[22];
     if (!c_exp) expand[j] = ((const uint8_t*)(uintptr_t)ptrs[8])[i];
     if (!c_vc) {
-      const int32_t vc = *(const int32_t*)(rec + 32);
+      const int32_t vc = rec[21];
       vcode[j] = vc;
       value_tag[j] = vc > TAG_UNKNOWN ? TAG_UNKNOWN : vc;
     }
-    if (!c_vl) vlen[j] = *(const int64_t*)(rec + 8);
-    if (!c_vo) voff[j] = *(const int64_t*)(rec + 16) + raw_base[c];
+    if (!c_vl) vlen[j] = *(const uint32_t*)(rec + 12);
+    if (!c_vo) voff[j] = (int64_t)*(const uint32_t*)(rec + 8) + raw_base[c];
     if (!c_vi) value_int[j] = ((const int64_t*)(uintptr_t)ptrs[12])[i];
     if (!c_w) width[j] = ((const int32_t*)(uintptr_t)ptrs[13])[i];
-    // object id
+    // object id (per-change const shortcut first: nearly every real
+    // change targets one object, so the has/actor/ctr loads + table
+    // translation collapse to a single C-array read)
     if (!c_obj) {
-      if (((const uint8_t*)(uintptr_t)ptrs[3])[i]) {
+      const int64_t cobj = c_obj_key[c];
+      if (cobj >= 0) {
+        obj_key[j] = cobj;
+      } else if (((const uint8_t*)(uintptr_t)ptrs[3])[i]) {
         const int32_t oa = ((const int32_t*)(uintptr_t)ptrs[2])[i];
         if (oa < 0 || oa >= tab_size[c]) return -4;
         const int64_t octr = ((const int64_t*)(uintptr_t)ptrs[1])[i];
@@ -318,19 +336,22 @@ long long am_assemble_log(
     }
     // key: map prop or sequence element
     if (c_sid != 2) {
+      const int64_t csid = c_sid == 1 ? -1 : c_sid_arr[c];
       const int32_t sid =
-          c_sid == 1 ? -1 : ((const int32_t*)(uintptr_t)ptrs[4])[i];
-      if (sid >= 0) {
+          csid != -2 ? -1 : ((const int32_t*)(uintptr_t)ptrs[4])[i];
+      if (csid >= 0) {
+        prop[j] = (int32_t)csid;
+        elem_ref[j] = ELEM_MAP;
+      } else if (sid >= 0) {
         if (prop_off[c] < 0 || sid >= prop_size[c]) return -6;
         prop[j] = prop_remap_all[prop_off[c] + sid];
         elem_ref[j] = ELEM_MAP;
       } else {
-        if (c_sid == 0) prop[j] = -1;
         const int64_t ectr = *(const int64_t*)(rec + 0);
         if (ectr == 0) {
           elem_ref[j] = ELEM_HEAD;
         } else {
-          const int32_t ea = *(const int32_t*)(rec + 28);
+          const int32_t ea = *(const int32_t*)(rec + 16);
           if (ea < 0 || ea >= tab_size[c]) return -7;
           if (ectr < 0 || ectr >= ((int64_t)1 << 43)) return -8;
           const int32_t r = resolve2(ectr, tab_all[tab_off[c] + ea], c);
@@ -354,7 +375,22 @@ long long am_assemble_log(
 
   // ---- 4. dense object ids ------------------------------------------------
   // ops overwhelmingly share their container: a one-entry memo turns the
-  // resolve into a single compare for nearly every row
+  // resolve into a single compare for nearly every row. The make RANK of a
+  // resolved row comes from a binary search over the (tiny, L1-resident)
+  // obj_table instead of the old N-row make_prefix stream.
+  auto make_rank = [&](int32_t r) -> int64_t {
+    const int64_t idk = id_key[r];
+    int64_t lo = 0, hi = n_make;
+    while (lo < hi) {
+      const int64_t mid = (lo + hi) >> 1;
+      if (obj_table[1 + mid] < idk)
+        lo = mid + 1;
+      else
+        hi = mid;
+    }
+    if (lo >= n_make || obj_table[1 + lo] != idk) return -1;
+    return lo;
+  };
   bool obj_fallback = false;
   if (c_obj) {
     const int64_t k = g_vals[1];
@@ -362,10 +398,13 @@ long long am_assemble_log(
     if (k != 0) {
       const int32_t r =
           resolve2(k >> AB, k & (((int64_t)1 << AB) - 1), src_c[0]);
-      if (r < 0 || !is_make_action(action[r]))
+      const int64_t mr = r < 0 || !is_make_action(action[r])
+                             ? -1
+                             : make_rank(r);
+      if (mr < 0)
         obj_fallback = true;
       else
-        dense = 1 + make_prefix[r];
+        dense = (int32_t)(1 + mr);
     }
     if (!obj_fallback) std::fill(obj_dense, obj_dense + N, dense);
   } else {
@@ -383,12 +422,15 @@ long long am_assemble_log(
       }
       const int32_t r = resolve2(k >> AB, k & (((int64_t)1 << AB) - 1),
                                  src_c[j]);
-      if (r < 0 || !is_make_action(action[r])) {
+      const int64_t mr = r < 0 || !is_make_action(action[r])
+                             ? -1
+                             : make_rank(r);
+      if (mr < 0) {
         obj_fallback = true;  // partial history: host recomputes the table
         break;
       }
       memo_obj_key = k;
-      memo_obj_dense = 1 + make_prefix[r];
+      memo_obj_dense = (int32_t)(1 + mr);
       obj_dense[j] = memo_obj_dense;
     }
   }

@@ -13,6 +13,7 @@
 #include <cstddef>
 #include <cstdint>
 #include <cstring>
+#include <vector>
 
 namespace {
 
@@ -330,31 +331,39 @@ extern "C" {
 long long am_preorder_index(const int32_t* first_child, const int32_t* next_sib,
                             const int32_t* parent, int64_t P, int64_t N,
                             int32_t* out) {
+    // Explicit-stack preorder: push the pending sibling when descending, so
+    // chain tails never climb the parent chain (the old climb was O(depth)
+    // per tail — on chain-heavy logs that re-walked whole insert runs).
+    // RGA trees are chains of CONSECUTIVE rows almost everywhere (an insert
+    // run's child is the next row), so the hot path reads first_child /
+    // next_sib sequentially and the stack stays near-empty.
+    (void)parent;
     for (int64_t i = 0; i < P; i++) out[i] = -1;
-    int64_t budget = 4 * N + 8;  // cycle guard
+    std::vector<int32_t> stack;
+    stack.reserve(64);
+    int64_t budget = 2 * N + 8;  // cycle guard
     for (int64_t r = P; r < N - 1; r++) {
         int32_t cur = first_child[r];
         int32_t idx = 0;
         while (cur >= 0 && cur < P) {
             if (--budget < 0) return -1;
+            if (out[cur] >= 0) return -1;  // shared node: cycle/overlap
             out[cur] = idx++;
-            if (first_child[cur] >= 0) {
-                cur = first_child[cur];
+            const int32_t ns = next_sib[cur];
+            const int32_t fc = first_child[cur];
+            if (fc >= 0) {
+                if (ns >= 0) stack.push_back(ns);
+                cur = fc;
+            } else if (ns >= 0) {
+                cur = ns;
+            } else if (!stack.empty()) {
+                cur = stack.back();
+                stack.pop_back();
             } else {
-                // climb until a next sibling exists or we re-reach the root
-                int32_t c = cur;
                 cur = -1;
-                while (c >= 0 && c < P) {
-                    if (--budget < 0) return -1;
-                    if (next_sib[c] >= 0) {
-                        cur = next_sib[c];
-                        break;
-                    }
-                    c = parent[c];
-                    if (c == (int32_t)r) break;
-                }
             }
         }
+        if (!stack.empty()) return -1;  // dangling pending siblings: corrupt
     }
     return 0;
 }

@@ -21,8 +21,11 @@
 // size threshold this engine beats the device end to end (see
 // merge_columns engine selection). Same columns in, same arrays out.
 
+#include <chrono>
 #include <climits>
 #include <cstdint>
+#include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <thread>
 #include <vector>
@@ -106,6 +109,20 @@ long long am_merge_cols(
   const int64_t N = 2 * P + 3;
   const int32_t S = (int32_t)(N - 1);
 
+  const bool timing = getenv("AM_MERGE_TIMING") != nullptr;
+  auto now_s = [] {
+    return std::chrono::duration<double>(
+               std::chrono::steady_clock::now().time_since_epoch())
+        .count();
+  };
+  double t0 = timing ? now_s() : 0.0;
+  auto tick = [&](const char* name) {
+    if (!timing) return;
+    const double t1 = now_s();
+    fprintf(stderr, "merge %-10s %.4fs\n", name, t1 - t0);
+    t0 = t1;
+  };
+
   // --- 1. succ resolution (pred scatter) --------------------------------
   std::memset(succ_count, 0, P * sizeof(int32_t));
   std::memset(inc_count, 0, P * sizeof(int32_t));
@@ -123,6 +140,7 @@ long long am_merge_cols(
     }
   }
 
+  tick("succ");
   // --- 2. visibility (types.rs:712-744) ---------------------------------
   for (int64_t i = 0; i < P; i++) {
     const int32_t a = action[i];
@@ -139,6 +157,7 @@ long long am_merge_cols(
             : 0;
   }
 
+  tick("visible");
   // --- 3. per-key winners ------------------------------------------------
   // seq groups: dense by run-head row; HEAD / missing targets get two
   // per-object slots (they group by (obj, sentinel key) on the device too)
@@ -183,23 +202,25 @@ long long am_merge_cols(
     conflicts[i] = g->cnt;
   }
 
+  tick("winners");
   // --- 4. RGA linearization ----------------------------------------------
   // parent chain + sibling lists; ascending-row prepend leaves each child
-  // list in descending row (= descending Lamport) order
-  for (int64_t i = 0; i < N; i++) first_child[i] = kNone;
-  for (int64_t i = 0; i < N; i++) next_sib[i] = kNone;
+  // list in descending row (= descending Lamport) order.
+  // (Kept as separate streaming passes: fusing them into the winners pass
+  // mixes three access patterns per iteration and measured SLOWER.)
+  std::memset(first_child, 0xFF, (size_t)N * sizeof(int32_t));  // kNone
+  std::memset(next_sib, 0xFF, (size_t)N * sizeof(int32_t));
   for (int64_t i = 0; i < P; i++) {
     const bool el = insert[i] && action[i] != kPadAction;
     is_elem[i] = el ? 1 : 0;
+    if (!el) {
+      parent_row[i] = S;
+      continue;
+    }
     const int32_t er = elem_ref[i];
-    parent_row[i] =
-        el ? (er == kElemHead ? (int32_t)(P + obj_dense[i])
-                              : (er >= 0 ? er : S))
-           : S;
-  }
-  for (int64_t i = 0; i < P; i++) {
-    if (!is_elem[i]) continue;
-    const int32_t p = parent_row[i];
+    const int32_t p = er == kElemHead ? (int32_t)(P + obj_dense[i])
+                                      : (er >= 0 ? er : S);
+    parent_row[i] = p;
     next_sib[i] = first_child[p];
     first_child[p] = (int32_t)i;
   }
@@ -213,6 +234,7 @@ long long am_merge_cols(
     for (int64_t i = 0; i < P; i++) elem_index[i] = kNone;
   }
 
+  tick("linearize");
   // --- per-object stats ---------------------------------------------------
   std::memset(obj_vis_len, 0, n_objs2 * sizeof(int32_t));
   std::memset(obj_text_width, 0, n_objs2 * sizeof(int32_t));
@@ -223,6 +245,7 @@ long long am_merge_cols(
     obj_vis_len[o]++;
     obj_text_width[o] += width[winner[i]];
   }
+  tick("stats");
   return 0;
 }
 

@@ -32,18 +32,25 @@ class AssembleError(AutomergeError):
     pass
 
 
-# The gather-heavy columns interleaved as one 40-byte record per op
+# The gather-heavy columns interleaved as one 24-byte record per op
 # (AoS): the assembler's permuted reads touch ONE cache line per row
-# instead of seven per-change column streams. i64 fields first keeps
-# them 8-aligned (40 % 8 == 0).
+# (2.6 rows per line) instead of seven per-change column streams. The
+# i64 field leads so it stays 8-aligned (24 % 8 == 0).
 HOT_DTYPE = np.dtype(
     [
-        ("elem_ctr", "<i8"), ("vlen", "<i8"), ("voff", "<i8"),
-        ("action", "<i4"), ("elem_actor", "<i4"), ("vcode", "<i4"),
-        ("insert", "u1"), ("_pad", "V3"),
+        ("elem_ctr", "<i8"),    # 0
+        ("voff", "<u4"),        # 8  chunk-local value-heap offset
+        ("vlen", "<u4"),        # 12 value payload length
+        ("elem_actor", "<i4"),  # 16 chunk-local actor index (-1 = HEAD)
+        ("action", "u1"),       # 20 storage action (0..15)
+        ("vcode", "u1"),        # 21 value meta type code (meta & 0xF)
+        ("insert", "u1"),       # 22
+        ("_pad", "V1"),         # 23
     ]
 )
-assert HOT_DTYPE.itemsize == 40
+assert HOT_DTYPE.itemsize == 24
+# voff/vlen are u32: one change's value heap never approaches 4GB (a chunk
+# that large fails elsewhere first); _split_batch guards anyway.
 
 # shared all-minus-one buffer for changes without a key_str / mark_name
 # column (grown on demand, never shrunk; cache rows only READ [0, n))
@@ -68,7 +75,7 @@ class ChangeCols:
         "n", "q", "obj_ctr", "obj_actor", "obj_has", "key_sid",
         "expand", "value_int", "width", "width_enc", "mark_sid",
         "pred_num", "pred_ctr", "pred_actor", "key_table", "mark_table",
-        "vraw", "hot", "_ptrs", "_const",
+        "vraw", "hot", "_ptrs", "_const", "rank_tab",
     )
 
     # the gather-heavy columns live ONLY in the hot record (strided views
@@ -220,8 +227,16 @@ def _split_batch(a: Dict, changes: Sequence) -> List[ChangeCols]:
     hot_all = np.empty(N, HOT_DTYPE)
     # HEAD (no actor) is counter 0; a map op's slot is ignored by C
     hot_all["elem_ctr"] = np.where(a["key_has_actor"], a["key_ctr"], 0)
+    voff_local = a["voff"] - raw_off[a["change_of_row"]]  # chunk-local
+    if N and (
+        int(a["vlen"].max(initial=0)) >= (1 << 32)
+        or int(voff_local.max(initial=0)) >= (1 << 32)
+        or int(voff_local.min(initial=0)) < 0
+        or int(a["vlen"].min(initial=0)) < 0
+    ):
+        raise AssembleError("value heap exceeds the 24-byte record range")
     hot_all["vlen"] = a["vlen"]
-    hot_all["voff"] = a["voff"] - raw_off[a["change_of_row"]]  # chunk-local
+    hot_all["voff"] = voff_local
     hot_all["action"] = a["action"]
     hot_all["elem_actor"] = a["key_actor"]
     hot_all["vcode"] = a["vcode"]
@@ -278,13 +293,50 @@ def _split_batch(a: Dict, changes: Sequence) -> List[ChangeCols]:
         cc.vraw = raw[rlo : rlo + int(raw_ln[c])]
         cc._ptrs = None
         cc._const = None
+        cc.rank_tab = None
         out.append(cc)
     return out
 
 
+_UNIVERSE_IDS: Dict[bytes, int] = {}
+_UNIVERSE_NEXT = [1]  # monotone: tokens never recycle, even across clears
+
+
+def _universe_token(rank_of: Dict[bytes, int]) -> int:
+    """Intern the actor universe (rank_of's keys are in rank order) to a
+    small id; equal universes across merges share one token. The key is a
+    LENGTH-PREFIXED join — actor ids are arbitrary bytes, so a separator
+    join would be ambiguous — making token equality exact, with no
+    hash/encoding collision corruption risk."""
+    key = b"".join(
+        len(a).to_bytes(4, "little") + a for a in rank_of
+    )
+    tok = _UNIVERSE_IDS.get(key)
+    if tok is None:
+        if len(_UNIVERSE_IDS) >= 4096:  # bound stale universes
+            _UNIVERSE_IDS.clear()
+        tok = _UNIVERSE_NEXT[0]
+        _UNIVERSE_NEXT[0] += 1
+        _UNIVERSE_IDS[key] = tok
+    return tok
+
+
+def _const_stacks(caches):
+    """(li, mask_stack, value_stack) over non-empty changes — the shared
+    input of _global_const and _per_change_const (assemble_log computes it
+    once and threads it into both)."""
+    li = np.asarray([i for i, cc in enumerate(caches) if cc.n > 0], np.int64)
+    if not len(li):
+        return li, np.zeros((0, 18), bool), np.zeros((0, 18), np.int64)
+    scans = [caches[int(i)].const_scan() for i in li]
+    ms = np.stack([m for m, _ in scans])
+    vs = np.stack([v for _, v in scans])
+    return li, ms, vs
+
+
 def _global_const(
     caches, tab_all, tab_off, tab_size, prop_off, prop_size, prop_remap,
-    mark_off, mark_size, mark_remap, total_raw,
+    mark_off, mark_size, mark_remap, total_raw, stacks=None,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Aggregate per-change constant columns into the assembler's global
     fill directives (see assemble.cpp g_flags docs): a column is fillable
@@ -292,12 +344,9 @@ def _global_const(
     value."""
     g_flags = np.zeros(18, np.int64)
     g_vals = np.zeros(18, np.int64)
-    li = np.asarray([i for i, cc in enumerate(caches) if cc.n > 0], np.int64)
+    li, ms, vs = stacks if stacks is not None else _const_stacks(caches)
     if not len(li):
         return g_flags, g_vals
-    scans = [caches[int(i)].const_scan() for i in li]
-    ms = np.stack([m for m, _ in scans])
-    vs = np.stack([v for _, v in scans])
     allc = ms.all(axis=0)
     same = (vs == vs[0]).all(axis=0)
     for k in (7, 8, 9, 10, 12, 13):
@@ -353,6 +402,58 @@ def _global_const(
                     g_flags[14] = 1
                     g_vals[14] = gm[0]
     return g_flags, g_vals
+
+
+def _per_change_const(
+    caches, tab_all, tab_off, tab_size, prop_off, prop_size, prop_remap,
+    stacks=None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-change constant shortcuts for the assembler's gather loop.
+
+    Real changes overwhelmingly target ONE object and one key shape, so
+    the per-row has/actor/ctr loads + actor-table translation collapse to
+    a single C-array read even when the GLOBAL const scan fails (e.g. the
+    make op itself rides in an early change). Returns:
+      c_obj_key[c]: packed global object key every row of c targets
+                    (0 = root), or -1 when the change's obj column varies;
+      c_sid[c]:     -1 = every row seq-keyed, >= 0 = one global map prop,
+                    -2 = varies.
+    Vectorized over the (cached) per-change const scans.
+    """
+    C = len(caches)
+    c_obj_key = np.full(C, -1, np.int64)
+    c_sid = np.full(C, -2, np.int64)
+    li, ms, vs = stacks if stacks is not None else _const_stacks(caches)
+    empty = np.ones(C, bool)
+    empty[li] = False
+    c_obj_key[empty] = 0
+    c_sid[empty] = -1
+    if not len(li):
+        return c_obj_key, c_sid
+
+    # object: const has/actor/ctr columns -> one packed key per change
+    oc = ms[:, 1] & ms[:, 2] & ms[:, 3]
+    has = vs[:, 3] != 0
+    oa = vs[:, 2]
+    octr = vs[:, 1]
+    ts = tab_size[li]
+    valid = oc & ((~has) | ((oa >= 0) & (oa < ts) & (octr >= 0) & (octr < (1 << 43))))
+    packed = np.where(
+        has,
+        (octr << ACTOR_BITS) | tab_all[tab_off[li] + np.clip(oa, 0, np.maximum(ts - 1, 0))],
+        0,
+    )
+    c_obj_key[li[valid]] = packed[valid]
+
+    # key sid: all-seq, or one prop remapped to its global id
+    sc = ms[:, 4]
+    s = vs[:, 4]
+    seq = sc & (s == -1)
+    c_sid[li[seq]] = -1
+    po = prop_off[li]
+    mp = sc & (s >= 0) & (po >= 0) & (s < prop_size[li])
+    c_sid[li[mp]] = prop_remap[(po + np.clip(s, 0, None))[mp]]
+    return c_obj_key, c_sid
 
 
 def _remap_tables(
@@ -593,10 +694,22 @@ def assemble_log(log, changes: Sequence, rank_of: Dict[bytes, int]):
     if N and int((start_op + n_ops).max()) - 1 >= (1 << 43):
         raise AssembleError("counter outside packed range")
 
-    # per-merge actor translation: chunk-local index -> global rank
-    tab_parts = [
-        [rank_of[bytes(a)] for a in ch.actors] for ch in changes
-    ]
+    # per-merge actor translation: chunk-local index -> global rank.
+    # The translated table is memoized on the cache keyed by the actor
+    # UNIVERSE (rank_of's sorted key join, interned to a token so the key
+    # comparison is one int, not a byte-string compare per change) —
+    # repeated merges over the same replica set skip the per-actor dict
+    # lookups entirely.
+    rank_token = _universe_token(rank_of)
+    tab_parts = []
+    for ch, cc in zip(changes, caches):
+        rt = cc.rank_tab
+        if rt is not None and rt[0] == rank_token:
+            tab_parts.append(rt[1])
+        else:
+            t = [rank_of[bytes(a)] for a in ch.actors]
+            cc.rank_tab = (rank_token, t)
+            tab_parts.append(t)
     tab_size = np.fromiter((len(t) for t in tab_parts), np.int64, count=C)
     tab_off = np.concatenate([[0], np.cumsum(tab_size)])[:-1].astype(np.int64)
     tab_all = np.fromiter(
@@ -619,9 +732,14 @@ def assemble_log(log, changes: Sequence, rank_of: Dict[bytes, int]):
     for c, cc in enumerate(caches):
         col_ptrs[c] = cc.ptr_row()
 
+    stacks = _const_stacks(caches)
     g_flags, g_vals = _global_const(
         caches, tab_all, tab_off, tab_size, prop_off, prop_size, prop_remap,
-        mark_off, mark_size, mark_remap, len(raw_all),
+        mark_off, mark_size, mark_remap, len(raw_all), stacks=stacks,
+    )
+    c_obj_key, c_sid = _per_change_const(
+        caches, tab_all, tab_off, tab_size, prop_off, prop_size, prop_remap,
+        stacks=stacks,
     )
 
     # outputs
@@ -655,6 +773,7 @@ def assemble_log(log, changes: Sequence, rank_of: Dict[bytes, int]):
             native._i64(tab_all), native._i32(prop_remap),
             native._i32(mark_remap), ACTOR_BITS,
             native._i64(g_flags), native._i64(g_vals),
+            native._i64(c_obj_key), native._i64(c_sid),
             native._i64(id_key), native._i64(obj_key), native._i32(prop),
             native._i32(action), native._u8(insert), native._u8(expand),
             native._i32(value_tag), native._i64(value_int),
