@@ -404,78 +404,72 @@ def _row_visibility(flat):
 
 
 def stale_read_state(doc):
-    """The flatten + linearization + visibility intermediates shared by
-    every stale read at one history length — computed once, cached by the
-    Document so N object reads pay one history pass, not N. None when the
-    array path can't serve this history."""
+    """Shared intermediates for every stale read at one history length —
+    computed once, cached by the Document so N object reads pay one
+    history pass, not N. None when the array path can't serve.
+
+    One OpLog extraction + one columnar merge (ops/merge.merge_columns)
+    supplies element order, winners, and visibility together — the same
+    engine the fan-in merge rides — instead of the former separate
+    flatten + element-export + visibility passes (three full scans of the
+    op history per catch-up read, the sync-config bottleneck VERDICT r4
+    flagged)."""
     stored = [a.stored for a in doc.history]
     if not stored:
         return None
-    flat = flatten_changes(stored)
-    if flat.get("rb") is None:
-        return None  # no value columns: let the store answer
-    obj_keys, obj_off, elem_rows = _seq_export(stored, flat)
-    vis, _, _ = _row_visibility(flat)
-    return {
-        "flat": flat,
-        "obj_keys": np.asarray(obj_keys),
-        "obj_off": obj_off,
-        "elem_rows": np.asarray(elem_rows),
-        "vis": vis,
-    }
+    from ..ops import DeviceDoc, OpLog
+    from ..ops.merge import merge_columns
+
+    log = OpLog.from_changes(stored)
+    if not hasattr(log.values, "code"):
+        # eager-list values (per-op extraction fallback): no value heap to
+        # gather from; let the materialized store answer
+        return None
+    res = merge_columns(
+        log.columns(), fetch=DeviceDoc.READ_FETCH, n_objs=log.n_objs,
+        n_props=len(log.props),
+    )
+    return {"log": log, "res": res}
 
 
 def stale_text(doc, obj_exid: str, state):
-    """Current-state text of one object straight from history arrays — no
-    op-store materialization. None when this path can't serve (caller
+    """Current-state text of one object straight from the merge outputs —
+    no op-store materialization. None when this path can't serve (caller
     falls back to the materialized store).
 
     This is the sync-consumer read path: a replica that catches up over
     the wire and is only *read* never pays the Python object build; the
     store materializes lazily on the first local edit (the same
     history-is-source-of-truth stance as Document._materialize_ops)."""
-    opid = doc.import_id(obj_exid)
-    if opid == (0, 0):
-        return None  # root is a map
-    flat = state["flat"]
-    rb = flat["rb"]
-    actor_b = doc.actors.get(opid[1]).bytes
-    rank = flat["rank_of"].get(bytes(actor_b))
-    if rank is None:
+    log, res = state["log"], state["res"]
+    try:
+        qkey = log.import_id(obj_exid)
+    except (KeyError, ValueError):
         return None
-    qkey = (opid[0] << ACTOR_BITS) | rank
+    if qkey == 0:
+        return None  # root is a map
 
-    obj_keys, obj_off, elem_rows = state["obj_keys"], state["obj_off"], state["elem_rows"]
-    kidx = np.flatnonzero(np.asarray(obj_keys) == qkey)
-    if len(kidx) == 0:
-        return None  # empty / unknown / non-sequence object
-    k = int(kidx[0])
-    rows = elem_rows[int(obj_off[k]) : int(obj_off[k + 1])].astype(np.int64)
-    vis = state["vis"]
-    ids = flat["op_id"]
+    # only sequence objects read as text; maps/tables fall back so the
+    # store raises the same typed error it would when materialized
+    n = log.n
+    mk = int(np.searchsorted(log.id_key, qkey))
+    if mk >= n or int(log.id_key[mk]) != qkey or int(log.action[mk]) not in (2, 4):
+        return None  # unknown object, or not MAKE_LIST/MAKE_TEXT
 
-    # winner per element: the insert op if visible, overridden by the last
-    # visible update targeting it (ascending lamport — same rule as the
-    # rebuild's seq-update pass / reference TopOps)
-    win = np.where(vis[rows], rows, -1)
-    upd = np.flatnonzero(
-        (flat["prop"] != 0) & (flat["insert"] == 0) & vis & (flat["obj"] == qkey)
-    )
-    if len(upd):
-        upd = upd[np.argsort(ids[upd], kind="stable")]
-        elem_ids = ids[rows]
-        order = np.argsort(elem_ids)
-        pos = np.searchsorted(elem_ids[order], flat["elem"][upd])
-        pos = np.clip(pos, 0, max(len(rows) - 1, 0))
-        ok = elem_ids[order][pos] == flat["elem"][upd] if len(rows) else np.zeros(0, bool)
-        win[order[pos[ok]]] = upd[ok]
+    # element rows of this object in document order; each element's
+    # current value is its merge-group winner (insert overridden by the
+    # last visible update — res["winner"] already encodes TopOps)
+    from ..ops.device_doc import order_elem_rows
 
-    sel = win[win >= 0]
-    a = rb["a"]
-    vc = a["vcode"][sel]
-    off = a["voff"][sel].astype(np.int64)
-    ln = a["vlen"][sel].astype(np.int64)
-    raw = a["vraw"]
+    obj_rows = np.flatnonzero(log.obj_key == qkey)
+    erows = order_elem_rows(log, res["elem_index"][:n], obj_rows)
+    win = res["winner"][:n][erows]
+    sel = win[win >= 0].astype(np.int64)
+    vals = log.values
+    vc = np.asarray(vals.code)[sel]
+    off = np.asarray(vals.off)[sel].astype(np.int64)
+    ln = np.asarray(vals.ln)[sel].astype(np.int64)
+    raw = vals.raw
     if len(sel) == 0:
         return ""
     if bool((vc == 6).all()):

@@ -187,14 +187,41 @@ long long am_assemble_log(
       bucket[b] = acc;
       acc += cover;
     }
-    for (int64_t ci = 0; ci < C; ci++) {
-      const int64_t c = by_rank[ci];
-      const int64_t base = row_off[c], s0 = start_op[c] - min_ctr;
-      for (int64_t i = 0; i < n_ops[c]; i++) {
-        const int32_t pos = bucket[s0 + i]++;
-        src[pos] = (int32_t)(base + i);
-        src_c[pos] = (int32_t)c;
-        newrow[base + i] = pos;
+    if (range * C <= 8 * N && C > 64) {
+      // many changes sharing a narrow counter range (the map+counter
+      // fan-in shape: 10k actors x 1k ops over the same counters): the
+      // per-change placement loop writes src at a C-change stride — one
+      // cache miss per row over a multi-hundred-MB window. Place in
+      // BLOCKS of changes instead: each (block, counter) pair touches a
+      // contiguous src segment and a block-local newrow window, keeping
+      // the working set L2-resident. Blocks run in rank order, so each
+      // counter bucket still fills in rank order.
+      constexpr int64_t BLK = 256;
+      for (int64_t blk = 0; blk < C; blk += BLK) {
+        const int64_t be = std::min(blk + BLK, C);
+        for (int64_t b = 0; b < range; b++) {
+          for (int64_t k = blk; k < be; k++) {
+            const int64_t c = by_rank[k];
+            const int64_t i = b - (start_op[c] - min_ctr);
+            if (i < 0 || i >= n_ops[c]) continue;
+            const int32_t pos = bucket[b]++;
+            const int64_t base = row_off[c];
+            src[pos] = (int32_t)(base + i);
+            src_c[pos] = (int32_t)c;
+            newrow[base + i] = pos;
+          }
+        }
+      }
+    } else {
+      for (int64_t ci = 0; ci < C; ci++) {
+        const int64_t c = by_rank[ci];
+        const int64_t base = row_off[c], s0 = start_op[c] - min_ctr;
+        for (int64_t i = 0; i < n_ops[c]; i++) {
+          const int32_t pos = bucket[s0 + i]++;
+          src[pos] = (int32_t)(base + i);
+          src_c[pos] = (int32_t)c;
+          newrow[base + i] = pos;
+        }
       }
     }
   } else {

@@ -39,6 +39,19 @@ from .merge import merge_columns
 from .oplog import MAKE_ACTIONS, ACTOR_BITS, OpLog, TAG_COUNTER
 
 _MAKE_OBJ = {0: ObjType.MAP, 2: ObjType.LIST, 4: ObjType.TEXT, 6: ObjType.TABLE}
+
+
+def order_elem_rows(log: "OpLog", elem_index: np.ndarray,
+                    obj_rows: np.ndarray) -> np.ndarray:
+    """Element rows of one sequence object in DOCUMENT order: the insert
+    rows the linearization ranked, sorted by their rank. The single
+    definition of the element-order rule shared by DeviceDoc reads and
+    the stale-store read path (core/bulk_load.stale_text)."""
+    obj_rows = np.asarray(obj_rows, np.int64)
+    erows = obj_rows[
+        np.asarray(log.insert)[obj_rows] & (elem_index[obj_rows] >= 0)
+    ]
+    return erows[np.argsort(elem_index[erows], kind="stable")]
 _OBJ_REPLACEMENT = "￼"
 _PUT = 1
 _INCREMENT = 5
@@ -198,13 +211,9 @@ class DeviceDoc:
         base = self._base
         cached = base._all_elems_cache.get(obj_key)
         if cached is None:
-            rows = [
-                (int(base.elem_index[r]), int(r))
-                for r in base._obj_rows(obj_key)
-                if base.log.insert[r] and base.elem_index[r] >= 0
-            ]
-            rows.sort()
-            cached = [r for _, r in rows]
+            cached = order_elem_rows(
+                base.log, base.elem_index, base._obj_rows(obj_key)
+            ).tolist()
             base._all_elems_cache[obj_key] = cached
         return cached
 

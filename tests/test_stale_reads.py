@@ -151,3 +151,22 @@ def test_stale_text_after_sync_roundtrip():
         if not ma and not mb:
             break
     assert b.text(t) == a.text(t)
+
+
+def test_stale_text_on_map_object_matches_store_error():
+    """text() on a MAP object must behave identically whether the store is
+    stale or materialized: the stale path falls back so the store raises
+    its typed error (review repro: the merge-backed path once returned "")."""
+    a = AutoDoc(actor=ActorId(bytes([1]) * 16))
+    m = a.put_object("_root", "m", ObjType.MAP)
+    a.put(m, "k", 1)
+    a.commit()
+    data = a.save_incremental_after([])
+    b = AutoDoc(actor=ActorId(bytes([2]) * 16))
+    b.load_incremental(data)  # store now stale
+    with pytest.raises(Exception, match="sequence read on map object"):
+        b.text(m)
+    # and the same error after materialization
+    b.keys(m)
+    with pytest.raises(Exception, match="sequence read on map object"):
+        b.text(m)
