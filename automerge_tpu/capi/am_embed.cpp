@@ -44,6 +44,133 @@ struct AMsyncState {
 
 static PyObject *g_shim = nullptr; // the shim module (owned)
 
+/* -- hot-call fast cache ----------------------------------------------------
+ *
+ * Per-op callers (am_splice_text / am_map_put_*) were interpreter-bound:
+ * every call crossed into Python dispatch. The shim's fast_begin exposes
+ * the SAME native session the Python hot paths use (core/transaction.py
+ * fast_splice_fn / fast_put_fn) as raw handles; while armed, this layer
+ * calls am_edit_splice / am_map_put directly — no GIL, no Python. The
+ * safety contract: dispatch() is the single funnel for everything else,
+ * and it resyncs Python's op-id accounting (shim.fast_sync) and disarms
+ * BEFORE running any other function. kind -2 is the neg-cache: the object
+ * proved ineligible, keep dispatching without re-probing per call. */
+typedef int64_t (*am_edit_splice_fn)(void *, int64_t, int64_t, int64_t,
+                                     const int32_t *, const int32_t *,
+                                     int64_t);
+typedef int64_t (*am_op_count_fn)(void *);
+typedef int64_t (*am_map_put_fn)(void *, int64_t, const char *, int64_t,
+                                 int32_t, int64_t, double, const uint8_t *,
+                                 int64_t);
+
+static struct {
+  int64_t handle = 0;    /* doc handle (0 = inactive) */
+  std::string obj;
+  int kind = -1;         /* 0 text, 1 map, -2 neg-cached, -1 inactive */
+  void *sess = nullptr;
+  int64_t base = 0;      /* next ctr = base + op_count(sess) */
+  int64_t enc = 0;       /* 0 codepoints, 1 utf-8 units, 2 utf-16 units */
+} g_fast;
+static am_edit_splice_fn g_f_splice = nullptr;
+static am_op_count_fn g_f_splice_count = nullptr;
+static am_map_put_fn g_f_map_put = nullptr;
+static am_op_count_fn g_f_map_count = nullptr;
+static bool g_f_addrs_tried = false;
+
+static AMresult *dispatch(const char *fn, PyObject *args);
+static int64_t g_sync_pending = 0; /* handle whose resync failed (OOM) */
+
+static bool fast_sync_dispatch(long long h) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject *args = Py_BuildValue("(L)", h);
+  PyGILState_Release(gil);
+  if (!args) return false;
+  AMresult *r = dispatch("fast_sync", args);
+  const bool ok = r->status == AM_STATUS_OK;
+  am_result_free(r);
+  return ok;
+}
+
+static void fast_disarm_sync(void) {
+  if (g_fast.kind != 0 && g_fast.kind != 1) return;
+  const long long h = (long long)g_fast.handle;
+  g_fast.kind = -1;
+  g_fast.handle = 0;
+  g_fast.sess = nullptr;
+  /* the resync is a hard invariant (op-id accounting); if it cannot run
+   * now (OOM building the args tuple), dispatch() retries it before the
+   * next operation and refuses to proceed until it lands */
+  if (!fast_sync_dispatch(h)) g_sync_pending = h;
+}
+
+/* Strict UTF-8: reject what CPython would (stray/overlong leads,
+ * surrogates, > U+10FFFF) so the fast path never accepts bytes the
+ * dispatch path errors on. Appends to cps/ws when given (enc selects the
+ * width unit); pure validation otherwise. */
+static bool utf8_next(const char *s, size_t n, size_t *i, uint32_t *out,
+                      int *blen) {
+  const uint8_t c = (uint8_t)s[*i];
+  if (c < 0x80) {
+    *out = c;
+    *blen = 1;
+    (*i)++;
+    return true;
+  }
+  int len;
+  uint32_t cp;
+  uint8_t lo = 0x80, hi = 0xBF;
+  if (c >= 0xC2 && c <= 0xDF) {
+    len = 2;
+    cp = c & 0x1F;
+  } else if (c == 0xE0) {
+    len = 3;
+    cp = 0;
+    lo = 0xA0;
+  } else if (c >= 0xE1 && c <= 0xEC) {
+    len = 3;
+    cp = c & 0x0F;
+  } else if (c == 0xED) {
+    len = 3;
+    cp = 0x0D;
+    hi = 0x9F; /* no surrogates */
+  } else if (c >= 0xEE && c <= 0xEF) {
+    len = 3;
+    cp = c & 0x0F;
+  } else if (c == 0xF0) {
+    len = 4;
+    cp = 0;
+    lo = 0x90;
+  } else if (c >= 0xF1 && c <= 0xF3) {
+    len = 4;
+    cp = c & 0x07;
+  } else if (c == 0xF4) {
+    len = 4;
+    cp = 4;
+    hi = 0x8F; /* <= U+10FFFF */
+  } else {
+    return false; /* 0x80-0xC1, 0xF5-0xFF */
+  }
+  if (*i + (size_t)len > n) return false;
+  for (int k = 1; k < len; k++) {
+    const uint8_t cc = (uint8_t)s[*i + k];
+    const uint8_t l = k == 1 ? lo : 0x80, h = k == 1 ? hi : 0xBF;
+    if (cc < l || cc > h) return false;
+    cp = (cp << 6) | (cc & 0x3F);
+  }
+  *i += (size_t)len;
+  *out = cp;
+  *blen = len;
+  return true;
+}
+
+static bool utf8_valid(const char *s, size_t n) {
+  uint32_t cp;
+  int blen;
+  for (size_t i = 0; i < n;)
+    if (!utf8_next(s, n, &i, &cp, &blen)) return false;
+  return true;
+}
+
 extern "C" int am_init(void) {
   if (g_shim) return 0;
   bool we_initialized = false;
@@ -171,6 +298,33 @@ static bool convert_items(PyObject *list, AMresult *r) {
 
 /* Call shim.call(fn, *args); args is a NEW reference to a tuple (stolen). */
 static AMresult *dispatch(const char *fn, PyObject *args) {
+  /* the single funnel: resync + disarm the hot-call cache before any
+   * other operation can mint op ids or change session state. The
+   * neg-cache survives put/splice dispatches (value-shape fallbacks on
+   * the same hot loop) but clears on anything that could change
+   * eligibility (commit, merge, mark, load, ...). */
+  if (fn[0] != 'f' || strncmp(fn, "fast_", 5) != 0) {
+    if (g_fast.kind >= 0) fast_disarm_sync();
+    if (g_fast.kind == -2 && strcmp(fn, "put") != 0 &&
+        strcmp(fn, "splice_text") != 0)
+      g_fast.kind = -1;
+    if (g_sync_pending) {
+      if (fast_sync_dispatch((long long)g_sync_pending)) {
+        g_sync_pending = 0;
+      } else {
+        AMresult *err = new AMresult();
+        err->status = AM_STATUS_ERROR;
+        err->error = "op-id accounting desynchronized (out of memory "
+                     "during fast-path resync)";
+        if (args) {
+          PyGILState_STATE gil = PyGILState_Ensure();
+          Py_DECREF(args);
+          PyGILState_Release(gil);
+        }
+        return err;
+      }
+    }
+  }
   AMresult *r = new AMresult();
   if (!g_shim) {
     Py_XDECREF(args);
@@ -218,6 +372,114 @@ static AMresult *dispatch(const char *fn, PyObject *args) {
   }
   PyGILState_Release(gil);
   return r;
+}
+
+/* -- hot-call cache: arming + direct entries -------------------------------*/
+
+static bool fast_fetch_addrs(void) {
+  if (g_f_addrs_tried) return g_f_map_put != nullptr;
+  g_f_addrs_tried = true;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject *args = PyTuple_New(0);
+  PyGILState_Release(gil);
+  if (!args) return false;
+  AMresult *r = dispatch("fast_addrs", args);
+  if (r->status == AM_STATUS_OK && r->items.size() >= 4) {
+    g_f_splice = (am_edit_splice_fn)(uintptr_t)r->items[0].i;
+    g_f_splice_count = (am_op_count_fn)(uintptr_t)r->items[1].i;
+    g_f_map_put = (am_map_put_fn)(uintptr_t)r->items[2].i;
+    g_f_map_count = (am_op_count_fn)(uintptr_t)r->items[3].i;
+  }
+  am_result_free(r);
+  return g_f_map_put != nullptr;
+}
+
+/* Arm the cache for (doc, obj, kind); on an eligible session returns true.
+ * An ineligible object neg-caches so per-call re-probing stops. */
+static bool fast_arm(AMdoc *d, const char *obj, int kind) {
+  if (!fast_fetch_addrs()) return false;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject *args = Py_BuildValue("(Lsi)", (long long)d->handle, obj, kind);
+  PyGILState_Release(gil);
+  if (!args) return false;
+  AMresult *r = dispatch("fast_begin", args);
+  const bool ok = r->status == AM_STATUS_OK && r->items.size() >= 3 &&
+                  r->items[0].i != 0;
+  g_fast.handle = d->handle;
+  g_fast.obj = obj;
+  if (ok) {
+    g_fast.kind = kind;
+    g_fast.sess = (void *)(uintptr_t)r->items[0].i;
+    g_fast.base = r->items[1].i;
+    g_fast.enc = r->items[2].i;
+  } else {
+    g_fast.kind = -2; /* neg-cache (also on errors: dispatch path reports) */
+    g_fast.sess = nullptr;
+  }
+  am_result_free(r);
+  return ok;
+}
+
+/* Armed text splice: utf-8 -> codepoints + per-codepoint widths in the
+ * document's index unit, then one native call. nullptr = fall back to the
+ * dispatch path (malformed utf-8). */
+static AMresult *fast_splice_armed(const char *text, size_t pos, size_t del) {
+  const size_t n = text ? strlen(text) : 0;
+  std::vector<int32_t> cps, ws;
+  cps.reserve(n);
+  ws.reserve(n);
+  for (size_t i = 0; i < n;) {
+    uint32_t c;
+    int blen;
+    if (!utf8_next(text, n, &i, &c, &blen))
+      return nullptr; /* invalid utf-8: dispatch path reports the error */
+    const int32_t w =
+        g_fast.enc == 1 ? blen : (g_fast.enc == 2 ? 1 + (c > 0xFFFF) : 1);
+    cps.push_back((int32_t)c);
+    ws.push_back(w);
+  }
+  const int64_t ctr = g_fast.base + g_f_splice_count(g_fast.sess);
+  const int64_t rr = g_f_splice(g_fast.sess, ctr, (int64_t)pos, (int64_t)del,
+                                cps.data(), ws.data(), (int64_t)cps.size());
+  AMresult *r = new AMresult();
+  if (rr < 0) {
+    r->status = AM_STATUS_ERROR;
+    r->error = rr == -2 ? "splice: delete past end of sequence"
+                        : "splice: index out of bounds";
+  }
+  return r;
+}
+
+/* Armed (or arm-now) check shared by the splice and map-put entries:
+ * true = g_fast holds a live session for (doc, obj, kind). */
+static bool fast_ready(AMdoc *d, const char *o, int kind) {
+  if (g_fast.handle == d->handle && g_fast.obj == o) {
+    if (g_fast.kind == kind) return true;
+    if (g_fast.kind == -2) return false;
+  }
+  fast_disarm_sync();
+  return fast_arm(d, o, kind);
+}
+
+/* Armed map put; nullptr = use the dispatch path (ineligible object,
+ * empty/invalid key, or a value shape the session rejects). */
+static AMresult *fast_map_put_try(AMdoc *d, const char *o, const char *k,
+                                  int32_t code, int64_t ival, double fval,
+                                  const uint8_t *raw, int64_t rawlen) {
+  if (!g_shim || !d || !o || !k || !k[0]) return nullptr;
+  const size_t klen = strlen(k);
+  if (!utf8_valid(k, klen)) return nullptr;
+  if (code == 6 && !utf8_valid((const char *)raw, (size_t)rawlen))
+    return nullptr; /* invalid utf-8 value: dispatch path reports */
+  if (!fast_ready(d, o, 1)) return nullptr;
+  const int64_t ctr = g_fast.base + g_f_map_count(g_fast.sess);
+  const int64_t rr = g_f_map_put(g_fast.sess, ctr, k, (int64_t)klen, code,
+                                 ival, fval, raw, rawlen);
+  if (rr < 0) {
+    fast_disarm_sync();
+    return nullptr;
+  }
+  return new AMresult();
 }
 
 /* -- results / items -------------------------------------------------------*/
@@ -355,6 +617,7 @@ static AMresult *put_tagged(AMdoc *doc, const char *obj, const char *key,
 
 extern "C" AMresult *am_map_put_null(AMdoc *d, const char *o, const char *k) {
   if (!g_shim) return dispatch("put", nullptr);
+  if (AMresult *fr = fast_map_put_try(d, o, k, 0, 0, 0.0, nullptr, 0)) return fr;
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject *zero = PyLong_FromLong(0);
   PyGILState_Release(gil);
@@ -363,6 +626,7 @@ extern "C" AMresult *am_map_put_null(AMdoc *d, const char *o, const char *k) {
 
 extern "C" AMresult *am_map_put_bool(AMdoc *d, const char *o, const char *k, int v) {
   if (!g_shim) return dispatch("put", nullptr);
+  if (AMresult *fr = fast_map_put_try(d, o, k, v ? 2 : 1, 0, 0.0, nullptr, 0)) return fr;
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject *p = PyLong_FromLong(v ? 1 : 0);
   PyGILState_Release(gil);
@@ -371,6 +635,7 @@ extern "C" AMresult *am_map_put_bool(AMdoc *d, const char *o, const char *k, int
 
 extern "C" AMresult *am_map_put_int(AMdoc *d, const char *o, const char *k, int64_t v) {
   if (!g_shim) return dispatch("put", nullptr);
+  if (AMresult *fr = fast_map_put_try(d, o, k, 4, v, 0.0, nullptr, 0)) return fr;
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject *p = PyLong_FromLongLong(v);
   PyGILState_Release(gil);
@@ -379,6 +644,8 @@ extern "C" AMresult *am_map_put_int(AMdoc *d, const char *o, const char *k, int6
 
 extern "C" AMresult *am_map_put_uint(AMdoc *d, const char *o, const char *k, uint64_t v) {
   if (!g_shim) return dispatch("put", nullptr);
+  if (v <= (uint64_t)INT64_MAX)
+    if (AMresult *fr = fast_map_put_try(d, o, k, 3, (int64_t)v, 0.0, nullptr, 0)) return fr;
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject *p = PyLong_FromUnsignedLongLong(v);
   PyGILState_Release(gil);
@@ -387,6 +654,7 @@ extern "C" AMresult *am_map_put_uint(AMdoc *d, const char *o, const char *k, uin
 
 extern "C" AMresult *am_map_put_f64(AMdoc *d, const char *o, const char *k, double v) {
   if (!g_shim) return dispatch("put", nullptr);
+  if (AMresult *fr = fast_map_put_try(d, o, k, 5, 0, v, nullptr, 0)) return fr;
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject *p = PyFloat_FromDouble(v);
   PyGILState_Release(gil);
@@ -396,6 +664,10 @@ extern "C" AMresult *am_map_put_f64(AMdoc *d, const char *o, const char *k, doub
 extern "C" AMresult *am_map_put_str(AMdoc *d, const char *o, const char *k,
                                     const char *v) {
   if (!g_shim) return dispatch("put", nullptr);
+  if (AMresult *fr = fast_map_put_try(
+          d, o, k, 6, 0, 0.0, (const uint8_t *)(v ? v : ""),
+          (int64_t)strlen(v ? v : "")))
+    return fr;
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject *p = PyUnicode_FromString(v ? v : "");
   PyGILState_Release(gil);
@@ -405,6 +677,9 @@ extern "C" AMresult *am_map_put_str(AMdoc *d, const char *o, const char *k,
 extern "C" AMresult *am_map_put_bytes(AMdoc *d, const char *o, const char *k,
                                       const uint8_t *v, size_t len) {
   if (!g_shim) return dispatch("put", nullptr);
+  if (v || len == 0)
+    if (AMresult *fr = fast_map_put_try(d, o, k, 7, 0, 0.0, v, (int64_t)len))
+      return fr;
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject *p = PyBytes_FromStringAndSize((const char *)v, (Py_ssize_t)len);
   PyGILState_Release(gil);
@@ -414,6 +689,7 @@ extern "C" AMresult *am_map_put_bytes(AMdoc *d, const char *o, const char *k,
 extern "C" AMresult *am_map_put_counter(AMdoc *d, const char *o, const char *k,
                                         int64_t v) {
   if (!g_shim) return dispatch("put", nullptr);
+  if (AMresult *fr = fast_map_put_try(d, o, k, 8, v, 0.0, nullptr, 0)) return fr;
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject *p = PyLong_FromLongLong(v);
   PyGILState_Release(gil);
@@ -530,6 +806,11 @@ extern "C" AMresult *am_list_increment(AMdoc *d, const char *o, size_t i, int64_
 
 extern "C" AMresult *am_splice_text(AMdoc *d, const char *o, size_t pos, size_t del,
                                     const char *text) {
+  if (g_shim && d && o && fast_ready(d, o, 0)) {
+    AMresult *fr = fast_splice_armed(text, pos, del);
+    if (fr) return fr;
+    fast_disarm_sync(); /* malformed utf-8: report via dispatch */
+  }
   AM_ARGS("(Lsnns)", (long long)d->handle, o, (Py_ssize_t)pos, (Py_ssize_t)del,
           text ? text : "");
   return dispatch("splice_text", args);

@@ -585,6 +585,85 @@ def _marks_items(marks_list) -> List[Item]:
     return out
 
 
+# -- C fast path (am_embed.cpp hot-call cache) --------------------------------
+#
+# Per-op C callers (am_splice_text / am_map_put_*) were interpreter-bound:
+# every call built a Python tuple and ran shim dispatch (~600k ops/s).
+# fast_begin exposes the SAME native session the Python fast paths use
+# (core/transaction.py fast_splice_fn / fast_put_fn) as raw handles, so the
+# embedder drives am_edit_splice / am_map_put directly with NO Python in
+# the loop. Safety contract: the C side clears its cache and dispatches
+# fast_sync before ANY other shim call, so Python-side op-id accounting
+# (tx._session_ops) resynchronizes before anything else can mint ids.
+
+
+def fast_addrs() -> List[Item]:
+    """Native entry addresses for the C fast path (or [] when absent)."""
+    import ctypes
+
+    from .. import native
+
+    lib = native.load()
+    if lib is None or not hasattr(lib, "am_map_put"):
+        return []
+    cast = lambda f: (UINT, ctypes.cast(f, ctypes.c_void_p).value)  # noqa: E731
+    return [
+        cast(lib.am_edit_splice), cast(lib.am_edit_op_count),
+        cast(lib.am_map_put), cast(lib.am_map_op_count),
+    ]
+
+
+_ENC_CODE = {"unicode": 0, "utf8": 1, "utf16": 2}
+
+
+def fast_begin(h: int, obj: str, kind: int) -> List[Item]:
+    """Arm the C hot-call cache for (doc, obj): kind 0 = text splice,
+    1 = map put. Returns [(HANDLE, session_addr), (INT, base_ctr),
+    (INT, enc_code)] — the next op counter is base_ctr + the session's
+    live op_count — or [] when the object is ineligible (the C side then
+    neg-caches and keeps dispatching)."""
+    from ..types import get_text_encoding
+
+    doc = _doc(h)
+    tx = doc._ensure_tx()
+    obj_id = tx._obj(obj)
+    if kind == 0:
+        info = tx.doc.ops.get_obj(obj_id)
+        from ..core.op_store import SeqObject
+
+        # TEXT only: splice_text on a LIST must keep raising through the
+        # dispatch path exactly like the python frontend
+        if not isinstance(info.data, SeqObject) or info.data.obj_type != ObjType.TEXT:
+            return []
+        sess = tx._session_for(obj_id, info)
+    else:
+        if not tx.enable_sessions or tx.scope is not None:
+            return []
+        if tx.actor_idx >= (1 << tx._ID_RANK_BITS):
+            return []
+        sess = tx.map_session_for(obj_id)
+    if sess is None or not sess._h:
+        return []
+    base = tx.start_op + len(tx.operations) + tx._session_ops - sess.op_count()
+    enc = _ENC_CODE[doc.doc.text_encoding or get_text_encoding()]
+    return [(HANDLE, sess._h), (INT, base), (INT, enc)]
+
+
+def fast_sync(h: int) -> List[Item]:
+    """Re-account ops the C fast path pushed straight into native
+    sessions (their op ids are consumed; tx._session_ops must agree
+    before any other operation mints ids)."""
+    doc = _docs.get(h)
+    tx = doc._tx if doc is not None else None
+    if tx is not None:
+        tx._session_ops = sum(
+            s[0].op_count() - s[1] for s in tx._sessions.values()
+        ) + sum(
+            s[0].op_count() - s[1] for s in tx._msessions.values()
+        )
+    return []
+
+
 def call(fn: str, *args) -> List[Item]:
     """The single dispatch point the C layer uses."""
     impl = globals().get(fn)

@@ -347,16 +347,93 @@ static void test_unicode_text(void) {
  * BASELINE.md documents it as interpreter-bound per call); prints per-op
  * vs bulk rates so CI logs track the boundary cost and the bulk idiom's
  * advantage stays visible. */
+/* -- hot-call fast-path edge cases ------------------------------------------- */
+/* The am_embed hot-call cache must agree with the dispatch path on every
+ * rejection: invalid utf-8, splices on non-text objects, empty keys, and
+ * op-id accounting across fast/slow interleavings. */
+static void test_fast_path_edges(void) {
+  AMdoc *d = am_create(NULL, 0);
+  char t[128], l[128];
+  obj_of(am_map_put_object(d, AM_ROOT, "t", AM_OBJ_TEXT), t, sizeof t);
+  obj_of(am_map_put_object(d, AM_ROOT, "l", AM_OBJ_LIST), l, sizeof l);
+
+  /* arm the fast path, then feed it input only the dispatch path rejects */
+  CHECK_OK(am_splice_text(d, t, 0, 0, "ok"));
+  AMresult *r = am_splice_text(d, t, 0, 0, "\xff\xfe");
+  CHECK(am_result_status(r) != AM_STATUS_OK); /* stray lead bytes */
+  am_result_free(r);
+  r = am_splice_text(d, t, 0, 0, "\xf8\x80\x80\x80");
+  CHECK(am_result_status(r) != AM_STATUS_OK); /* > 4-byte lead */
+  am_result_free(r);
+  r = am_splice_text(d, t, 0, 0, "\xed\xa0\x80");
+  CHECK(am_result_status(r) != AM_STATUS_OK); /* surrogate half */
+  am_result_free(r);
+  r = am_splice_text(d, t, 0, 0, "\xc0\xaf");
+  CHECK(am_result_status(r) != AM_STATUS_OK); /* overlong */
+  am_result_free(r);
+  CHECK_OK(am_splice_text(d, t, 2, 0, " \xf0\x9f\x9a\x80")); /* valid 4-byte */
+
+  /* splice on a LIST object must error exactly like the python frontend */
+  r = am_splice_text(d, l, 0, 0, "nope");
+  CHECK(am_result_status(r) != AM_STATUS_OK);
+  am_result_free(r);
+
+  /* empty / invalid-utf8 keys: dispatch path raises */
+  r = am_map_put_int(d, AM_ROOT, "", 1);
+  CHECK(am_result_status(r) != AM_STATUS_OK);
+  am_result_free(r);
+  r = am_map_put_str(d, AM_ROOT, "k", "\xff");
+  CHECK(am_result_status(r) != AM_STATUS_OK); /* invalid utf-8 value */
+  am_result_free(r);
+
+  /* fast/slow interleave: map puts (fast), delete (dispatch), puts again;
+   * op-id accounting must stay consistent through commit + reload */
+  CHECK_OK(am_map_put_int(d, AM_ROOT, "a", 1));
+  CHECK_OK(am_map_put_int(d, AM_ROOT, "b", 2));
+  CHECK_OK(am_map_delete(d, AM_ROOT, "a"));
+  CHECK_OK(am_map_put_int(d, AM_ROOT, "c", 3));
+  CHECK_OK(am_splice_text(d, t, 0, 0, ">"));
+  CHECK_OK(am_map_put_counter(d, AM_ROOT, "n", 5));
+  CHECK_OK(am_map_increment(d, AM_ROOT, "n", 2));
+  CHECK_OK(am_commit(d, NULL));
+  CHECK(res_int(am_map_get(d, AM_ROOT, "n")) == 7);
+  CHECK(res_int(am_map_get(d, AM_ROOT, "c")) == 3);
+  r = am_map_get(d, AM_ROOT, "a");
+  CHECK(am_result_status(r) == AM_STATUS_OK && am_result_size(r) == 0);
+  am_result_free(r);
+  /* save/load roundtrip proves the ids encoded consistently */
+  uint8_t buf[1 << 16];
+  size_t n = res_bytes(am_save(d), buf, sizeof buf);
+  AMdoc *d2 = am_load(buf, n);
+  CHECK(d2 != NULL);
+  CHECK(res_int(am_map_get(d2, AM_ROOT, "n")) == 7);
+  char s1[256], s2[256];
+  res_str(am_text(d2, t), s1, sizeof s1);
+  res_str(am_text(d, t), s2, sizeof s2);
+  CHECK(strcmp(s1, s2) == 0);
+  am_doc_free(d);
+  am_doc_free(d2);
+}
+
 static void test_throughput_probe(void) {
   AMdoc *d = am_create(NULL, 0);
   char t[128];
   obj_of(am_map_put_object(d, AM_ROOT, "t", AM_OBJ_TEXT), t, sizeof t);
-  const int N = 2000;
+  const int N = 20000;
   double t0 = now_s();
   for (int i = 0; i < N; i++) {
     CHECK_OK(am_splice_text(d, t, (size_t)i, 0, "x"));
   }
   double per_op = N / (now_s() - t0);
+  /* per-call map puts (the am_embed hot-call cache drives the native
+   * map session directly — no Python in the loop) */
+  char key[32];
+  t0 = now_s();
+  for (int i = 0; i < N; i++) {
+    snprintf(key, sizeof key, "k%06d", i);
+    CHECK_OK(am_map_put_int(d, AM_ROOT, key, i));
+  }
+  double per_put = N / (now_s() - t0);
   /* bulk idiom: one boundary crossing for the whole run */
   char big[8193];
   memset(big, 'y', 8192);
@@ -365,10 +442,11 @@ static void test_throughput_probe(void) {
   CHECK_OK(am_splice_text(d, t, (size_t)N, 0, big));
   double bulk = 8192 / (now_s() - t0);
   fprintf(stderr,
-          "capi throughput: %.0f ops/s per-call, %.0f chars/s bulk "
-          "(use bulk calls on hot paths)\n",
-          per_op, bulk);
+          "capi throughput: %.0f splice ops/s per-call, %.0f map puts/s "
+          "per-call, %.0f chars/s bulk\n",
+          per_op, per_put, bulk);
   CHECK(res_int(am_length(d, t)) == N + 8192);
+  CHECK(res_int(am_map_get(d, AM_ROOT, "k000007")) == 7);
   am_doc_free(d);
 }
 
@@ -719,6 +797,7 @@ int main(void) {
   test_deep_history_reads();
   test_three_peer_counter_convergence();
   test_unicode_text();
+  test_fast_path_edges();
   test_throughput_probe();
   test_get_all_at_conflict_history();
   test_deep_nesting();

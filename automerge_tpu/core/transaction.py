@@ -242,6 +242,44 @@ class Transaction:
 
         return fast
 
+    def map_session_for(self, obj_id: OpId):
+        """Existing or newly-eligible native map session for ``obj_id``
+        (None when ineligible: non-map object, a conflicted key, wide
+        actor ranks, or no native library)."""
+        from .. import native
+
+        ent = self._msessions.get(obj_id)
+        if ent is not None:
+            return ent[0]
+        lib = native.load()
+        if lib is None or not hasattr(lib, "am_map_create"):
+            return None
+        info = self.doc.ops.get_obj(obj_id)
+        if not isinstance(info.data, MapObject):
+            return None
+        import numpy as np
+
+        bits = self._ID_RANK_BITS
+        lim = 1 << bits
+        props = self.doc.props
+        keys: List[str] = []
+        winners: List[int] = []
+        for key_idx, run in info.data.props.items():
+            vis = [o for o in run if o.visible_at(None)]
+            if not vis:
+                continue
+            if len(vis) > 1:
+                return None  # conflicted key: python path handles preds
+            w = vis[0]
+            if w.id[1] >= lim:
+                return None
+            keys.append(props.get(key_idx))
+            winners.append((w.id[0] << bits) | w.id[1])
+        sess = native.MapSession(self.actor_idx)
+        sess.init(keys, np.asarray(winners, np.int64))
+        self._msessions[obj_id] = [sess, 0]  # [session, drained watermark]
+        return sess
+
     def fast_put_fn(self, obj: str):
         """A minimal per-put closure for the map hot path, or None.
 
@@ -262,39 +300,8 @@ class Transaction:
             return None
         if self.actor_idx >= (1 << self._ID_RANK_BITS):
             return None
-        obj_id = self._obj(obj)
-        ent = self._msessions.get(obj_id)
-        if ent is None:
-            lib = native.load()
-            if lib is None or not hasattr(lib, "am_map_create"):
-                return None
-            info = self.doc.ops.get_obj(obj_id)
-            if not isinstance(info.data, MapObject):
-                return None
-            import numpy as np
-
-            bits = self._ID_RANK_BITS
-            lim = 1 << bits
-            props = self.doc.props
-            keys: List[str] = []
-            winners: List[int] = []
-            for key_idx, run in info.data.props.items():
-                vis = [o for o in run if o.visible_at(None)]
-                if not vis:
-                    continue
-                if len(vis) > 1:
-                    return None  # conflicted key: python path handles preds
-                w = vis[0]
-                if w.id[1] >= lim:
-                    return None
-                keys.append(props.get(key_idx))
-                winners.append((w.id[0] << bits) | w.id[1])
-            sess = native.MapSession(self.actor_idx)
-            sess.init(keys, np.asarray(winners, np.int64))
-            ent = [sess, 0]  # [session, drained watermark]
-            self._msessions[obj_id] = ent
-        sess = ent[0]
-        if not sess._h:
+        sess = self.map_session_for(self._obj(obj))
+        if sess is None or not sess._h:
             return None
         h = sess._h
         fput = fc.map_put
@@ -1174,10 +1181,15 @@ def _scalar_from_vmeta(vmeta: int, raw: bytes) -> ScalarValue:
         return ScalarValue("bool", False)
     if code == 2:
         return ScalarValue("bool", True)
-    if code == 4:
+    if code == 3:
+        from ..utils.leb128 import decode_uleb
+
+        return ScalarValue("uint", decode_uleb(raw, 0)[0])
+    if code in (4, 8, 9):
         from ..utils.leb128 import decode_sleb
 
-        return ScalarValue("int", decode_sleb(raw, 0)[0])
+        tag = {4: "int", 8: "counter", 9: "timestamp"}[code]
+        return ScalarValue(tag, decode_sleb(raw, 0)[0])
     if code == 5:
         import struct
 

@@ -34,10 +34,13 @@ using u8 = uint8_t;
 constexpr i32 V_NULL = 0;
 constexpr i32 V_FALSE = 1;
 constexpr i32 V_TRUE = 2;
+constexpr i32 V_UINT = 3;  // uleb
 constexpr i32 V_INT = 4;   // sleb
 constexpr i32 V_F64 = 5;
 constexpr i32 V_STR = 6;
 constexpr i32 V_BYTES = 7;
+constexpr i32 V_COUNTER = 8;    // sleb
+constexpr i32 V_TIMESTAMP = 9;  // sleb
 
 struct MOp {
   i64 id;       // packed (ctr << 20 | rank)
@@ -77,6 +80,18 @@ void put_sleb(std::vector<u8>& out, i64 v) {
     u8 byte = (u8)(v & 0x7F);
     v >>= 7;  // arithmetic shift: sign-extends
     if ((v == 0 && !(byte & 0x40)) || (v == -1 && (byte & 0x40))) {
+      out.push_back(byte);
+      return;
+    }
+    out.push_back(byte | 0x80);
+  }
+}
+
+void put_uleb(std::vector<u8>& out, unsigned long long v) {
+  for (;;) {
+    u8 byte = (u8)(v & 0x7F);
+    v >>= 7;
+    if (v == 0) {
       out.push_back(byte);
       return;
     }
@@ -131,7 +146,12 @@ i64 am_map_put(void* p, i64 ctr, const char* key, i64 key_len, i32 code,
     case V_FALSE:
     case V_TRUE:
       break;
+    case V_UINT:
+      put_uleb(s.raw, (unsigned long long)ival);
+      break;
     case V_INT:
+    case V_COUNTER:
+    case V_TIMESTAMP:
       put_sleb(s.raw, ival);
       break;
     case V_F64: {
