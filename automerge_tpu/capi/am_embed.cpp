@@ -67,6 +67,7 @@ static struct {
   int64_t handle = 0;    /* doc handle (0 = inactive) */
   std::string obj;
   int kind = -1;         /* 0 text, 1 map, -2 neg-cached, -1 inactive */
+  int neg = 0;           /* per-kind neg bits: 1<<kind proved ineligible */
   void *sess = nullptr;
   int64_t base = 0;      /* next ctr = base + op_count(sess) */
   int64_t enc = 0;       /* 0 codepoints, 1 utf-8 units, 2 utf-16 units */
@@ -306,8 +307,10 @@ static AMresult *dispatch(const char *fn, PyObject *args) {
   if (fn[0] != 'f' || strncmp(fn, "fast_", 5) != 0) {
     if (g_fast.kind >= 0) fast_disarm_sync();
     if (g_fast.kind == -2 && strcmp(fn, "put") != 0 &&
-        strcmp(fn, "splice_text") != 0)
+        strcmp(fn, "splice_text") != 0) {
       g_fast.kind = -1;
+      g_fast.neg = 0;
+    }
     if (g_sync_pending) {
       if (fast_sync_dispatch((long long)g_sync_pending)) {
         g_sync_pending = 0;
@@ -405,6 +408,7 @@ static bool fast_arm(AMdoc *d, const char *obj, int kind) {
   AMresult *r = dispatch("fast_begin", args);
   const bool ok = r->status == AM_STATUS_OK && r->items.size() >= 3 &&
                   r->items[0].i != 0;
+  if (g_fast.handle != d->handle || g_fast.obj != obj) g_fast.neg = 0;
   g_fast.handle = d->handle;
   g_fast.obj = obj;
   if (ok) {
@@ -413,7 +417,10 @@ static bool fast_arm(AMdoc *d, const char *obj, int kind) {
     g_fast.base = r->items[1].i;
     g_fast.enc = r->items[2].i;
   } else {
-    g_fast.kind = -2; /* neg-cache (also on errors: dispatch path reports) */
+    /* per-kind neg-cache (also on errors: the dispatch path reports);
+     * a text-ineligible object can still arm the map fast path & v.v. */
+    g_fast.kind = -2;
+    g_fast.neg |= 1 << kind;
     g_fast.sess = nullptr;
   }
   am_result_free(r);
@@ -455,7 +462,7 @@ static AMresult *fast_splice_armed(const char *text, size_t pos, size_t del) {
 static bool fast_ready(AMdoc *d, const char *o, int kind) {
   if (g_fast.handle == d->handle && g_fast.obj == o) {
     if (g_fast.kind == kind) return true;
-    if (g_fast.kind == -2) return false;
+    if (g_fast.kind == -2 && (g_fast.neg & (1 << kind))) return false;
   }
   fast_disarm_sync();
   return fast_arm(d, o, kind);

@@ -32,6 +32,8 @@ gathers and segmented reductions — shapes XLA maps well onto the VPU.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -294,6 +296,225 @@ def merge_kernel_core(c):
     """Device merge without document-order ranking (the hybrid pipeline:
     the native preorder walk supplies elem_index on host)."""
     return resolve_state(c, *succ_resolution(c))
+
+
+def device_linearize_condensed(c, core, rcap: int, obj_cap: int = None):
+    """All-device document order via CHAIN CONDENSATION.
+
+    The plain pointer-doubling ranking (device_linearize) pays two
+    O(log N)-step loops of random gathers over the full row space — the
+    known-weak all-device phase. This version collapses the preorder
+    list into RUNS first: in actor-concatenated element order
+    (``c["aorder"]``, host-supplied layout permutation), a typing chain
+    is a CONTIGUOUS stretch of slots where each op is its predecessor's
+    first child (the structure native/condense.cpp exploits host-side;
+    reference locality: query/insert.rs:11-160). Runs are found with
+    cumsum + segmented scans, and the two doubling loops (sibling-climb
+    threading + Wyllie ranking) run over ``rcap``-sized run tables.
+
+    Every full-width data movement is expressed as a SCATTER along the
+    permutation (unique indices) rather than a gather — random gathers
+    cost ~10x more than scatters on this hardware — leaving one small
+    per-object gather in the whole pass. The caller guarantees the true
+    run count fits ``rcap`` (OpLog counts runs host-side and picks the
+    bucket).
+    """
+    P = c["action"].shape[0]
+    i32 = jnp.int32
+    ks = jnp.arange(P, dtype=i32)
+    is_elem = core["is_elem"]
+    er = c["elem_ref"]
+    first_child = core["first_child"]
+    next_sib = core["next_sib"][:P]
+    seq = c["aorder"]  # compact slot k -> element row (pad sentinel = P)
+
+    # first-child continuation, scatter-style: each element row p marks
+    # ITS first child as a continuation (unique targets)
+    fc_elem = first_child[:P]  # first child of element row p (node space<P)
+    mark = is_elem & (fc_elem >= 0)
+    is_cont = (
+        jnp.zeros(P + 1, jnp.bool_)
+        .at[jnp.where(mark, jnp.clip(fc_elem, 0, P - 1), P)]
+        .set(True)[:P]
+    )
+
+    valid = seq < P
+    seqc = jnp.clip(seq, 0, P - 1)
+    # row -> compact slot (junk writes land in the spare slot)
+    kpos = (
+        jnp.full(P + 1, 0, i32)
+        .at[jnp.where(valid, seqc, P)]
+        .set(ks)[:P]
+    )
+    # per-slot facts: scatter each row's packed data to its slot
+    row_pack = jnp.stack(
+        [
+            er,
+            next_sib,
+            is_cont.astype(i32) * 2 + (next_sib != NONE32).astype(i32),
+        ],
+        axis=1,
+    )
+    slot_tgt = jnp.where(is_elem, kpos, P)
+    g = (
+        jnp.zeros((P + 1, 3), i32)
+        .at[slot_tgt]
+        .set(row_pack)[:P]
+    )
+    er_k = g[:, 0]
+    sib_k = g[:, 1]
+    cont_bit = g[:, 2]
+
+    # run segmentation: slot k continues its run iff it is a first-child
+    # continuation AND its parent is the previous compact slot's row
+    prev_row = jnp.concatenate([jnp.full(1, P, i32), seq[:-1]])
+    cont_k = valid & (cont_bit >= 2) & (er_k == prev_row)
+    brk = valid & ~cont_k
+    run_of_k = jnp.cumsum(brk.astype(i32)) - 1
+
+    # segmented scan carrying the run-start position and the "last
+    # sibling-bearing member so far" answer (one scan, no gathers)
+    flag_k = valid & ((cont_bit & 1) == 1)
+    val_k = jnp.where(flag_k, sib_k, NONE32)
+
+    def _seg_last(x, y):
+        xv, xf, xs, xb = x
+        yv, yf, ys, yb = y
+        v = jnp.where(yb, yv, jnp.where(yf, yv, xv))
+        f = jnp.where(yb, yf, xf | yf)
+        s = jnp.where(yb, ys, xs)
+        return (v, f, s, xb | yb)
+
+    ans_k, ansf_k, start_k, _ = jax.lax.associative_scan(
+        _seg_last, (val_k, flag_k, ks, brk)
+    )
+    off_k = ks - start_k
+
+    # run tables (rcap capacity; host guarantees run count <= rcap).
+    # Runs are CONTIGUOUS compact stretches: lengths from start diffs.
+    rix = jnp.arange(rcap, dtype=i32)
+    rsafe = jnp.clip(run_of_k, 0, rcap - 1)
+    run_cnt = jnp.sum(brk.astype(i32))
+    live_r = rix < run_cnt
+    n_elems = jnp.sum(valid.astype(i32))
+    run_start = (
+        jnp.full(rcap + 1, 0, i32)
+        .at[jnp.where(brk, rsafe, rcap)]
+        .set(ks)[:rcap]
+    )
+    run_end = jnp.where(
+        rix + 1 < run_cnt,
+        jnp.concatenate([run_start[1:], jnp.zeros(1, i32)]),
+        n_elems,
+    )
+    run_len = jnp.where(live_r, run_end - run_start, 0)
+
+    # condensed sibling-climb: each run asks "A at my head's parent" —
+    # answered within the parent's run prefix when a flagged member
+    # exists, else inherited from THAT run's own climb (all rcap-sized)
+    head_row = seq[jnp.clip(run_start, 0, P - 1)]
+    par_head = jnp.where(live_r, er[jnp.clip(head_row, 0, P - 1)], NONE32)
+    par_is_elem = par_head >= 0  # object-root parents (<0) end the climb
+    pk = kpos[jnp.clip(par_head, 0, P - 1)]
+    a_at_p = ans_k[pk]
+    f_at_p = ansf_k[pk]
+    prun = jnp.clip(run_of_k[pk], 0, rcap - 1)
+    done_r = (~par_is_elem) | f_at_p
+    ans_r = jnp.where(par_is_elem & f_at_p, a_at_p, NONE32)
+    jump_r = jnp.where(par_is_elem, prun, rix)
+
+    # static unroll: a flat HLO graph — fori_loop pays ~1ms/iteration of
+    # launch overhead on this backend, dwarfing the tiny rcap-sized gathers
+    for _ in range(_ceil_log2(rcap) + 1):
+        take = (~done_r) & done_r[jump_r]
+        ans_r = jnp.where(take, ans_r[jump_r], ans_r)
+        done_r = done_r | take
+        jump_r = jump_r[jump_r]
+
+    # run successor: the tail's first child (a later run's head), else the
+    # tail's climb answer (within-run prefix, else the run climb)
+    tail_k = jnp.clip(run_start + run_len - 1, 0, P - 1)
+    tail_row = seq[tail_k]
+    fc_tail = first_child[jnp.clip(tail_row, 0, P - 1)]
+    a_tail = jnp.where(ansf_k[tail_k], ans_k[tail_k], ans_r)
+    nxt_row = jnp.where(live_r, jnp.where(fc_tail >= 0, fc_tail, a_tail), NONE32)
+    succ_run = jnp.where(
+        nxt_row >= 0,
+        jnp.clip(run_of_k[kpos[jnp.clip(nxt_row, 0, P - 1)]], 0, rcap - 1),
+        jnp.int32(rcap),
+    )
+
+    # Wyllie over runs, weights = run lengths; sentinel slot rcap = END
+    dist_r = jnp.concatenate([jnp.where(live_r, run_len, 0), jnp.zeros(1, i32)])
+    nxt_r = jnp.concatenate([succ_run, jnp.full(1, rcap, i32)])
+
+    for _ in range(_ceil_log2(rcap) + 1):  # static unroll (see climb)
+        dist_r = dist_r + dist_r[nxt_r]
+        nxt_r = nxt_r[nxt_r]
+
+    # broadcast each run's dist to its slots: scatter to head slots (rcap
+    # writes), then carry-from-boundary with a segmented scan — no table
+    # gather with full-width indices
+    dist_at_head = (
+        jnp.zeros(P + 1, i32)
+        .at[jnp.where(live_r, jnp.clip(run_start, 0, P), P)]
+        .set(dist_r[:rcap])[:P]
+    )
+
+    def _seg_carry(x, y):
+        xv, xb = x
+        yv, yb = y
+        return (jnp.where(yb, yv, xv), xb | yb)
+
+    dist_k, _ = jax.lax.associative_scan(_seg_carry, (dist_at_head, brk))
+
+    # nodes from v (inclusive) to END: run dist minus offset; scatter the
+    # per-slot value back to rows, then rank = T(object start) - T(v)
+    t_slot = dist_k - off_k
+    t_row = (
+        jnp.zeros(P + 1, i32)
+        .at[jnp.where(valid, seqc, P)]
+        .set(t_slot)[:P]
+    )
+    if obj_cap is not None:
+        # small static object table: T(start) per object via two tiny
+        # gathers + ONE full-width table lookup
+        roots = first_child[P : P + obj_cap + 2]
+        t_start_obj = jnp.where(
+            roots >= 0, t_row[jnp.clip(roots, 0, P - 1)], NONE32
+        )
+        t_start = t_start_obj[jnp.clip(c["obj_dense"], 0, obj_cap + 1)]
+        return jnp.where(is_elem & (t_start >= 0), t_start - t_row, NONE32)
+    start = first_child[P + c["obj_dense"]]
+    startc = jnp.clip(start, 0, P - 1)
+    return jnp.where(
+        is_elem & (start >= 0), t_row[startc] - t_row, NONE32
+    )
+
+
+def condensed_caps(log) -> tuple:
+    """(rcap, obj_cap) buckets for merge_kernel_condensed — the ONE bucket
+    policy shared by bench and tests."""
+    r = max(log.condensed_run_count(), 1)
+    rcap = max(1 << (r - 1).bit_length(), 32)
+    obj_cap = max(1 << max(log.n_objs - 1, 1).bit_length(), 16)
+    return rcap, obj_cap
+
+
+@functools.lru_cache(maxsize=None)
+def merge_kernel_condensed(rcap: int, obj_cap: int = None):
+    """jit'd all-device merge whose linearization condenses chains into at
+    most ``rcap`` runs (one compiled kernel per (rcap, obj_cap) bucket).
+    A static ``obj_cap`` also arms resolve_state's packed single-key
+    winner sort."""
+
+    @jax.jit
+    def _kernel(c):
+        core = resolve_state(c, *succ_resolution(c), obj_cap=obj_cap)
+        core["elem_index"] = device_linearize_condensed(c, core, rcap, obj_cap)
+        return core
+
+    return _kernel
 
 
 # -- scatter-based resolution -------------------------------------------------

@@ -110,6 +110,7 @@ class OpLog:
         "pred_tgt",
         "expand",
         "mark_name_idx",
+        "_actor_order",
     )
 
     def __init__(self):
@@ -120,6 +121,7 @@ class OpLog:
         self.mark_names: List[str] = []
         self.n = 0
         self.n_objs = 1
+        self._actor_order = None
 
     # -- construction --------------------------------------------------
 
@@ -438,10 +440,14 @@ class OpLog:
 
     # -- device prep -----------------------------------------------------
 
-    def columns(self, covered: np.ndarray = None):
+    def columns(self, covered: np.ndarray = None, include_aorder: bool = False):
         """The device-facing column dict WITHOUT capacity padding — the
         host merge engine consumes it as-is (merge_columns pads lazily
         when it routes to the jit kernel, whose shapes must bucket).
+
+        ``include_aorder`` attaches the compacted actor-order layout the
+        condensed all-device kernel reads (bench/tests opt in; the default
+        paths skip the extra device upload).
         """
         if covered is None:
             covered = np.ones(self.n, np.bool_)
@@ -457,9 +463,11 @@ class OpLog:
             "covered": np.asarray(covered, np.bool_),
             "pred_src": self.pred_src,
             "pred_tgt": self.pred_tgt,
+            **({"aorder": self.actor_order()} if include_aorder else {}),
         }
 
-    def padded_columns(self, min_capacity: int = 16, covered: np.ndarray = None):
+    def padded_columns(self, min_capacity: int = 16, covered: np.ndarray = None,
+                       include_aorder: bool = False):
         """Pad to power-of-two capacities for shape-stable jit.
 
         Everything is int32/bool — deliberately: int64 is emulated on TPU.
@@ -470,8 +478,46 @@ class OpLog:
         (default: every op covered — the current-state resolution).
         """
         return pad_columns(
-            self.columns(covered=covered), self.n_objs, min_capacity
+            self.columns(covered=covered, include_aorder=include_aorder),
+            self.n_objs, min_capacity,
         )
+
+    def actor_order(self) -> np.ndarray:
+        """INSERT rows in ACTOR-CONCATENATED order: each actor's element
+        ops consecutive, counters ascending. In this order a typing chain
+        is a contiguous stretch (the per-op RGA references point at the
+        author's previous op), which is what lets the condensed device
+        linearization find chains with scans instead of pointer-chasing
+        (ops/merge.device_linearize_condensed)."""
+        ao = self._actor_order
+        if ao is None:
+            rank = (self.id_key & ACTOR_MASK).astype(np.int64)
+            perm = np.argsort(rank, kind="stable").astype(np.int32)
+            ao = perm[np.asarray(self.insert, bool)[perm]]
+            self._actor_order = ao
+        return ao
+
+    def condensed_run_count(self) -> int:
+        """Exact chain-run count of device_linearize_condensed, computed
+        host-side with vector passes — picks the kernel's rcap bucket."""
+        n = self.n
+        if n == 0:
+            return 1
+        ins = np.asarray(self.insert, bool)
+        er = self.elem_ref
+        rows = np.arange(n, dtype=np.int64)
+        # first_child[p] = LAST insert row referencing p (ascending
+        # prepend: later rows shadow earlier, fancy assignment keeps the
+        # last write)
+        fc = np.full(n, -1, np.int64)
+        em = ins & (er >= 0)
+        fc[er[em]] = rows[em]
+        erc = np.clip(er, 0, n - 1)
+        is_cont = em & (fc[erc] == rows)
+        vs = self.actor_order()
+        prev = np.concatenate([[-9], vs[:-1]])
+        cont = is_cont[vs] & (er[vs] == prev)
+        return max(int((~cont).sum()), 1)
 
     def covered_mask(self, clock_max_op: np.ndarray) -> np.ndarray:
         """Vectorized ``Clock::covers`` (reference: clock.rs:71-77): row i is
@@ -558,6 +604,9 @@ def pad_columns(cols, n_objs: int, min_capacity: int = 16):
         "covered": False,
         "pred_src": 0,
         "pred_tgt": -1,
+        # compacted element order: pad slots carry the out-of-range
+        # sentinel p (the kernel tests "slot < P" for validity)
+        "aorder": p,
     }
     return {
         k: _pad(

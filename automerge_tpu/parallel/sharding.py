@@ -90,6 +90,9 @@ COLUMN_SPECS = {
     "pred_src": P(AXIS),
     "pred_tgt": P(AXIS),
 }
+# (the "aorder" column is opt-in for the single-device condensed kernel
+# only — OpLog.columns() excludes it by default, so the sharded specs
+# never see it; its own condensation is chain-based)
 
 def _sharded_winners(c, visible, Pl, n_objs2, n_props, G):
     """Scatter-based per-key winners, row-sliced per device.

@@ -212,7 +212,7 @@ def main():
             scatter_geometry_ok, scatter_kernel_core,
         )
 
-        cols_np = log.padded_columns()
+        cols_np = log.padded_columns(include_aorder=True)
         cols_dev = jax.block_until_ready(
             {k: jnp.asarray(v) for k, v in cols_np.items()}
         )
@@ -250,7 +250,21 @@ def main():
         have_scatter = scatter_geometry_ok(
             len(cols_np["action"]), log.n_objs, len(log.props)
         )
-        variants = [("full", merge_kernel), ("core", merge_kernel_core)]
+        # all-device document ordering: the chain-condensed kernel
+        # (runs found by scans, doubling only over the run tables)
+        # replaces the plain pointer-doubling ranking when the run count
+        # fits a bucket meaningfully below the row space
+        from automerge_tpu.ops.merge import (
+            condensed_caps, merge_kernel_condensed,
+        )
+
+        rcap, obj_cap = condensed_caps(log)
+        if rcap <= len(cols_np["action"]):
+            full_fn = merge_kernel_condensed(rcap, obj_cap)
+            kernel["condensed_runs"] = int(log.condensed_run_count())
+        else:
+            full_fn = merge_kernel
+        variants = [("full", full_fn), ("core", merge_kernel_core)]
         if have_scatter:
             variants.append(
                 ("scatter", scatter_kernel_core(log.n_objs, len(log.props)))

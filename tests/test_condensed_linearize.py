@@ -1,0 +1,134 @@
+"""The chain-condensed all-device linearization (ops/merge.py
+device_linearize_condensed) must produce the same document order as the
+host preorder walk and the plain pointer-doubling kernel, on every forest
+shape: typing chains, interleaved multi-actor chains, random splices,
+deletes, multiple sequence objects, and forests whose runs break at
+change boundaries.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from automerge_tpu import native
+from automerge_tpu.api import AutoDoc
+from automerge_tpu.ops import DeviceDoc, OpLog
+from automerge_tpu.ops.merge import (
+    condensed_caps,
+    merge_columns,
+    merge_kernel,
+    merge_kernel_condensed,
+)
+from automerge_tpu.types import ActorId, ObjType
+
+
+def _assert_condensed_matches(docs_or_doc):
+    docs = docs_or_doc if isinstance(docs_or_doc, list) else [docs_or_doc]
+    log = OpLog.from_documents(docs)
+    cols = log.padded_columns(include_aorder=True)
+    rcap, obj_cap = condensed_caps(log)
+    out_c = merge_kernel_condensed(rcap)(cols)
+    out_o = merge_kernel_condensed(rcap, obj_cap)(cols)  # packed-sort arm
+    out_d = merge_kernel(cols)
+    host = merge_columns(
+        log.columns(), fetch=("elem_index", "visible", "winner"),
+        n_objs=log.n_objs, n_props=len(log.props),
+    )
+    n = log.n
+    ei_c = np.asarray(out_c["elem_index"])[:n]
+    ei_o = np.asarray(out_o["elem_index"])[:n]
+    ei_d = np.asarray(out_d["elem_index"])[:n]
+    ei_h = np.asarray(host["elem_index"])[:n]
+    np.testing.assert_array_equal(ei_c, ei_d)
+    np.testing.assert_array_equal(ei_c, ei_h)
+    np.testing.assert_array_equal(ei_o, ei_h)
+    np.testing.assert_array_equal(
+        np.asarray(out_o["winner"])[:n], np.asarray(host["winner"])[:n]
+    )
+
+
+def test_typing_chain():
+    d = AutoDoc(actor=ActorId(bytes([1]) * 16))
+    t = d.put_object("_root", "t", ObjType.TEXT)
+    d.splice_text(t, 0, 0, "hello world, this is a chain")
+    d.commit()
+    _assert_condensed_matches(d)
+
+
+def test_interleaved_actors_and_deletes():
+    a = AutoDoc(actor=ActorId(bytes([1]) * 16))
+    t = a.put_object("_root", "t", ObjType.TEXT)
+    a.splice_text(t, 0, 0, "base text for everyone")
+    a.commit()
+    forks = [a.fork(actor=ActorId(bytes([10 + i]) * 16)) for i in range(6)]
+    rng = np.random.default_rng(7)
+    for i, f in enumerate(forks):
+        for _ in range(20):
+            ln = f.length(t)
+            pos = int(rng.integers(0, ln + 1))
+            ndel = int(rng.integers(0, min(2, ln - pos) + 1))
+            f.splice_text(t, pos, ndel, "ab"[: int(rng.integers(0, 3))])
+        f.commit()
+    for f in forks:
+        a.merge(f)
+    _assert_condensed_matches(a)
+
+
+def test_multiple_sequence_objects():
+    d = AutoDoc(actor=ActorId(bytes([2]) * 16))
+    t1 = d.put_object("_root", "t1", ObjType.TEXT)
+    t2 = d.put_object("_root", "t2", ObjType.TEXT)
+    lst = d.put_object("_root", "l", ObjType.LIST)
+    d.splice_text(t1, 0, 0, "first object")
+    d.splice_text(t2, 0, 0, "second")
+    for i in range(10):
+        d.insert(lst, i, i)
+    d.commit()
+    d.splice_text(t1, 5, 3, "X")
+    d.delete(lst, 2)
+    d.commit()
+    _assert_condensed_matches(d)
+
+
+def test_prepend_heavy_sibling_order():
+    # every insert at position 0: all elements are siblings of HEAD, so
+    # every element is its own run (worst case for condensation)
+    d = AutoDoc(actor=ActorId(bytes([3]) * 16))
+    t = d.put_object("_root", "t", ObjType.TEXT)
+    for i in range(60):
+        d.splice_text(t, 0, 0, chr(ord("a") + i % 26))
+    d.commit()
+    _assert_condensed_matches(d)
+
+
+def test_cross_change_chain_continuation():
+    # one actor typing across many commits: the chain spans changes but
+    # stays contiguous in actor order
+    d = AutoDoc(actor=ActorId(bytes([4]) * 16))
+    t = d.put_object("_root", "t", ObjType.TEXT)
+    for w in ("alpha ", "beta ", "gamma ", "delta"):
+        d.splice_text(t, d.length(t), 0, w)
+        d.commit()
+    _assert_condensed_matches(d)
+
+
+def test_randomized_forests():
+    rng = np.random.default_rng(42)
+    for trial in range(4):
+        a = AutoDoc(actor=ActorId(bytes([1]) * 16))
+        t = a.put_object("_root", "t", ObjType.TEXT)
+        a.splice_text(t, 0, 0, "seed")
+        a.commit()
+        forks = [a.fork(actor=ActorId(bytes([20 + i]) * 16)) for i in range(4)]
+        for f in forks:
+            for _ in range(int(rng.integers(5, 40))):
+                ln = f.length(t)
+                pos = int(rng.integers(0, ln + 1))
+                ndel = int(rng.integers(0, min(3, ln - pos) + 1))
+                txt = "xyz"[: int(rng.integers(0, 4))]
+                f.splice_text(t, pos, ndel, txt)
+            f.commit()
+        for f in forks:
+            a.merge(f)
+        _assert_condensed_matches(a)
