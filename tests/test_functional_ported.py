@@ -1,0 +1,305 @@
+"""Reference JS-wrapper scenarios ported against the functional API.
+
+Each test is a behavioral port of a named case from the reference's
+wrapper suite (reference: javascript/test/legacy_tests.ts — file:line
+cited per test), driven through automerge_tpu.functional's immutable-doc
+idiom: change() returns new values, merge() consumes the local input,
+conflicts read through get_conflicts with opid-exid keys.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+import automerge_tpu.functional as am
+
+A1 = bytes.fromhex("aa" * 16)
+A2 = bytes.fromhex("bb" * 16)
+A3 = bytes.fromhex("cc" * 16)
+
+
+def _pair():
+    return am.init(actor=A1), am.init(actor=A2)
+
+
+def opid(ctr: int, actor: bytes) -> str:
+    return f"{ctr}@{actor.hex()}"
+
+
+def _val(v):
+    """Render a conflict entry for comparison (proxies -> plain values)."""
+    return v.to_py() if hasattr(v, "to_py") else v
+
+
+def test_merge_concurrent_updates_of_different_properties():
+    # legacy_tests.ts:1077
+    s1, s2 = _pair()
+    s1 = am.change(s1, lambda d: d.update({"foo": "bar"}))
+    s2 = am.change(s2, lambda d: d.update({"hello": "world"}))
+    s3 = am.merge(s1, s2)
+    assert s3.to_py() == {"foo": "bar", "hello": "world"}
+    assert am.get_conflicts(s3, "foo") is None
+    assert am.get_conflicts(s3, "hello") is None
+    s4 = am.load(am.save(s3))
+    assert am.equals(s3, s4)
+
+
+def test_add_concurrent_increments_of_same_property():
+    # legacy_tests.ts:1090
+    s1, s2 = _pair()
+    s1 = am.change(s1, lambda d: d.update({"counter": am.Counter()}))
+    s2 = am.merge(s2, am.clone(s1))
+    s1 = am.change(s1, lambda d: d.increment("counter", 1))
+    s2 = am.change(s2, lambda d: d.increment("counter", 2))
+    assert s1["counter"] == 1 and s2["counter"] == 2
+    s3 = am.merge(s1, s2)
+    assert s3["counter"] == 3
+    assert am.get_conflicts(s3, "counter") is None
+    assert am.equals(am.load(am.save(s3)), s3)
+
+
+def test_increments_only_apply_to_values_they_precede():
+    # legacy_tests.ts:1104 — concurrent counter REPLACE vs increment:
+    # each increment lands only on the counter op it named
+    s1, s2 = _pair()
+    s1 = am.change(s1, lambda d: d.update({"counter": am.Counter(0)}))
+    s1 = am.change(s1, lambda d: d.increment("counter", 1))
+    s2 = am.change(s2, lambda d: d.update({"counter": am.Counter(100)}))
+    s2 = am.change(s2, lambda d: d.increment("counter", 3))
+    s3 = am.merge(s1, s2)
+    # A2 > A1 lexicographically: s2's write wins
+    assert s3.to_py() == {"counter": 103}
+    assert {k: _val(v) for k, v in am.get_conflicts(s3, "counter").items()} == {
+        opid(1, A1): 1,
+        opid(1, A2): 103,
+    }
+    assert am.equals(am.load(am.save(s3)), s3)
+
+
+def test_detect_concurrent_updates_of_same_field():
+    # legacy_tests.ts:1126
+    s1, s2 = _pair()
+    s1 = am.change(s1, lambda d: d.update({"field": "one"}))
+    s2 = am.change(s2, lambda d: d.update({"field": "two"}))
+    s3 = am.merge(s1, s2)
+    assert s3.to_py() == {"field": "two"}  # larger actor id wins
+    assert {k: _val(v) for k, v in am.get_conflicts(s3, "field").items()} == {
+        opid(1, A1): "one",
+        opid(1, A2): "two",
+    }
+
+
+def test_detect_concurrent_updates_of_same_list_element():
+    # legacy_tests.ts:1141
+    s1, s2 = _pair()
+    s1 = am.change(s1, lambda d: d.update({"birds": ["finch"]}))
+    s2 = am.merge(s2, am.clone(s1))
+    s1 = am.change(s1, lambda d: d["birds"].__setitem__(0, "greenfinch"))
+    s2 = am.change(s2, lambda d: d["birds"].__setitem__(0, "goldfinch_"))
+    s3 = am.merge(s1, s2)
+    assert s3.to_py()["birds"] == ["goldfinch_"]
+    confl = am.get_conflicts(s3["birds"], 0)
+    assert {k: _val(v) for k, v in confl.items()} == {
+        opid(3, A1): "greenfinch",
+        opid(3, A2): "goldfinch_",
+    }
+
+
+def test_assignment_conflicts_of_different_types():
+    # legacy_tests.ts:1158
+    s1 = am.init(actor=A1)
+    s2 = am.init(actor=A2)
+    s3 = am.init(actor=A3)
+    s1 = am.change(s1, lambda d: d.update({"field": "string"}))
+    s2 = am.change(s2, lambda d: d.update({"field": ["list"]}))
+    s3 = am.change(s3, lambda d: d.update({"field": {"thing": "map"}}))
+    s1 = am.merge(am.merge(s1, s2), s3)
+    assert _val(s1["field"]) in ("string", ["list"], {"thing": "map"})
+    confl = {k: _val(v) for k, v in am.get_conflicts(s1, "field").items()}
+    assert confl == {
+        opid(1, A1): "string",
+        opid(1, A2): ["list"],
+        opid(1, A3): {"thing": "map"},
+    }
+
+
+def test_changes_within_conflicting_map_field():
+    # legacy_tests.ts:1171
+    s1, s2 = _pair()
+    s1 = am.change(s1, lambda d: d.update({"field": "string"}))
+    s2 = am.change(s2, lambda d: d.update({"field": {}}))
+    s2 = am.change(s2, lambda d: d["field"].update({"innerKey": 42}))
+    s3 = am.merge(s1, s2)
+    confl = {k: _val(v) for k, v in am.get_conflicts(s3, "field").items()}
+    assert confl == {
+        opid(1, A1): "string",
+        opid(1, A2): {"innerKey": 42},
+    }
+
+
+def test_changes_within_conflicting_list_element():
+    # legacy_tests.ts:1183
+    s1, s2 = _pair()
+    s1 = am.change(s1, lambda d: d.update({"list": ["hello"]}))
+    s2 = am.merge(s2, am.clone(s1))
+    s1 = am.change(s1, lambda d: d["list"].__setitem__(0, {"map1": True}))
+    s1 = am.change(s1, lambda d: d["list"][0].update({"key": 1}))
+    s2 = am.change(s2, lambda d: d["list"].__setitem__(0, {"map2": True}))
+    s2 = am.change(s2, lambda d: d["list"][0].update({"key": 2}))
+    s3 = am.merge(s1, s2)
+    assert s3.to_py()["list"] == [{"map2": True, "key": 2}]
+    confl = {k: _val(v) for k, v in am.get_conflicts(s3["list"], 0).items()}
+    assert confl == {
+        opid(3, A1): {"map1": True, "key": 1},
+        opid(3, A2): {"map2": True, "key": 2},
+    }
+
+
+def test_no_merge_of_concurrently_assigned_nested_maps():
+    # legacy_tests.ts:1202
+    s1, s2 = _pair()
+    s1 = am.change(s1, lambda d: d.update({"config": {"background": "blue"}}))
+    s2 = am.change(s2, lambda d: d.update({"config": {"logo_url": "logo.png"}}))
+    s3 = am.merge(s1, s2)
+    assert _val(s3["config"]) in (
+        {"background": "blue"}, {"logo_url": "logo.png"},
+    )
+    confl = {k: _val(v) for k, v in am.get_conflicts(s3, "config").items()}
+    assert confl == {
+        opid(1, A1): {"background": "blue"},
+        opid(1, A2): {"logo_url": "logo.png"},
+    }
+
+
+def test_clear_conflicts_after_assigning_new_value():
+    # legacy_tests.ts:1217
+    s1, s2 = _pair()
+    s1 = am.change(s1, lambda d: d.update({"field": "one"}))
+    s2 = am.change(s2, lambda d: d.update({"field": "two"}))
+    s3 = am.merge(s1, am.clone(s2))
+    s3 = am.change(s3, lambda d: d.update({"field": "three"}))
+    assert s3.to_py() == {"field": "three"}
+    assert am.get_conflicts(s3, "field") is None
+    s2 = am.merge(s2, s3)
+    assert s2.to_py() == {"field": "three"}
+    assert am.get_conflicts(s2, "field") is None
+
+
+def test_concurrent_insertions_at_different_list_positions():
+    # legacy_tests.ts:1229
+    s1, s2 = _pair()
+    s1 = am.change(s1, lambda d: d.update({"list": ["one", "three"]}))
+    s2 = am.merge(s2, am.clone(s1))
+    s1 = am.change(s1, lambda d: d["list"].insert(1, "two"))
+    s2 = am.change(s2, lambda d: d["list"].append("four"))
+    s3 = am.merge(s1, s2)
+    assert s3.to_py() == {"list": ["one", "two", "three", "four"]}
+
+
+def test_concurrent_insertions_at_same_position_converge():
+    # legacy_tests.ts:1240
+    s1, s2 = _pair()
+    s1 = am.change(s1, lambda d: d.update({"birds": ["parakeet"]}))
+    s2 = am.merge(s2, am.clone(s1))
+    s1 = am.change(s1, lambda d: d["birds"].append("starling"))
+    s2 = am.change(s2, lambda d: d["birds"].append("chaffinch"))
+    s3 = am.merge(s1, am.clone(s2))
+    birds = s3.to_py()["birds"]
+    assert birds in (
+        ["parakeet", "starling", "chaffinch"],
+        ["parakeet", "chaffinch", "starling"],
+    )
+    s2b = am.merge(s2, s3)
+    assert am.equals(s2b, s3)
+
+
+def test_concurrent_assignment_and_deletion_add_wins():
+    # legacy_tests.ts:1253 — add-wins semantics
+    s1, s2 = _pair()
+    s1 = am.change(s1, lambda d: d.update({"bestBird": "robin"}))
+    s2 = am.merge(s2, am.clone(s1))
+    s1 = am.change(s1, lambda d: d.__delitem__("bestBird"))
+    s2 = am.change(s2, lambda d: d.update({"bestBird": "magpie"}))
+    s3 = am.merge(s1, s2)
+    assert s3.to_py() == {"bestBird": "magpie"}
+
+
+def test_list_insert_order_for_equal_counters_is_reverse_actor():
+    # legacy_tests.ts:774 — concurrent same-counter inserts land in
+    # reverse actor-id order
+    s1 = am.init(actor=A1)
+    s2 = am.init(actor=A2)
+    s1 = am.change(s1, lambda d: d.update({"list": []}))
+    s2 = am.merge(s2, am.clone(s1))
+    s1 = am.change(s1, lambda d: d["list"].insert(0, "one"))
+    s2 = am.change(s2, lambda d: d["list"].insert(0, "two"))
+    s3 = am.merge(s1, s2)
+    assert s3.to_py()["list"] == ["two", "one"]  # A2 > A1
+
+
+def test_root_property_deletion_and_js_delete_behavior():
+    # legacy_tests.ts:451,464
+    d = am.from_dict({"a": 1, "b": 2}, actor=A1)
+    d = am.change(d, lambda x: x.__delitem__("a"))
+    assert d.to_py() == {"b": 2}
+    assert "a" not in d
+
+
+def test_type_of_property_can_change():
+    # legacy_tests.ts:482
+    d = am.from_dict({"x": 1}, actor=A1)
+    d = am.change(d, lambda x: x.update({"x": "now a string"}))
+    assert d.to_py() == {"x": "now a string"}
+    d = am.change(d, lambda x: x.update({"x": [1, 2]}))
+    assert d.to_py() == {"x": [1, 2]}
+
+
+def test_arbitrary_depth_nesting_and_replacement():
+    # legacy_tests.ts:571,585
+    d = am.from_dict(
+        {"a": {"b": {"c": {"d": {"e": "deep"}}}}}, actor=A1
+    )
+    assert d["a"]["b"]["c"]["d"].to_py() == {"e": "deep"}
+    d = am.change(d, lambda x: x["a"]["b"].update({"c": "replaced"}))
+    assert d.to_py() == {"a": {"b": {"c": "replaced"}}}
+
+
+def test_out_by_one_list_assignment_is_insertion():
+    # legacy_tests.ts:797,807
+    d = am.from_dict({"l": ["a"]}, actor=A1)
+    d = am.change(d, lambda x: x["l"].insert(1, "b"))
+    assert d.to_py()["l"] == ["a", "b"]
+    with pytest.raises(Exception):
+        am.change(d, lambda x: x["l"].__setitem__(5, "nope"))
+
+
+def test_empty_change_references_dependencies():
+    # legacy_tests.ts:402,413 — the ack change depends on BOTH heads
+    s1, s2 = _pair()
+    s1 = am.change(s1, lambda d: d.update({"a": 1}))
+    s2 = am.change(s2, lambda d: d.update({"b": 2}))
+    h1 = am.get_heads(s1)[0]
+    h2 = am.get_heads(s2)[0]
+    s1 = am.merge(s1, s2)
+    s1 = am.empty_change(s1, "ack")
+    last = am.get_history(s1)[-1].change
+    assert sorted(last["deps"]) == sorted([h1.hex(), h2.hex()])
+    assert last["ops"] == []
+
+
+def test_change_does_not_mutate_input_and_old_doc_unusable():
+    # legacy_tests.ts:85 + stable.ts outdated-document rule
+    s1 = am.from_dict({"k": 1}, actor=A1)
+    s2 = am.change(s1, lambda d: d.update({"k": 2}))
+    assert s2.to_py() == {"k": 2}
+    with pytest.raises(RuntimeError):
+        am.change(s1, lambda d: d.update({"k": 3}))
+
+
+def test_no_conflicts_on_repeated_assignment():
+    # legacy_tests.ts:135
+    d = am.init(actor=A1)
+    for v in (1, 2, 3):
+        d = am.change(d, lambda x, v=v: x.update({"k": v}))
+        assert am.get_conflicts(d, "k") is None
+    assert d.to_py() == {"k": 3}
