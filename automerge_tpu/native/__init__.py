@@ -808,17 +808,16 @@ class EditSession:
         bulk-ingest path); with ``clamp``, positions and delete counts are
         clamped to the live length per edit. Returns total ops emitted."""
         n = len(edits)
-        pos = np.empty(n, np.int64)
-        ndel = np.empty(n, np.int64)
-        texts = []
+        # vectorized batch prep: the former per-edit python loop cost more
+        # than the native splices themselves on full-trace ingests
+        pos = np.fromiter((e[0] for e in edits), np.int64, count=n)
+        ndel = np.fromiter((e[1] for e in edits), np.int64, count=n)
+        texts = [e[2] if len(e) == 3 else ("".join(e[2:]) if len(e) > 3 else "") for e in edits]
         off = np.empty(n + 1, np.int64)
         off[0] = 0
-        for i, e in enumerate(edits):
-            pos[i] = e[0]
-            ndel[i] = e[1]
-            t = "".join(e[2:]) if len(e) > 2 else ""
-            texts.append(t)
-            off[i + 1] = off[i] + len(t)
+        np.cumsum(
+            np.fromiter(map(len, texts), np.int64, count=n), out=off[1:]
+        )
         all_text = "".join(texts)
         if all_text:
             cps = np.frombuffer(all_text.encode("utf-32-le"), np.uint32).astype(np.int32)

@@ -56,6 +56,7 @@ def main():
     from automerge_tpu.types import ActorId
 
     verbose = os.environ.get("BENCH_VERBOSE")
+    reps = env_int("BENCH_REPS", 3)  # best-of-N, one knob for every config
     results = {}
 
     def note(msg):
@@ -165,14 +166,14 @@ def main():
         return log, res, best
 
     log, res, (t_extract, t_merge) = device_merge_timed(
-        changes, env_int("BENCH_REPS", 3)
+        changes, reps
     )
     t_device = t_extract + t_merge
     n = log.n
 
     # baseline 1: native sequential apply (measured)
     t_native, native_text = W.seq_apply_baseline(
-        changes, base.text_obj, reps=env_int("BENCH_REPS", 3)
+        changes, base.text_obj, reps=reps
     )
     native_rate = n / t_native
 
@@ -236,7 +237,7 @@ def main():
             _sync(out)
             rtt = time.perf_counter() - t0
             t_best = float("inf")
-            for _ in range(env_int("BENCH_REPS", 3) + 1):
+            for _ in range(reps + 1):
                 t0 = time.perf_counter()
                 for _ in range(M):
                     out = fn(cols_dev)  # async dispatch
@@ -316,7 +317,7 @@ def main():
         os.environ["AUTOMERGE_TPU_HOST_MERGE_MAX"] = "0"
         try:
             _, _, (t_dex, t_dmg) = device_merge_timed(
-                changes, env_int("BENCH_REPS", 3)
+                changes, reps
             )
         finally:
             if prev is None:
@@ -377,7 +378,7 @@ def main():
     all_mc = [a.stored for a in cdoc.doc.history] + mc_changes
     mc_reps = []
     mlog, mres, (t_mc_ex, t_mc_mg) = device_merge_timed(
-        all_mc, env_int("BENCH_REPS", 3), rep_times=mc_reps
+        all_mc, reps, rep_times=mc_reps
     )
     t_mc = t_mc_ex + t_mc_mg
     mdev = DeviceDoc(mlog, mres)
@@ -411,11 +412,11 @@ def main():
     rga_changes = W.synth_rga(rbase, rga_actors, rga_ops)
     all_rga = list(rbase.changes) + rga_changes
     rlog, rres, (t_rga_ex, t_rga_mg) = device_merge_timed(
-        all_rga, env_int("BENCH_REPS", 3)
+        all_rga, reps
     )
     t_rga = t_rga_ex + t_rga_mg
     t_rn, rn_text = W.seq_apply_baseline(
-        all_rga, rbase.text_obj, reps=env_int("BENCH_REPS", 3)
+        all_rga, rbase.text_obj, reps=reps
     )
     rdev = DeviceDoc(rlog, rres)
     assert rdev.text(rbase.text_exid) == rn_text, "rga device/native divergence"
@@ -489,7 +490,7 @@ def main():
 
     # best-of-reps like every other config (a fresh replica per rep)
     t_sync, rounds, phases = sync_once()
-    for _ in range(env_int("BENCH_REPS", 3) - 1):
+    for _ in range(reps - 1):
         dt, r, p = sync_once()
         if dt < t_sync:
             t_sync, rounds, phases = dt, r, p
@@ -510,7 +511,6 @@ def main():
     # show up as per-op time even when the batched merge path is healthy)
     micro = {}
     micro_max = env_int("BENCH_MICRO_MAX", 10_000)
-    reps = env_int("BENCH_REPS", 3)
     for n_keys in (100, 1_000, 10_000):
         if n_keys > micro_max:
             continue
