@@ -308,8 +308,11 @@ def main():
             kernel["kernel_vs_baseline"] = round(best_core / baseline_rate, 3)
             note(f"fanin kernel-only: {kernel}")
     except Exception as e:  # noqa: BLE001 — degrade, record, continue
-        kernel = {"kernel_error": repr(e)[:300]}
-        note(f"fanin kernel section failed: {e!r}")
+        import traceback
+
+        tb = traceback.format_exc()
+        kernel = {"kernel_error": (repr(e) + " | " + tb.splitlines()[-3:][0])[:500]}
+        print(f"fanin kernel section failed:\n{tb}", file=sys.stderr, flush=True)
 
     # ---- device e2e sidecar: the SAME fan-in with the host engine off ----
     # (AUTOMERGE_TPU_HOST_MERGE_MAX=0 -> merge_columns routes to the
@@ -358,8 +361,11 @@ def main():
             }
             note(f"fanin device e2e: {device_e2e}")
     except Exception as e:  # noqa: BLE001
-        device_e2e = {"device_e2e_error": repr(e)[:300]}
-        note(f"fanin device e2e failed: {e!r}")
+        import traceback
+
+        tb = traceback.format_exc()
+        device_e2e = {"device_e2e_error": repr(e)[:500]}
+        print(f"fanin device e2e failed:\n{tb}", file=sys.stderr, flush=True)
 
     results["fanin"] = {
         **kernel,

@@ -303,3 +303,55 @@ def test_no_conflicts_on_repeated_assignment():
         d = am.change(d, lambda x, v=v: x.update({"k": v}))
         assert am.get_conflicts(d, "k") is None
     assert d.to_py() == {"k": 3}
+
+
+# -- changeAt scenarios (reference: javascript/test/change_at.ts) -------------
+
+
+def test_change_at_prior_state_lands_concurrent():
+    # change_at.ts:6 — edit as of old heads; both edits survive the merge
+    d = am.init(actor=A1)
+    d = am.change(d, lambda x: x.update({"text": am.Text("aaabbbccc")}))
+    heads1 = am.get_heads(d)
+    d = am.change(d, lambda x: am.splice(x, ["text"], 3, 3, "BBB"))
+    assert d.to_py()["text"] == "aaaBBBccc"
+
+    def edit_old(x):
+        assert str(x["text"]) == "aaabbbccc"  # sees the OLD state
+        am.splice(x, ["text"], 2, 3, "XXX")
+        assert str(x["text"]) == "aaXXXbccc"
+
+    d = am.change_at(d, heads1, edit_old)
+    assert d.to_py()["text"] == "aaXXXBBBccc"
+
+
+def test_change_at_empty_change_leaves_heads_intact():
+    # change_at.ts:22 — a no-op changeAt must not collapse a forked history
+    d1 = am.init(actor=A1)
+    d1 = am.change(d1, lambda x: x.update({"text": "aaabbbccc"}))
+    heads_before_fork = am.get_heads(d1)
+    d2 = am.clone(d1, actor=A2)
+    d2 = am.change(d2, lambda x: x.update({"doc2": "doc2"}))
+    d1 = am.change(d1, lambda x: x.update({"doc1": "doc1"}))
+    d1 = am.merge(d1, d2)
+    assert len(am.get_heads(d1)) == 2
+    d1 = am.change_at(d1, heads_before_fork, lambda x: None)
+    assert len(am.get_heads(d1)) == 2
+
+
+def test_change_at_adds_head_beside_unchanged_fork():
+    # change_at.ts:47 — the changeAt head joins the untouched fork's head
+    d1 = am.init(actor=A1)
+    d1 = am.change(d1, lambda x: x.update({"text": "aaabbbccc"}))
+    d2 = am.clone(d1, actor=A2)
+    d2 = am.change(d2, lambda x: x.update({"doc2": "doc2"}))
+    heads_on_fork = am.get_heads(d2)
+    d1 = am.change(d1, lambda x: x.update({"doc1": "doc1"}))
+    doc1_heads = am.get_heads(d1)
+    d1 = am.merge(d1, d2)
+    d1 = am.change_at(d1, doc1_heads, lambda x: x.update({"text": "changed"}))
+    new_heads = [
+        h for h in am.get_heads(d1) if h not in heads_on_fork
+    ]
+    assert len(new_heads) == 1  # exactly one new head from the isolated edit
+    assert set(am.get_heads(d1)) == set(heads_on_fork) | set(new_heads)
