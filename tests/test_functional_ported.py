@@ -1,10 +1,11 @@
 """Reference JS-wrapper scenarios ported against the functional API.
 
 Each test is a behavioral port of a named case from the reference's
-wrapper suite (reference: javascript/test/legacy_tests.ts — file:line
-cited per test), driven through automerge_tpu.functional's immutable-doc
-idiom: change() returns new values, merge() consumes the local input,
-conflicts read through get_conflicts with opid-exid keys.
+wrapper suites (reference: javascript/test/legacy_tests.ts,
+change_at.ts, patches.ts — file:line cited per test), driven through
+automerge_tpu.functional's immutable-doc idiom: change() returns new
+values, merge() consumes the local input, conflicts read through
+get_conflicts with opid-exid keys.
 """
 
 from __future__ import annotations
@@ -12,6 +13,7 @@ from __future__ import annotations
 import pytest
 
 import automerge_tpu.functional as am
+from automerge_tpu.patches import apply_patches
 
 A1 = bytes.fromhex("aa" * 16)
 A2 = bytes.fromhex("bb" * 16)
@@ -355,3 +357,55 @@ def test_change_at_adds_head_beside_unchanged_fork():
     ]
     assert len(new_heads) == 1  # exactly one new head from the isolated edit
     assert set(am.get_heads(d1)) == set(heads_on_fork) | set(new_heads)
+
+
+# -- patch / diff scenarios (reference: javascript/test/patches.ts) -----------
+
+
+def test_diff_covers_changes_between_heads():
+    # patches.ts:76 — diff(before, after) describes the delta; applying it
+    # to the before-state materializes the after-state
+    d = am.from_dict({"birds": ["goldfinch"]}, actor=A1)
+    before = am.get_heads(d)
+    before_state = am.to_dict(d)
+
+    def edit(x):
+        x["birds"].append("greenfinch")
+        x.update({"fish": ["cod"]})
+
+    d = am.change(d, edit)
+    after = am.get_heads(d)
+    patches = am.diff(d, before, after)
+    assert patches  # non-empty delta
+    got = apply_patches(before_state, patches)
+    assert got == {"birds": ["goldfinch", "greenfinch"], "fish": ["cod"]}
+    # reverse diff walks back
+    back = am.diff(d, after, before)
+    assert apply_patches(am.to_dict(d), back) == {"birds": ["goldfinch"]}
+
+
+def test_diff_before_and_after_views_are_readable():
+    # patches.ts:7 — before/after states around a change are addressable
+    d = am.from_dict({"count": 0}, actor=A1)
+    heads_before = am.get_heads(d)
+    d = am.change(d, lambda x: x.update({"count": 1}))
+    heads_after = am.get_heads(d)
+    assert am.view(d, heads_before).to_py() == {"count": 0}
+    assert am.view(d, heads_after).to_py() == {"count": 1}
+
+
+def test_diff_observed_deletion_states():
+    # patches.ts:27,49 — deletions in lists and maps round-trip via diff
+    d = am.from_dict({"list": ["a", "b", "c"], "obj": {"a": "a", "b": "b"}},
+                     actor=A1)
+    before = am.get_heads(d)
+    before_state = am.to_dict(d)
+
+    def edit(x):
+        am.delete_at(x["list"], 1)
+        del x["obj"]["b"]
+
+    d = am.change(d, edit)
+    assert d.to_py() == {"list": ["a", "c"], "obj": {"a": "a"}}
+    got = apply_patches(before_state, am.diff(d, before, am.get_heads(d)))
+    assert got == {"list": ["a", "c"], "obj": {"a": "a"}}
