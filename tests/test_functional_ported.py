@@ -2,7 +2,8 @@
 
 Each test is a behavioral port of a named case from the reference's
 wrapper suites (reference: javascript/test/legacy_tests.ts,
-change_at.ts, patches.ts — file:line cited per test), driven through
+change_at.ts, patches.ts, text_test.ts — file:line cited per test),
+driven through
 automerge_tpu.functional's immutable-doc idiom: change() returns new
 values, merge() consumes the local input, conflicts read through
 get_conflicts with opid-exid keys.
@@ -409,3 +410,72 @@ def test_diff_observed_deletion_states():
     assert d.to_py() == {"list": ["a", "c"], "obj": {"a": "a"}}
     got = apply_patches(before_state, am.diff(d, before, am.get_heads(d)))
     assert got == {"list": ["a", "c"], "obj": {"a": "a"}}
+
+
+# -- text scenarios (reference: javascript/test/text_test.ts) -----------------
+
+
+def test_text_insert_delete_implicit_explicit():
+    # text_test.ts:17,25,36
+    d = am.from_dict({"text": am.Text("")}, actor=A1)
+    d = am.change(d, lambda x: am.splice(x, ["text"], 0, 0, "abc"))
+    d = am.change(d, lambda x: am.splice(x, ["text"], 1, 1))
+    d = am.change(d, lambda x: am.splice(x, ["text"], 1, 0))
+    assert d.to_py()["text"] == "ac"
+
+
+def test_text_concurrent_insertion_converges():
+    # text_test.ts:48
+    s1 = am.from_dict({"text": am.Text("")}, actor=A1)
+    s2 = am.merge(am.init(actor=A2), am.clone(s1))
+    s1 = am.change(s1, lambda x: am.splice(x, ["text"], 0, 0, "abc"))
+    s2 = am.change(s2, lambda x: am.splice(x, ["text"], 0, 0, "xyz"))
+    s1 = am.merge(s1, am.clone(s2))
+    t = s1.to_py()["text"]
+    assert t in ("abcxyz", "xyzabc")
+    s2 = am.merge(s2, s1)
+    assert s2.to_py()["text"] == t
+
+
+def test_text_and_other_ops_in_same_change():
+    # text_test.ts:60
+    d = am.from_dict({"text": am.Text("")}, actor=A1)
+
+    def edit(x):
+        x.update({"foo": "bar"})
+        am.splice(x, ["text"], 0, 0, "a")
+
+    d = am.change(d, edit)
+    assert d.to_py() == {"foo": "bar", "text": "a"}
+
+
+def test_text_edits_visible_inside_change_callback():
+    # text_test.ts:77
+    def edit(x):
+        x.update({"text": am.Text("")})
+        am.splice(x, ["text"], 0, 0, "abcd")
+        am.splice(x, ["text"], 2, 1)
+        assert str(x["text"]) == "abd"
+
+    d = am.change(am.init(actor=A1), edit)
+    assert d.to_py()["text"] == "abd"
+
+
+def test_text_initial_value_is_one_change_and_unicode():
+    # text_test.ts:95,105,115
+    s1 = am.from_dict({"text": am.Text("init")}, actor=A1)
+    assert s1.to_py()["text"] == "init"
+    changes = am.get_all_changes(s1)
+    assert len(changes) == 1
+    s2 = am.apply_changes(am.init(actor=A2), changes)
+    assert s2.to_py()["text"] == "init"
+    uni = am.from_dict({"text": am.Text("\U0001F426")}, actor=A3)
+    assert uni.to_py()["text"] == "\U0001F426"
+    assert am.load(am.save(uni)).to_py()["text"] == "\U0001F426"
+
+
+def test_splice_into_text_nested_in_arrays():
+    # text_test.ts:122
+    d = am.from_dict({"dom": [[am.Text("world")]]}, actor=A1)
+    d = am.change(d, lambda x: am.splice(x, ["dom", 0, 0], 0, 0, "Hello "))
+    assert d.to_py()["dom"][0][0] == "Hello world"
