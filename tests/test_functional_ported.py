@@ -2,7 +2,8 @@
 
 Each test is a behavioral port of a named case from the reference's
 wrapper suites (reference: javascript/test/legacy_tests.ts,
-change_at.ts, patches.ts, text_test.ts — file:line cited per test),
+change_at.ts, patches.ts, text_test.ts, marks.ts, error.ts —
+file:line cited per test),
 driven through
 automerge_tpu.functional's immutable-doc idiom: change() returns new
 values, merge() consumes the local input, conflicts read through
@@ -479,3 +480,60 @@ def test_splice_into_text_nested_in_arrays():
     d = am.from_dict({"dom": [[am.Text("world")]]}, actor=A1)
     d = am.change(d, lambda x: am.splice(x, ["dom", 0, 0], 0, 0, "Hello "))
     assert d.to_py()["dom"][0][0] == "Hello world"
+
+
+# -- mark / error scenarios (reference: javascript/test/marks.ts, error.ts) ---
+
+
+def test_partial_unmark_splits_spans_and_survives_save_load():
+    # marks.ts:7 — unmark of a middle range splits the span; a loaded copy
+    # reports the same spans
+    d = am.from_dict(
+        {"x": am.Text("the quick fox jumps over the lazy dog")}, actor=A1
+    )
+    d = am.change(d, lambda x: am.mark(
+        x, ["x"], {"start": 5, "end": 10, "expand": "none"},
+        "font-weight", "bold",
+    ))
+    d = am.change(d, lambda x: am.unmark(
+        x, ["x"], {"start": 7, "end": 9, "expand": "none"}, "font-weight",
+    ))
+    spans = [(m.name, m.value, m.start, m.end) for m in am.marks(d, "x")]
+    assert spans == [
+        ("font-weight", "bold", 5, 7),
+        ("font-weight", "bold", 9, 10),
+    ]
+    d2 = am.load_incremental(am.init(actor=A2), am.save(d))
+    spans2 = [(m.name, m.value, m.start, m.end) for m in am.marks(d2, "x")]
+    assert spans2 == spans
+
+
+def test_marks_track_splices_sensibly():
+    # marks.ts:74 — a mark shifts under a preceding splice and a full
+    # unmark clears it (indices adapted to this API's default codepoint
+    # units: each emoji is ONE index unit here, vs the JS wrapper's two)
+    d = am.from_dict({"content": am.Text("\U0001F600\U0001F600")}, actor=A1)
+
+    def edit(x):
+        am.mark(x, ["content"], {"start": 1, "end": 2, "expand": "none"},
+                "bold", True)
+        am.splice(x, ["content"], 0, 0, "\U0001F643")
+
+    d = am.change(d, edit)
+    spans = [(m.name, m.value, m.start, m.end) for m in am.marks(d, "content")]
+    assert spans == [("bold", True, 2, 3)]
+    d = am.change(d, lambda x: am.unmark(
+        x, ["content"], {"start": 2, "end": 3, "expand": "none"}, "bold",
+    ))
+    assert am.marks(d, "content") == []
+
+
+def test_errors_are_exceptions_not_strings():
+    # error.ts:5,19 — misuse raises TYPED exceptions, not strings
+    from automerge_tpu.errors import AutomergeError
+
+    with pytest.raises(TypeError):
+        am.from_dict({"x": object()}, actor=A1)  # unsupported datatype
+    d = am.from_dict({"l": [1]}, actor=A1)
+    with pytest.raises(AutomergeError):
+        am.change(d, lambda x: x["l"].__setitem__(9, "out of range"))
