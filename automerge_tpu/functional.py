@@ -502,6 +502,40 @@ class ListProxy:
         del self[i]
         return v
 
+    def entries(self):
+        """(index, value) pairs, like the JS list proxy's entries()
+        (reference: proxies.ts listMethods entries)."""
+        return enumerate(self)
+
+    def values(self):
+        return iter(self)
+
+    def keys(self):
+        return iter(range(len(self)))
+
+    def splice(self, start: int, delete_count: int = None, *items):
+        """JS Array.splice semantics (reference: proxies.ts list splice
+        tests): remove ``delete_count`` entries at ``start`` (to the end
+        when omitted), insert ``items`` there, return the removed values
+        as plain python values."""
+        n = len(self)
+        start = max(0, min(start + n if start < 0 else start, n))
+        if delete_count is None:
+            delete_count = n - start
+        delete_count = max(0, min(delete_count, n - start))
+        removed = [
+            v.to_py() if hasattr(v, "to_py") else v
+            for v in (self[start + k] for k in range(delete_count))
+        ]
+        # one ranged primitive for the deletions (api.AutoDoc.splice),
+        # then the shared tree writer per inserted item so containers
+        # still become CRDT objects
+        if delete_count:
+            self._auto.splice(self._obj, start, delete_count, [])
+        for off, v in enumerate(items):
+            self.insert(start + off, v)
+        return removed
+
     def increment(self, i: int, by: int = 1):
         self._auto.increment(self._obj, self._norm(i), by)
 

@@ -2,7 +2,8 @@
 
 Each test is a behavioral port of a named case from the reference's
 wrapper suites (reference: javascript/test/legacy_tests.ts,
-change_at.ts, patches.ts, text_test.ts, marks.ts, error.ts —
+change_at.ts, patches.ts, text_test.ts, marks.ts, error.ts,
+proxies.ts —
 file:line cited per test),
 driven through
 automerge_tpu.functional's immutable-doc idiom: change() returns new
@@ -537,3 +538,58 @@ def test_errors_are_exceptions_not_strings():
     d = am.from_dict({"l": [1]}, actor=A1)
     with pytest.raises(AutomergeError):
         am.change(d, lambda x: x["l"].__setitem__(9, "out of range"))
+
+
+# -- list proxy scenarios (reference: javascript/test/proxies.ts) -------------
+
+
+def test_list_proxy_iteration_entries_values_keys():
+    # proxies.ts:16,29,41
+    d = am.from_dict({"list": ["a", "b", "c"]}, actor=A1)
+
+    def edit(x):
+        lst = x["list"]
+        seen = [(i, v) for i, v in lst.entries()]
+        assert seen == [(0, "a"), (1, "b"), (2, "c")]
+        assert list(lst.values()) == ["a", "b", "c"]
+        assert list(lst.keys()) == [0, 1, 2]
+
+    am.change(d, edit)
+
+
+def test_list_proxy_splice_removes_and_returns_deleted():
+    # proxies.ts:55
+    d = am.from_dict({"list": ["a", "b", "c"]}, actor=A1)
+
+    def edit(x):
+        assert x["list"].splice(1, 1) == ["b"]
+
+    d = am.change(d, edit)
+    assert d.to_py()["list"] == ["a", "c"]
+
+
+def test_list_proxy_splice_replaces_and_inserts():
+    # proxies.ts:64,73
+    d = am.from_dict({"list": ["a", "b", "c"]}, actor=A1)
+
+    def edit(x):
+        assert x["list"].splice(1, 1, "d", "e") == ["b"]
+
+    d = am.change(d, edit)
+    assert d.to_py()["list"] == ["a", "d", "e", "c"]
+    def edit2(x):
+        assert x["list"].splice(1, 0, "z") == []
+
+    d = am.change(d, edit2)
+    assert d.to_py()["list"] == ["a", "z", "d", "e", "c"]
+
+
+def test_list_proxy_splice_start_only_truncates():
+    # proxies.ts:82
+    d = am.from_dict({"list": ["a", "b", "c"]}, actor=A1)
+
+    def edit(x):
+        assert x["list"].splice(1) == ["b", "c"]
+
+    d = am.change(d, edit)
+    assert d.to_py()["list"] == ["a"]
