@@ -3,7 +3,7 @@
 Each test is a behavioral port of a named case from the reference's
 wrapper suites (reference: javascript/test/legacy_tests.ts,
 change_at.ts, patches.ts, text_test.ts, marks.ts, error.ts,
-proxies.ts —
+proxies.ts, extra_api_tests.ts, new-change-api.ts —
 file:line cited per test),
 driven through
 automerge_tpu.functional's immutable-doc idiom: change() returns new
@@ -593,3 +593,37 @@ def test_list_proxy_splice_start_only_truncates():
 
     d = am.change(d, edit)
     assert d.to_py()["list"] == ["a"]
+
+
+def test_incremental_load_chain_tracks_every_change():
+    # extra_api_tests.ts:6 — a replica fed only incremental saves after
+    # each change converges with the source
+    d1 = am.from_dict({"foo": "bar"}, actor=A1)
+    d2 = am.load_incremental(am.init(actor=A2), am.save(d1))
+    for edit in (
+        lambda x: x.update({"foo2": "bar2"}),
+        lambda x: x.update({"foo": "bar2"}),
+        lambda x: x.update({"x": "y"}),
+    ):
+        d1 = am.change(d1, edit)
+        d2 = am.load_incremental(d2, am.save_incremental(d1))
+    assert am.equals(d1, d2)
+    assert am.get_heads(d1) == am.get_heads(d2)
+
+
+def test_new_change_api_basics():
+    # new-change-api.ts:6,18,26
+    d = am.from_dict({"foo": "bar"}, actor=A1)
+
+    def edit(x):
+        assert x["foo"] == "bar"
+        x.update({"foo": "baz"})
+
+    d = am.change(d, edit)
+    assert d.to_py() == {"foo": "baz"}
+    d = am.from_dict({"list": []}, actor=A2)
+    d = am.change(d, lambda x: am.insert_at(x["list"], 0, "a"))
+    assert d.to_py()["list"] == ["a"]
+    d = am.from_dict({"list": ["a", "b", "c"]}, actor=A3)
+    d = am.change(d, lambda x: am.delete_at(x["list"], 0))
+    assert d.to_py()["list"] == ["b", "c"]
