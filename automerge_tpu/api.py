@@ -438,19 +438,31 @@ class AutoDoc:
         on_partial: str = "error",
         string_migration: str = "none",
         text_encoding: Optional[str] = None,
+        on_error: Optional[str] = None,
     ) -> "AutoDoc":
         return cls(
             document=Document.load(
                 data, actor, verify,
                 on_partial=on_partial, string_migration=string_migration,
-                text_encoding=text_encoding,
+                text_encoding=text_encoding, on_error=on_error,
             )
         )
 
     def load_incremental(
-        self, data: bytes, verify: bool = True, on_partial: str = "ignore"
+        self,
+        data: bytes,
+        verify: bool = True,
+        on_partial: str = "ignore",
+        on_error: Optional[str] = None,
     ) -> int:
         self.commit()
-        applied = self.doc.load_incremental(data, verify, on_partial=on_partial)
+        applied = self.doc.load_incremental(
+            data, verify, on_partial=on_partial, on_error=on_error
+        )
         self._notify_patches()
         return applied
+
+    @property
+    def salvage_report(self):
+        """The report left by the last ``on_error="salvage"`` load, or None."""
+        return self.doc.salvage_report

@@ -41,8 +41,26 @@ def _write(path: Optional[str], data: bytes) -> None:
             f.write(data)
 
 
+def _load_doc(args) -> AutoDoc:
+    doc = AutoDoc.load(
+        _read(args.input),
+        verify=not args.skip_verifying_heads,
+        on_error="salvage" if getattr(args, "salvage", False) else None,
+    )
+    rep = doc.salvage_report
+    if rep is not None and rep.dropped:
+        print(f"salvage: {rep.summary()}", file=sys.stderr)
+        for d in rep.dropped:
+            print(
+                f"salvage: dropped span at {d.offset}: {d.reason}"
+                + (f" (checksum {d.checksum.hex()})" if d.checksum else ""),
+                file=sys.stderr,
+            )
+    return doc
+
+
 def cmd_export(args) -> int:
-    doc = AutoDoc.load(_read(args.input), verify=not args.skip_verifying_heads)
+    doc = _load_doc(args)
     out = json.dumps(doc.hydrate(), indent=2, ensure_ascii=False)
     _write(args.out, (out + "\n").encode())
     return 0
@@ -79,7 +97,7 @@ def cmd_merge(args) -> int:
 
 
 def cmd_examine(args) -> int:
-    doc = AutoDoc.load(_read(args.input), verify=not args.skip_verifying_heads)
+    doc = _load_doc(args)
     changes = [expand_change(a.stored) for a in doc.doc.history]
     _write(args.out, (json.dumps(changes, indent=2) + "\n").encode())
     return 0
@@ -87,8 +105,18 @@ def cmd_examine(args) -> int:
 
 def cmd_examine_sync(args) -> int:
     from .sync import Message
+    from .sync.session import SESSION_FRAME_TYPE, decode_frame
 
-    msg = Message.decode(_read(args.input))
+    data = _read(args.input)
+    frame = None
+    if data[:1] == bytes([SESSION_FRAME_TYPE]):
+        epoch, flags, seq, inner = decode_frame(data)
+        frame = {"epoch": epoch, "flags": flags, "seq": seq}
+        data = inner
+    if frame is not None and not data:
+        _write(args.out, (json.dumps({"frame": frame}, indent=2) + "\n").encode())
+        return 0
+    msg = Message.decode(data)
     out = {
         "heads": [h.hex() for h in msg.heads],
         "need": [h.hex() for h in msg.need],
@@ -101,6 +129,8 @@ def cmd_examine_sync(args) -> int:
         ],
         "changes": [expand_change(c) for c in msg.changes],
     }
+    if frame is not None:
+        out = {"frame": frame, "message": out}
     _write(args.out, (json.dumps(out, indent=2) + "\n").encode())
     return 0
 
@@ -197,6 +227,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp = add("export", cmd_export, help="document -> JSON")
     sp.add_argument("input", nargs="?", help="input .automerge file (default stdin)")
     sp.add_argument("--skip-verifying-heads", action="store_true")
+    sp.add_argument("--salvage", action="store_true",
+                    help="recover what a damaged save still holds "
+                         "(dropped spans are reported on stderr)")
 
     sp = add("import", cmd_import, help="JSON -> document")
     sp.add_argument("input", nargs="?", help="input JSON file (default stdin)")
@@ -207,6 +240,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp = add("examine", cmd_examine, help="dump a document's changes as JSON")
     sp.add_argument("input", nargs="?", help="input .automerge file (default stdin)")
     sp.add_argument("--skip-verifying-heads", action="store_true")
+    sp.add_argument("--salvage", action="store_true",
+                    help="recover what a damaged save still holds "
+                         "(dropped spans are reported on stderr)")
 
     sp = add("examine-sync", cmd_examine_sync, help="decode a sync message")
     sp.add_argument("input", nargs="?", help="input sync message file (default stdin)")

@@ -116,6 +116,8 @@ class Document:
         self.deps: Set[bytes] = set()
         self.change_graph = ChangeGraph()
         self.max_op = 0
+        # set by the last salvage load (on_error="salvage"), else None
+        self.salvage_report = None
         # exid-string -> OpId memo: actor interning is append-only, so a
         # resolved id never changes (misses are NOT cached)
         self._exid_cache: Dict[str, OpId] = {}
@@ -1206,6 +1208,7 @@ class Document:
         on_partial: str = "error",
         string_migration: str = "none",
         text_encoding: Optional[str] = None,
+        on_error: Optional[str] = None,
     ) -> "Document":
         """Strict by default: any malformed chunk rejects the whole load
         (the reference's LoadOptions defaults to OnPartialLoad::Error for
@@ -1214,9 +1217,18 @@ class Document:
         rewrites scalar strings into TEXT objects after loading
         (StringMigration, automerge.rs:1567-1610). ``text_encoding`` fixes
         the loaded document's text index unit (LoadOptions analogue of the
-        reference's per-build TextValue width)."""
+        reference's per-build TextValue width).
+
+        ``on_error`` is an alias for ``on_partial`` that also admits
+        ``"salvage"``: skip checksum-invalid or truncated chunks, resume at
+        the next magic marker, apply every chunk that still verifies, and
+        leave a ``SalvageReport`` of what was dropped on
+        ``doc.salvage_report``.
+        """
         from .. import trace
 
+        if on_error is not None:
+            on_partial = on_error
         doc = cls(actor, text_encoding=text_encoding)
         with trace.span("load", bytes=len(data)):
             doc.load_incremental(data, verify=verify, on_partial=on_partial)
@@ -1227,15 +1239,26 @@ class Document:
         return doc
 
     def load_incremental(
-        self, data: bytes, verify: bool = True, on_partial: str = "ignore"
+        self,
+        data: bytes,
+        verify: bool = True,
+        on_partial: str = "ignore",
+        on_error: Optional[str] = None,
     ) -> int:
         """Apply every chunk in ``data``; returns the number applied.
 
         A malformed tail stops the scan: with ``on_partial="ignore"`` (the
         default, matching the reference's incremental load tolerating
         trailing garbage — automerge.rs:730-769, OnPartialLoad::Ignore
-        automerge.rs:41-47) the valid prefix is kept; "error" re-raises.
+        automerge.rs:41-47) the valid prefix is kept; "error" re-raises;
+        "salvage" skips corrupt spans and keeps going (see ``load``).
         """
+        if on_error is not None:
+            on_partial = on_error
+        if on_partial == "salvage":
+            return self._load_salvage(data, verify)
+        if on_partial not in ("ignore", "error"):
+            raise ValueError(f"unknown on_partial {on_partial!r}")
         pos = 0
         applied = 0
         while pos < len(data):
@@ -1257,6 +1280,52 @@ class Document:
                 break
             self.apply_changes(changes)
             applied += 1
+        return applied
+
+    def _load_salvage(self, data: bytes, verify: bool) -> int:
+        """Degrade-gracefully load: apply every verifiable chunk, record
+        every dropped span in ``self.salvage_report``, never raise on
+        corrupt input."""
+        from .. import trace
+        from ..storage.change import parse_change_data
+        from ..storage.chunk import write_chunk
+        from ..storage.document import (
+            DroppedChunk,
+            parse_document_chunk,
+            salvage_scan,
+        )
+
+        chunks, report = salvage_scan(data)
+        applied = 0
+        for chunk in chunks:
+            try:
+                if chunk.chunk_type == CHUNK_DOCUMENT:
+                    changes = _reconstruct(parse_document_chunk(chunk), verify)
+                else:
+                    # scan_chunks already verified framing + checksum; just
+                    # rebuild canonical raw bytes (hash identity + future
+                    # sync need them) and parse the body
+                    raw = write_chunk(chunk.chunk_type, chunk.data)
+                    changes = [parse_change_data(chunk.data, chunk.hash, raw)]
+                self.apply_changes(changes)
+                applied += 1
+            except Exception as e:
+                # framing verified but the body (or its application) did
+                # not: drop this chunk too, with its real identity
+                report.dropped.append(
+                    DroppedChunk(
+                        offset=chunk.offset,
+                        end=-1,  # body-level rejection: span end not tracked
+                        reason=f"chunk body rejected: {e}",
+                        checksum=chunk.checksum,
+                        computed_hash=chunk.hash,
+                    )
+                )
+        report.applied_chunks = applied
+        self.salvage_report = report
+        trace.count("load.salvaged_chunks", n=applied)
+        if report.dropped:
+            trace.count("load.dropped_chunks", n=len(report.dropped))
         return applied
 
 

@@ -22,6 +22,14 @@ object creation returns {"$obj": "<exid>", "type": "map|list|text"}.
 
 Run: ``python -m automerge_tpu.rpc`` (see tests/test_rpc.py for a full
 two-peer session driven from a separate process).
+
+Robustness: every malformed frame (bad JSON, unknown method, oversized
+request, undecodable base64) answers with an ``error`` response; EOF —
+even mid-request — is a clean shutdown. ``configure`` sets
+``maxRequestBytes`` and ``syncTimeoutMs``; the ``syncSession*`` methods
+expose the resilient retry/backoff/reset sync sessions (sync/session.py)
+for lossy client links, and ``load`` accepts ``onError: "salvage"`` to
+recover damaged saves (the response then carries a ``salvage`` report).
 """
 
 from __future__ import annotations
@@ -29,11 +37,20 @@ from __future__ import annotations
 import base64
 import json
 import sys
+import time
 from typing import Dict, Optional
 
 from .api import AutoDoc
-from .sync import SyncState
+from .sync import SessionConfig, SyncSession, SyncState
 from .types import ActorId, ObjType, ScalarValue
+
+# default per-request line limit: large enough for multi-megabyte base64
+# saves, small enough that a hostile or broken client cannot buffer-bomb
+# the process — serve() reads each line with a bounded readline(limit), so
+# an endless newline-free stream is discarded in bounded chunks instead of
+# being buffered whole (configurable via the ``configure`` method)
+DEFAULT_MAX_REQUEST_BYTES = 32 << 20
+DEFAULT_SYNC_TIMEOUT_MS = 5000
 
 _OBJTYPES = {"map": ObjType.MAP, "list": ObjType.LIST, "text": ObjType.TEXT,
              "table": ObjType.TABLE}
@@ -95,11 +112,18 @@ def _from_rendered(rendered, exid, doc) -> object:
 class RpcServer:
     """One frontend session: documents + sync states by integer handle."""
 
-    def __init__(self):
+    def __init__(
+        self,
+        max_request_bytes: int = DEFAULT_MAX_REQUEST_BYTES,
+        sync_timeout_ms: int = DEFAULT_SYNC_TIMEOUT_MS,
+    ):
         self._docs: Dict[int, AutoDoc] = {}
         self._syncs: Dict[int, SyncState] = {}
+        self._sessions: Dict[int, SyncSession] = {}
         self._patched = set()  # docs with an activated patch cursor
         self._next = 1
+        self.max_request_bytes = max_request_bytes
+        self.sync_timeout_ms = sync_timeout_ms
 
     # -- handle plumbing ----------------------------------------------------
 
@@ -131,9 +155,38 @@ class RpcServer:
 
     def load(self, p):
         doc = AutoDoc.load(
-            _unb64(p["data"]), text_encoding=p.get("textEncoding")
+            _unb64(p["data"]),
+            text_encoding=p.get("textEncoding"),
+            on_error=p.get("onError"),
         )
-        return {"doc": self._reg(self._docs, doc)}
+        out = {"doc": self._reg(self._docs, doc)}
+        rep = doc.salvage_report
+        if rep is not None:
+            out["salvage"] = {
+                "appliedChunks": rep.applied_chunks,
+                "dropped": [
+                    {"offset": d.offset, "reason": d.reason,
+                     "checksum": _b64(d.checksum)}
+                    for d in rep.dropped
+                ],
+            }
+        return out
+
+    def configure(self, p):
+        """Runtime knobs: syncTimeoutMs (resilient sync sessions' base
+        retransmit timeout), maxRequestBytes (per-line request limit)."""
+        if "syncTimeoutMs" in p:
+            v = int(p["syncTimeoutMs"])
+            if v <= 0:
+                raise ValueError("syncTimeoutMs must be positive")
+            self.sync_timeout_ms = v
+        if "maxRequestBytes" in p:
+            v = int(p["maxRequestBytes"])
+            if v <= 0:
+                raise ValueError("maxRequestBytes must be positive")
+            self.max_request_bytes = v
+        return {"syncTimeoutMs": self.sync_timeout_ms,
+                "maxRequestBytes": self.max_request_bytes}
 
     def free(self, p):
         self._docs.pop(p["doc"], None)
@@ -317,6 +370,66 @@ class RpcServer:
         )
         return None
 
+    # resilient sync sessions (retry/backoff/reset over lossy transports;
+    # see sync/session.py). The base retransmit timeout is the server's
+    # syncTimeoutMs (``configure``), overridable per session.
+    def _session_config(self, p) -> SessionConfig:
+        timeout_ms = int(p.get("timeoutMs", self.sync_timeout_ms))
+        if timeout_ms <= 0:
+            raise ValueError("timeoutMs must be positive")
+        timeout_s = timeout_ms / 1000.0
+        return SessionConfig(
+            timeout=timeout_s,
+            max_timeout=timeout_s * 16,
+            seed=int(p.get("seed", 0)),
+        )
+
+    def syncSessionNew(self, p):
+        sess = SyncSession(
+            self._doc(p),
+            config=self._session_config(p),
+            epoch=int(p.get("epoch", 1)),
+        )
+        return {"session": self._reg(self._sessions, sess)}
+
+    def syncSessionRestore(self, p):
+        """Rebuild a session from persisted bytes after a restart; pass an
+        epoch different from the pre-restart one."""
+        sess = SyncSession.restore(
+            self._doc(p),
+            _unb64(p["data"]),
+            epoch=int(p["epoch"]),
+            config=self._session_config(p),
+        )
+        return {"session": self._reg(self._sessions, sess)}
+
+    def _session(self, p) -> SyncSession:
+        sess = self._sessions.get(p.get("session"))
+        if sess is None:
+            raise ValueError(f"invalid session handle {p.get('session')}")
+        return sess
+
+    def syncSessionPoll(self, p):
+        frame = self._session(p).poll(time.monotonic())
+        return None if frame is None else _b64(frame)
+
+    def syncSessionReceive(self, p):
+        """Feed wire bytes; corrupt or duplicate frames are absorbed (and
+        counted), never raised."""
+        accepted = self._session(p).receive(_unb64(p["data"]), time.monotonic())
+        return {"accepted": accepted}
+
+    def syncSessionStats(self, p):
+        sess = self._session(p)
+        return dict(sess.stats, converged=sess.converged(), epoch=sess.epoch)
+
+    def syncSessionEncode(self, p):
+        return _b64(self._session(p).encode())
+
+    def syncSessionFree(self, p):
+        self._sessions.pop(p.get("session"), None)
+        return None
+
     # -- dispatch -----------------------------------------------------------
 
     # explicit allowlist: getattr dispatch must never reach serve/handle or
@@ -330,6 +443,10 @@ class RpcServer:
         "getCursor", "getCursorPosition", "materialize", "popPatches",
         "syncStateNew", "syncStateFree", "syncStateEncode",
         "syncStateDecode", "generateSyncMessage", "receiveSyncMessage",
+        "configure",
+        "syncSessionNew", "syncSessionRestore", "syncSessionPoll",
+        "syncSessionReceive", "syncSessionStats", "syncSessionEncode",
+        "syncSessionFree",
     })
 
     def handle(self, req: dict) -> dict:
@@ -366,33 +483,81 @@ class RpcServer:
                 "error": {"type": "EncodeError", "message": str(e)},
             })
 
+    def _handle_line(self, line: str) -> tuple[Optional[dict], bool]:
+        """One request line -> (response dict or None, stop flag).
+        Total error isolation: any malformed frame becomes an ``error``
+        response; nothing a client sends can raise out of here."""
+        line = line.strip()
+        if not line:
+            return None, False
+        # measure encoded BYTES, not characters: a non-ASCII payload can be
+        # 4x its character count (the ascii fast path avoids re-encoding)
+        nbytes = (
+            len(line) if line.isascii()
+            else len(line.encode("utf-8", errors="surrogatepass"))
+        )
+        if nbytes > self.max_request_bytes:
+            return {"id": None, "error": {
+                "type": "RequestTooLarge",
+                "message": f"request of {nbytes} bytes exceeds limit "
+                           f"of {self.max_request_bytes}"}}, False
+        try:
+            req = json.loads(line)
+        except json.JSONDecodeError as e:
+            return {"id": None,
+                    "error": {"type": "ParseError", "message": str(e)}}, False
+        if not isinstance(req, dict):
+            return {"id": None, "error": {
+                "type": "ParseError",
+                "message": "request must be a JSON object"}}, False
+        if req.get("method") == "shutdown":
+            return {"id": req.get("id"), "result": None}, True
+        try:
+            return self.handle(req), False
+        except Exception as e:  # belt and braces: handle() already catches
+            return {"id": None,
+                    "error": {"type": type(e).__name__,
+                              "message": str(e)}}, False
+
     def serve(self, stdin=None, stdout=None) -> None:
         stdin = stdin or sys.stdin
         stdout = stdout or sys.stdout
-        for line in stdin:
-            line = line.strip()
-            if not line:
-                continue
+        raw_readline = getattr(stdin, "readline", None)
+        if raw_readline is None:  # plain iterables of lines work too
+            it = iter(stdin)
+            readline = lambda: next(it, "")  # noqa: E731
+        else:
+            def readline():
+                # bounded read: a request longer than the limit is never
+                # buffered whole — the tail is drained (and discarded) in
+                # limit-sized chunks until its newline, then rejected.
+                # readline(limit) counts characters, so the true buffer
+                # bound is limit..4*limit bytes; _handle_line then enforces
+                # the byte-exact limit on what survives
+                limit = self.max_request_bytes + 1
+                line = raw_readline(limit)
+                if len(line) >= limit and not line.endswith("\n"):
+                    while True:
+                        tail = raw_readline(limit)
+                        if not tail or tail.endswith("\n"):
+                            break
+                return line
+        while True:
             try:
-                req = json.loads(line)
-            except json.JSONDecodeError as e:
-                req = None
-                resp = {"id": None,
-                        "error": {"type": "ParseError", "message": str(e)}}
-            else:
-                if not isinstance(req, dict):
-                    resp = {"id": None, "error": {
-                        "type": "ParseError",
-                        "message": "request must be a JSON object"}}
-                elif req.get("method") == "shutdown":
-                    stdout.write(self._encode_response(
-                        {"id": req.get("id"), "result": None}) + "\n")
+                line = readline()
+            except Exception:
+                return  # broken pipe / undecodable stream: clean shutdown
+            if not line:  # EOF (including mid-request cut-offs)
+                return
+            resp, stop = self._handle_line(line)
+            if resp is not None:
+                try:
+                    stdout.write(self._encode_response(resp) + "\n")
                     stdout.flush()
-                    return
-                else:
-                    resp = self.handle(req)
-            stdout.write(self._encode_response(resp) + "\n")
-            stdout.flush()
+                except Exception:
+                    return  # client went away mid-response: clean shutdown
+            if stop:
+                return
 
 
 def main() -> int:

@@ -41,7 +41,10 @@ from . import columns as C
 from .chunk import (
     CHUNK_DOCUMENT,
     DEFLATE_MIN_SIZE,
+    DroppedRegion,
+    RawChunk,
     parse_chunk,
+    scan_chunks,
     write_chunk,
 )
 from .change import HEAD_STORED, ROOT_STORED
@@ -466,6 +469,10 @@ def parse_document(buf: bytes, pos: int = 0) -> tuple[ParsedDocument, int]:
         raise ValueError(f"expected document chunk, got type {chunk.chunk_type}")
     if not chunk.checksum_valid:
         raise ValueError("document chunk checksum mismatch")
+    return (_parse_document_body(chunk), end)
+
+
+def _parse_document_body(chunk: "RawChunk") -> ParsedDocument:
     data = chunk.data
     p = 0
     nactors, p = decode_uleb(data, p)
@@ -525,7 +532,76 @@ def parse_document(buf: bytes, pos: int = 0) -> tuple[ParsedDocument, int]:
             pass  # irregular shape: the python decoder is the authority
     if not validated:
         parsed.ops  # noqa: B018 — decode + per-op bounds checks, may raise
-    return (parsed, end)
+    return parsed
+
+
+# ---------------------------------------------------------------------------
+# salvage loading: recover the valid chunks from a damaged save
+
+
+@dataclass
+class DroppedChunk:
+    """One unrecoverable byte span in a damaged save."""
+
+    offset: int
+    end: int
+    reason: str
+    checksum: bytes  # stored 4-byte checksum (the original hash prefix), or b""
+    computed_hash: bytes  # hash of the bytes as found (b"" if unparseable)
+
+
+@dataclass
+class SalvageReport:
+    """What a salvage load kept and what it had to drop."""
+
+    scanned_bytes: int = 0
+    applied_chunks: int = 0
+    dropped: List[DroppedChunk] = field(default_factory=list)
+
+    @property
+    def dropped_checksums(self) -> List[bytes]:
+        """The stored checksums of dropped chunks — each is the first 4
+        bytes of the original (pre-corruption) chunk hash, so callers can
+        name exactly which changes were lost."""
+        return [d.checksum for d in self.dropped if d.checksum]
+
+    def summary(self) -> str:
+        return (
+            f"salvaged {self.applied_chunks} chunk(s), "
+            f"dropped {len(self.dropped)} span(s) over {self.scanned_bytes} bytes"
+        )
+
+
+def salvage_scan(buf: bytes) -> tuple[List[RawChunk], SalvageReport]:
+    """Split a (possibly damaged) save into verifiable chunks + a report.
+
+    Checksum-invalid and unparseable spans become ``DroppedChunk`` records;
+    the scan resynchronises on the next ``MAGIC_BYTES`` occurrence (see
+    ``scan_chunks``). ``applied_chunks`` is left 0 — the loader fills it in
+    after it knows how many chunks actually applied.
+    """
+    report = SalvageReport(scanned_bytes=len(buf))
+    chunks: List[RawChunk] = []
+    for item in scan_chunks(buf):
+        if isinstance(item, DroppedRegion):
+            report.dropped.append(
+                DroppedChunk(
+                    offset=item.offset,
+                    end=item.end,
+                    reason=item.reason,
+                    checksum=item.checksum,
+                    computed_hash=item.hash,
+                )
+            )
+        else:
+            chunks.append(item)
+    return chunks, report
+
+
+def parse_document_chunk(chunk: RawChunk) -> ParsedDocument:
+    """Parse an already-framed-and-verified document chunk (the body of
+    ``parse_document``, reusable from the salvage path)."""
+    return _parse_document_body(chunk)
 
 
 def _check_doc_actor_bounds(op: DocOp, i: int, n_actors: int) -> None:

@@ -41,6 +41,25 @@ def event(name: str, **fields) -> None:
         logger.debug("%s %s", name, body)
 
 
+# -- counters ---------------------------------------------------------------
+# Degradation observability (sync.retry, sync.reset, load.salvaged_chunks,
+# ...): recovery paths are rare, so these always accumulate — one dict
+# increment — and additionally emit an ``event`` line when tracing is on.
+
+counters: dict = {}
+
+
+def count(name: str, n: int = 1, **fields) -> None:
+    """Increment the named counter and trace it (``name n=… k=v``)."""
+    counters[name] = counters.get(name, 0) + n
+    if logger.isEnabledFor(_DEBUG):
+        event(name, n=n, total=counters[name], **fields)
+
+
+def reset_counters() -> None:
+    counters.clear()
+
+
 class span:
     """``with span("load", bytes=n):`` — logs entry/exit with wall time."""
 

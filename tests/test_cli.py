@@ -107,3 +107,47 @@ def test_module_invocation(doc_file):
     )
     assert r.returncode == 0
     assert json.loads(r.stdout) == {"title": "hello cli", "count": 3}
+
+
+def test_export_salvage_recovers_damaged_save(tmp_path, capsys):
+    """A save with a corrupted trailing chunk exports what survives when
+    --salvage is given (and reports the dropped span on stderr)."""
+    d = AutoDoc(actor=actor(1))
+    d.put("_root", "keep", 1)
+    d.commit()
+    good = d.save_incremental_after([])
+    d.put("_root", "lost", 2)
+    d.commit()
+    full = d.save_incremental_after([])
+    bad = bytearray(full)
+    bad[len(good) + 14] ^= 0xFF  # corrupt the second change chunk
+    p = tmp_path / "damaged.automerge"
+    p.write_bytes(bytes(bad))
+
+    # strict export fails cleanly
+    with pytest.raises(Exception):
+        main(["export", str(p)])
+
+    out = tmp_path / "salvaged.json"
+    assert main(["export", str(p), "--salvage", "-o", str(out)]) == 0
+    assert json.loads(out.read_text()) == {"keep": 1}
+    err = capsys.readouterr().err
+    assert "dropped span" in err
+
+
+def test_examine_sync_session_frame(tmp_path):
+    """examine-sync understands session frames (0x45 envelope) as well as
+    bare protocol messages."""
+    from automerge_tpu.sync import SyncSession
+
+    d = AutoDoc(actor=actor(1))
+    d.put("_root", "x", 1)
+    d.commit()
+    frame = SyncSession(d, epoch=5).poll(0)
+    p = tmp_path / "frame.sync"
+    p.write_bytes(frame)
+    out = tmp_path / "frame.json"
+    assert main(["examine-sync", str(p), "-o", str(out)]) == 0
+    parsed = json.loads(out.read_text())
+    assert parsed["frame"]["epoch"] == 5
+    assert parsed["message"]["heads"]

@@ -194,3 +194,163 @@ def test_pop_patches_preserves_open_transaction():
     assert doc.doc.history[-1].stored.message == "my edit"
     patches = call(srv, "popPatches", doc=d)
     assert any(p["action"] == "PutMap" and p.get("key") == "x" for p in patches)
+
+
+# -- server hostility: malformed frames must never kill the process ----------
+
+def test_hostile_invalid_json_and_unknown_method_and_missing_id():
+    import io
+
+    srv = RpcServer()
+    lines = [
+        '{"not json',                                   # invalid JSON
+        '{"id": 1, "method": "noSuchMethod"}',          # unknown method
+        '{"method": "heads", "params": {"doc": 1}}',    # missing id
+        '{"id": 2, "method": "load", "params": {"data": "!!!not-base64!!"}}',
+        '{"id": 3, "method": "create", "params": {"actor": "zz"}}',  # bad hex
+        '{"id": 4, "method": "create"}',                # still alive?
+    ]
+    out = io.StringIO()
+    srv.serve(stdin=iter([ln + "\n" for ln in lines]), stdout=out)
+    resps = [json.loads(x) for x in out.getvalue().splitlines()]
+    assert len(resps) == len(lines)
+    assert resps[0]["error"]["type"] == "ParseError"
+    assert resps[1]["error"]["type"] == "UnknownMethod"
+    # a request without an id still answers (id echoes back as null)
+    assert "error" in resps[2] and resps[2]["id"] is None
+    assert "error" in resps[3]
+    assert "error" in resps[4]
+    assert resps[5]["result"]["doc"] == 1  # server state intact throughout
+
+
+def test_hostile_oversized_payload_rejected_without_dying():
+    import io
+
+    srv = RpcServer()
+    lines = [
+        '{"id": 1, "method": "configure", "params": {"maxRequestBytes": 1024}}',
+        json.dumps({"id": 2, "method": "load",
+                    "params": {"data": "A" * 4096}}),   # oversized base64
+        '{"id": 3, "method": "create"}',                # still alive
+    ]
+    out = io.StringIO()
+    srv.serve(stdin=iter([ln + "\n" for ln in lines]), stdout=out)
+    resps = [json.loads(x) for x in out.getvalue().splitlines()]
+    assert resps[0]["result"]["maxRequestBytes"] == 1024
+    assert resps[1]["error"]["type"] == "RequestTooLarge"
+    assert resps[2]["result"]["doc"] == 1
+
+
+def test_configure_rejects_nonsense():
+    srv = RpcServer()
+    resp = srv.handle({"id": 1, "method": "configure",
+                       "params": {"syncTimeoutMs": -5}})
+    assert "error" in resp
+    resp = srv.handle({"id": 2, "method": "configure",
+                       "params": {"maxRequestBytes": "many"}})
+    assert "error" in resp
+    out = call(srv, "configure", syncTimeoutMs=250)
+    assert out["syncTimeoutMs"] == 250
+
+
+@pytest.mark.skipif(os.name != "posix", reason="subprocess stdio test")
+def test_hostile_subprocess_mid_request_eof_clean_shutdown():
+    """Cutting the connection in the middle of a request must end the
+    process cleanly (exit 0), not hang or traceback."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "automerge_tpu.rpc"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    proc.stdin.write('{"id": 1, "method": "create"}\n')
+    proc.stdin.write('{"id": 2, "method": "put", "par')  # cut mid-request
+    proc.stdin.flush()
+    proc.stdin.close()
+    assert proc.wait(timeout=60) == 0
+    lines = proc.stdout.read().splitlines()
+    assert json.loads(lines[0])["result"]["doc"] == 1
+    assert proc.stderr.read() == ""
+
+
+def test_sync_session_rpc_surface():
+    """Two peers over the resilient session RPC: corrupt and duplicated
+    frames are absorbed; the docs still converge."""
+    srv = RpcServer()
+    call(srv, "configure", syncTimeoutMs=100)
+    a = call(srv, "create", actor="01" * 16)["doc"]
+    b = call(srv, "create", actor="02" * 16)["doc"]
+    call(srv, "put", doc=a, obj="_root", prop="from_a", value=1)
+    call(srv, "commit", doc=a)
+    call(srv, "put", doc=b, obj="_root", prop="from_b", value=2)
+    call(srv, "commit", doc=b)
+    sa = call(srv, "syncSessionNew", doc=a, epoch=1)["session"]
+    sb = call(srv, "syncSessionNew", doc=b, epoch=2)["session"]
+
+    import base64 as b64mod
+    for _ in range(30):
+        fa = call(srv, "syncSessionPoll", session=sa)
+        if fa is not None:
+            # a corrupted copy first: must be absorbed, not crash
+            corrupt = bytearray(b64mod.b64decode(fa))
+            corrupt[len(corrupt) // 2] ^= 0xFF
+            r = call(srv, "syncSessionReceive", session=sb,
+                     data=b64mod.b64encode(bytes(corrupt)).decode())
+            assert r["accepted"] is False
+            call(srv, "syncSessionReceive", session=sb, data=fa)
+            call(srv, "syncSessionReceive", session=sb, data=fa)  # duplicate
+        fb = call(srv, "syncSessionPoll", session=sb)
+        if fb is not None:
+            call(srv, "syncSessionReceive", session=sa, data=fb)
+        stats_a = call(srv, "syncSessionStats", session=sa)
+        stats_b = call(srv, "syncSessionStats", session=sb)
+        if stats_a["converged"] and stats_b["converged"]:
+            break
+    assert call(srv, "heads", doc=a) == call(srv, "heads", doc=b)
+    stats_b = call(srv, "syncSessionStats", session=sb)
+    assert stats_b["malformed"] >= 1 and stats_b["dups"] >= 1
+    # persistence across a "restart" with a fresh epoch
+    enc = call(srv, "syncSessionEncode", session=sa)
+    sa2 = call(srv, "syncSessionRestore", doc=a, data=enc, epoch=9)["session"]
+    assert call(srv, "syncSessionStats", session=sa2)["epoch"] == 9
+    call(srv, "syncSessionFree", session=sa)
+    call(srv, "syncSessionFree", session=sb)
+
+
+def test_hostile_newline_free_stream_is_drained_not_buffered():
+    """An oversized request with no newline must be consumed in bounded
+    chunks (readline(limit)) and answered with RequestTooLarge; the server
+    keeps serving afterwards."""
+    import io
+
+    srv = RpcServer(max_request_bytes=128)
+    stream = "Z" * 100_000 + "\n" + '{"id": 1, "method": "create"}\n'
+    out = io.StringIO()
+    srv.serve(stdin=io.StringIO(stream), stdout=out)
+    resps = [json.loads(x) for x in out.getvalue().splitlines()]
+    assert resps[0]["error"]["type"] == "RequestTooLarge"
+    assert resps[1]["result"]["doc"] == 1
+
+
+def test_sync_session_rejects_nonpositive_timeout():
+    srv = RpcServer()
+    d = call(srv, "create")["doc"]
+    resp = srv.handle({"id": 1, "method": "syncSessionNew",
+                       "params": {"doc": d, "timeoutMs": 0}})
+    assert "error" in resp
+
+
+def test_request_limit_counts_bytes_not_characters():
+    """A non-ASCII payload must be measured in encoded bytes: 600 CJK
+    chars ≈ 1800 UTF-8 bytes, over a 1k limit even though len() < 1024."""
+    import io
+
+    srv = RpcServer(max_request_bytes=1024)
+    big = json.dumps({"id": 1, "method": "create",
+                      "params": {"actor": "世" * 600}}, ensure_ascii=False)
+    assert len(big) < 1024 < len(big.encode())
+    out = io.StringIO()
+    srv.serve(stdin=io.StringIO(big + "\n"), stdout=out)
+    resp = json.loads(out.getvalue().splitlines()[0])
+    assert resp["error"]["type"] == "RequestTooLarge"

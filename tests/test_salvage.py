@@ -1,0 +1,250 @@
+"""Salvage loading and hardened chunk parsing.
+
+A pristine save is all-or-nothing under the strict loader; with
+``on_error="salvage"`` a damaged save degrades gracefully: checksum-invalid
+or truncated chunks are skipped, the scan resynchronises at the next
+MAGIC_BYTES occurrence, every chunk that still verifies is applied, and
+``doc.salvage_report`` names exactly what was dropped.
+"""
+
+import pytest
+
+from automerge_tpu import trace
+from automerge_tpu.api import AutoDoc
+from automerge_tpu.storage.chunk import (
+    CHUNK_CHANGE,
+    ChunkParseError,
+    DroppedRegion,
+    MAGIC_BYTES,
+    parse_chunk,
+    scan_chunks,
+    write_chunk,
+)
+from automerge_tpu.storage.document import salvage_scan
+from automerge_tpu.types import ActorId
+
+
+def actor(i):
+    return ActorId(bytes([i]) * 16)
+
+
+def chunk_offsets(buf):
+    offs, pos = [], 0
+    while pos < len(buf):
+        offs.append(pos)
+        _, pos = parse_chunk(buf, pos)
+    return offs
+
+
+def chain_save(n=5):
+    """A save of n concatenated change chunks from one actor (each change
+    depends on the previous one)."""
+    d = AutoDoc(actor=actor(1))
+    for i in range(n):
+        d.put("_root", f"k{i}", i)
+        d.commit()
+    data = d.save_incremental_after([])
+    hashes = [c.hash for c in d.doc.get_changes([])]
+    return data, hashes
+
+
+# -- chunk header parsing (the satellite fixes) ------------------------------
+
+def test_eight_byte_exact_header_is_truncated():
+    """magic+checksum with no type byte must be a clean parse error, not an
+    IndexError from reading buf[pos+8]."""
+    buf = MAGIC_BYTES + b"\x00\x00\x00\x00"
+    assert len(buf) == 8
+    with pytest.raises(ChunkParseError, match="truncated chunk header"):
+        parse_chunk(buf)
+
+
+@pytest.mark.parametrize("size", range(0, 9))
+def test_every_short_header_is_truncated(size):
+    buf = (MAGIC_BYTES + b"\x00\x00\x00\x00\x01")[:size]
+    with pytest.raises(ChunkParseError, match="truncated chunk header"):
+        parse_chunk(buf)
+
+
+def test_uleb_length_overrun_is_chunk_parse_error():
+    """A length field whose continuation bytes run past end-of-input must
+    raise ChunkParseError naming the byte offset — no LEBDecodeError leaks."""
+    buf = MAGIC_BYTES + b"\x00\x00\x00\x00" + bytes([CHUNK_CHANGE]) + b"\x80\x80"
+    with pytest.raises(ChunkParseError, match="at byte 9"):
+        parse_chunk(buf)
+    # and at a non-zero base offset the message names the true position
+    good = write_chunk(CHUNK_CHANGE, b"data")
+    with pytest.raises(ChunkParseError, match=f"at byte {len(good) + 9}"):
+        parse_chunk(good + buf, len(good))
+
+
+# -- tolerant scanning -------------------------------------------------------
+
+def test_scan_chunks_clean_input():
+    data, _ = chain_save(3)
+    items = list(scan_chunks(data))
+    assert len(items) == 3
+    assert not any(isinstance(x, DroppedRegion) for x in items)
+
+
+def test_scan_chunks_resyncs_after_garbage():
+    a = write_chunk(CHUNK_CHANGE, b"alpha")
+    b = write_chunk(CHUNK_CHANGE, b"beta")
+    buf = a + b"\x00garbage\x01" + b
+    items = list(scan_chunks(buf))
+    chunks = [x for x in items if not isinstance(x, DroppedRegion)]
+    drops = [x for x in items if isinstance(x, DroppedRegion)]
+    assert [c.data for c in chunks] == [b"alpha", b"beta"]
+    assert [c.offset for c in chunks] == [0, len(a) + 9]
+    assert len(drops) == 1
+    assert drops[0].offset == len(a)
+    assert drops[0].end == len(a) + 9  # resynced exactly at b's magic
+    # a garbage span has no readable header: no bogus checksum reported
+    assert drops[0].checksum == b""
+
+
+def test_scan_chunks_truncated_tail():
+    data, _ = chain_save(2)
+    cut = data[: len(data) - 3]
+    items = list(scan_chunks(cut))
+    chunks = [x for x in items if not isinstance(x, DroppedRegion)]
+    drops = [x for x in items if isinstance(x, DroppedRegion)]
+    assert len(chunks) == 1
+    assert len(drops) == 1
+    assert drops[0].end == len(cut)
+
+
+def test_salvage_scan_reports_checksum_of_corrupt_chunk():
+    data, hashes = chain_save(3)
+    offs = chunk_offsets(data)
+    bad = bytearray(data)
+    bad[offs[1] + 12] ^= 0xFF  # flip a body byte of chunk 2
+    chunks, report = salvage_scan(bytes(bad))
+    assert len(chunks) == 2
+    assert len(report.dropped) == 1
+    # the stored checksum survives and names the original change hash
+    assert report.dropped[0].checksum == hashes[1][:4]
+    assert report.dropped[0].reason == "checksum mismatch"
+
+
+# -- salvage loading ---------------------------------------------------------
+
+def test_salvage_load_one_corrupt_chunk_keeps_the_rest():
+    """The acceptance case: one corrupted chunk in a save; every other
+    verifiable change loads; the report names exactly the dropped hash."""
+    data, hashes = chain_save(5)
+    offs = chunk_offsets(data)
+    bad = bytearray(data)
+    bad[offs[2] + 15] ^= 0xFF
+    bad = bytes(bad)
+
+    # strict load: all-or-nothing, as before
+    with pytest.raises(Exception):
+        AutoDoc.load(bad)
+
+    d = AutoDoc.load(bad, on_error="salvage")
+    rep = d.salvage_report
+    assert rep is not None
+    assert rep.applied_chunks == 4
+    assert rep.dropped_checksums == [hashes[2][:4]]
+    # changes before the hole are applied; the dependents of the destroyed
+    # change wait in the queue (recoverable via sync once a peer provides it)
+    applied = {c.hash for c in d.doc.get_changes([])}
+    assert applied == {hashes[0], hashes[1]}
+    assert d.doc.get_missing_deps([]) == [hashes[2]]
+    assert d.keys("_root") == ["k0", "k1"]
+
+
+def test_salvage_load_concurrent_branches_lose_only_the_corrupt_one():
+    """With concurrent branches, destroying one branch's chunk must not
+    take the other branch down."""
+    a = AutoDoc(actor=actor(1))
+    a.put("_root", "base", 0)
+    a.commit()
+    b = a.fork(actor=actor(2))
+    a.put("_root", "from_a", 1)
+    a.commit()
+    b.put("_root", "from_b", 2)
+    b.commit()
+    a.merge(b)
+    base, ca, cb = (c for c in a.doc.get_changes([]))
+    data = bytes(base.raw_bytes + ca.raw_bytes + cb.raw_bytes)
+    offs = chunk_offsets(data)
+    bad = bytearray(data)
+    bad[offs[1] + 14] ^= 0x0F  # corrupt a's branch change
+    d = AutoDoc.load(bytes(bad), on_error="salvage")
+    rep = d.salvage_report
+    assert rep.applied_chunks == 2
+    assert rep.dropped_checksums == [ca.hash[:4]]
+    assert d.keys("_root") == ["base", "from_b"]
+
+
+def test_salvage_load_corrupt_document_chunk_keeps_trailing_changes():
+    d0 = AutoDoc(actor=actor(1))
+    d0.put("_root", "x", 1)
+    d0.commit()
+    doc_chunk = d0.save()
+    d0.put("_root", "y", 2)
+    tail_hash = d0.commit()
+    tail = d0.doc.get_change_by_hash(tail_hash).raw_bytes
+    data = doc_chunk + tail
+    bad = bytearray(data)
+    bad[20] ^= 0xFF  # destroy the document chunk body
+    d = AutoDoc.load(bytes(bad), on_error="salvage")
+    rep = d.salvage_report
+    assert rep.applied_chunks == 1
+    assert len(rep.dropped) == 1
+    # the trailing change chunk still parsed; its dep (inside the destroyed
+    # document chunk) is reported missing
+    assert d.doc.get_missing_deps([]) != []
+
+
+def test_salvage_load_pristine_save_reports_nothing_dropped():
+    data, hashes = chain_save(4)
+    d = AutoDoc.load(data, on_error="salvage")
+    rep = d.salvage_report
+    assert rep.applied_chunks == 4
+    assert rep.dropped == []
+    assert {c.hash for c in d.doc.get_changes([])} == set(hashes)
+    assert "salvaged 4 chunk(s)" in rep.summary()
+
+
+def test_salvage_emits_trace_counters():
+    trace.reset_counters()
+    data, _ = chain_save(3)
+    bad = bytearray(data)
+    bad[chunk_offsets(data)[1] + 12] ^= 0xFF
+    AutoDoc.load(bytes(bad), on_error="salvage")
+    assert trace.counters.get("load.salvaged_chunks") == 2
+    assert trace.counters.get("load.dropped_chunks") == 1
+
+
+def test_load_incremental_salvage_alias_and_unknown_mode():
+    data, _ = chain_save(2)
+    d = AutoDoc(actor=actor(9))
+    applied = d.load_incremental(data, on_error="salvage")
+    assert applied == 2
+    assert d.salvage_report is not None
+    with pytest.raises(ValueError, match="unknown on_partial"):
+        AutoDoc(actor=actor(9)).load_incremental(data, on_partial="bogus")
+
+
+def test_rpc_load_salvage_reports_drops():
+    from tests.test_rpc import call
+    from automerge_tpu.rpc import RpcServer
+    import base64
+
+    data, hashes = chain_save(3)
+    bad = bytearray(data)
+    bad[chunk_offsets(data)[1] + 12] ^= 0xFF
+    srv = RpcServer()
+    out = call(srv, "load", data=base64.b64encode(bytes(bad)).decode(),
+               onError="salvage")
+    assert out["salvage"]["appliedChunks"] == 2
+    dropped = out["salvage"]["dropped"]
+    assert len(dropped) == 1
+    assert base64.b64decode(dropped[0]["checksum"]) == hashes[1][:4]
+    # strict load of the same bytes answers with an error, not a crash
+    resp = srv.handle({"id": 1, "method": "load",
+                       "params": {"data": base64.b64encode(bytes(bad)).decode()}})
+    assert "error" in resp
