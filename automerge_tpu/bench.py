@@ -390,6 +390,46 @@ def synth_mapcounter(
     return out, expected
 
 
+def synth_delta_chain(
+    base: BaseInfo, trace_edits: Sequence, k: int, ops_per_delta: int,
+    offset: int, actor: Optional[bytes] = None,
+) -> List[List[StoredChange]]:
+    """The incremental workload: K successive small deltas from ONE editing
+    replica against a large resident base — each delta is one change whose
+    deps chain off the previous delta (seq ascending), exactly what a live
+    peer streams over sync. Returns K single-change batches."""
+    import copy
+
+    actor = actor if actor is not None else _replica_actor(0)
+    out: List[List[StoredChange]] = []
+    cur = copy.copy(base)  # shallow view; heads/max_op advance per delta
+    lo0 = min(offset // 2, max(len(trace_edits) - ops_per_delta - 1, 0))
+    span = max(len(trace_edits) - lo0 - ops_per_delta, 1)
+    for i in range(k):
+        lo = lo0 + (offset // 2 + i * ops_per_delta) % span
+        ch = synth_seq_change(
+            cur, actor, trace_edits[lo : lo + ops_per_delta], seed=5000 + i
+        )
+        if i > 0:  # the committing replica's seq advances along the chain
+            ch = build_change(
+                StoredChange(
+                    dependencies=list(cur.heads),
+                    actor=actor,
+                    other_actors=ch.other_actors,
+                    seq=i + 1,
+                    start_op=cur.max_op + 1,
+                    timestamp=0,
+                    message=None,
+                    ops=ch.ops,
+                )
+            )
+        cur = copy.copy(cur)
+        cur.heads = [ch.hash]
+        cur.max_op = ch.max_op
+        out.append([ch])
+    return out
+
+
 # -- the native sequential-apply baseline -----------------------------------
 
 

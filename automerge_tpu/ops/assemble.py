@@ -193,7 +193,21 @@ def ensure_change_cols(changes: Sequence) -> List[ChangeCols]:
     ]
     missing = [i for i, c in enumerate(caches) if c is None]
     if missing:
-        from .extract import batch_arrays
+        from .extract import cached_cols_for_hash
+
+        # hash-keyed cache first: a re-delivered change (fresh object off
+        # the wire, same hash) costs one dict hit instead of a re-decode
+        still = []
+        for i in missing:
+            cc = cached_cols_for_hash(getattr(changes[i], "hash", None))
+            if cc is not None:
+                changes[i].cached_cols = cc
+                caches[i] = cc
+            else:
+                still.append(i)
+        missing = still
+    if missing:
+        from .extract import batch_arrays, remember_cols_for_hash
 
         subset = [changes[i] for i in missing]
         for ch in subset:
@@ -204,6 +218,7 @@ def ensure_change_cols(changes: Sequence) -> List[ChangeCols]:
         for i, cc in zip(missing, built):
             changes[i].cached_cols = cc
             caches[i] = cc
+            remember_cols_for_hash(getattr(changes[i], "hash", None), cc)
     enc = get_text_encoding()
     for cc in caches:
         if cc.width_enc != enc:

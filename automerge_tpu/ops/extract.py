@@ -324,7 +324,9 @@ def batch_arrays(changes) -> Dict[str, object]:
         (boolean, COL_EXPAND), (rle, COL_VAL_META), (strtab, COL_KEY_STR),
         (strtab, COL_MARK_NAME), (rle, COL_PRED_GROUP),
     ]
-    pool = _decode_pool()
+    # small batches (incremental deltas) run serially: the pool's submit/
+    # wait round-trip costs more than the decodes themselves below ~16k ops
+    pool = _decode_pool() if N >= (1 << 14) else None
     if pool is not None:
         futs = [pool.submit(fn, spec) for fn, spec in tasks]
         results = [f.result() for f in futs]
@@ -536,20 +538,35 @@ _TAG_NAME = {
 }
 
 
+def _value_cache_cap() -> int:
+    import os
+
+    return int(os.environ.get("AUTOMERGE_TPU_VALUE_CACHE", 1 << 16))
+
+
 class LazyValues:
     """Row -> ScalarValue, materialized on demand from the raw value buffer.
 
     Drop-in for the eager python list the slow extraction path produces.
+    The per-row cache is BOUNDED (``cap``, default 65536 entries, env knob
+    AUTOMERGE_TPU_VALUE_CACHE): a long-lived DeviceDoc over a multi-million
+    row log would otherwise accrete one ScalarValue per row ever read.
+    Eviction is insertion-order FIFO (one dict pop); ``hits``/``misses``
+    count cache effectiveness for the bench / trace output.
     """
 
-    __slots__ = ("code", "off", "ln", "raw", "cache")
+    __slots__ = ("code", "off", "ln", "raw", "cache", "cap", "hits", "misses")
 
-    def __init__(self, code: np.ndarray, off: np.ndarray, ln: np.ndarray, raw: bytes):
+    def __init__(self, code: np.ndarray, off: np.ndarray, ln: np.ndarray,
+                 raw: bytes, cap: Optional[int] = None):
         self.code = code
         self.off = off
         self.ln = ln
         self.raw = raw
         self.cache: Dict[int, ScalarValue] = {}
+        self.cap = _value_cache_cap() if cap is None else cap
+        self.hits = 0
+        self.misses = 0
 
     def __len__(self) -> int:
         return len(self.code)
@@ -557,9 +574,21 @@ class LazyValues:
     def __getitem__(self, row: int) -> ScalarValue:
         v = self.cache.get(row)
         if v is None:
+            self.misses += 1
             v = self._decode(row)
-            self.cache[row] = v
+            if self.cap > 0:
+                if len(self.cache) >= self.cap:
+                    self.cache.pop(next(iter(self.cache)))
+                self.cache[row] = v
+        else:
+            self.hits += 1
         return v
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "hits": self.hits, "misses": self.misses,
+            "size": len(self.cache), "cap": self.cap,
+        }
 
     def _decode(self, row: int) -> ScalarValue:
         import struct
@@ -567,7 +596,9 @@ class LazyValues:
         code = int(self.code[row])
         o = int(self.off[row])
         ln = int(self.ln[row])
-        chunk = self.raw[o : o + ln]
+        # the raw heap may be a (shared, append-only) bytearray; values
+        # must come out as immutable bytes
+        chunk = bytes(self.raw[o : o + ln])
         if code == 0:
             return ScalarValue("null")
         if code == 1:
@@ -589,6 +620,52 @@ class LazyValues:
         if code == 9:
             return ScalarValue("timestamp", decode_sleb(chunk, 0)[0])
         return ScalarValue("unknown", (code, chunk))
+
+
+# -- per-change-hash extraction cache ----------------------------------------
+# Sync re-delivers changes as FRESH StoredChange objects (parsed off the
+# wire), so the per-object ``cached_cols`` memo never hits for them. This
+# bounded hash-keyed cache makes a re-delivered (or re-parsed) change's
+# column decode one dict hit. LRU by re-insertion; the cap bounds worst-case
+# host memory at a few thousand decoded changes.
+
+_CHANGE_COLS_CACHE: "OrderedDict[bytes, object]" = None  # type: ignore[assignment]
+_CHANGE_COLS_CAP = 4096
+
+
+def _change_cache() -> "OrderedDict[bytes, object]":
+    global _CHANGE_COLS_CACHE
+    if _CHANGE_COLS_CACHE is None:
+        from collections import OrderedDict
+
+        _CHANGE_COLS_CACHE = OrderedDict()
+    return _CHANGE_COLS_CACHE
+
+
+def cached_cols_for_hash(h: Optional[bytes]):
+    """Decoded ChangeCols for a change hash, or None (counts hit/miss)."""
+    from .. import trace
+
+    if h is None:
+        return None
+    cache = _change_cache()
+    cc = cache.get(h)
+    if cc is not None:
+        cache.move_to_end(h)
+        trace.count("extract.change_cache_hit")
+    else:
+        trace.count("extract.change_cache_miss")
+    return cc
+
+
+def remember_cols_for_hash(h: Optional[bytes], cc) -> None:
+    if h is None or cc is None:
+        return
+    cache = _change_cache()
+    cache[h] = cc
+    cache.move_to_end(h)
+    while len(cache) > _CHANGE_COLS_CAP:
+        cache.popitem(last=False)
 
 
 def doc_op_arrays(col_data) -> Dict[str, object]:

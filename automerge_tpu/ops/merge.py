@@ -38,7 +38,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .oplog import ELEM_HEAD, PAD_ACTION, TAG_COUNTER, _next_pow2
+from .. import trace
+from .oplog import ELEM_HEAD, PAD_ACTION, TAG_COUNTER, _capacity, _next_pow2
 
 _DELETE = 3
 _INCREMENT = 5
@@ -493,11 +494,13 @@ def device_linearize_condensed(c, core, rcap: int, obj_cap: int = None):
 
 
 def condensed_caps(log) -> tuple:
-    """(rcap, obj_cap) buckets for merge_kernel_condensed — the ONE bucket
-    policy shared by bench and tests."""
-    r = max(log.condensed_run_count(), 1)
-    rcap = max(1 << (r - 1).bit_length(), 32)
-    obj_cap = max(1 << max(log.n_objs - 1, 1).bit_length(), 16)
+    """(rcap, obj_cap) buckets for merge_kernel_condensed — routed through
+    oplog._capacity, the ONE growth/bucket policy (shared with pad_columns
+    and the packed transport) so a growing document reuses the compiled
+    kernel for every size inside a bucket instead of retracing per row
+    count."""
+    rcap = _capacity(max(log.condensed_run_count(), 1), 32)
+    obj_cap = _capacity(max(log.n_objs, 1), 16)
     return rcap, obj_cap
 
 
@@ -918,7 +921,7 @@ def _packed_merge(cols_np, fetch, n_objs, n_props=None):
 
     P = len(cols_np["action"])
     Q = len(cols_np["pred_src"])
-    obj_cap = min(_next_pow2(max((n_objs or P) + 2, 16)), P + 2)
+    obj_cap = min(_capacity((n_objs or P) + 2, 16), P + 2)
     fetch = tuple(fetch)
     scatter_geom = (
         scatter_geom_key(n_objs, n_props)
@@ -948,10 +951,15 @@ def _packed_merge(cols_np, fetch, n_objs, n_props=None):
         fn = _packed_cache[key] = _runs_fn(
             dev_fetch, obj_cap, static_key, P, Q, scatter_geom
         )
-    flat_dev = fn({k: jnp.asarray(v) for k, v in arrays.items()})  # async
+    with trace.time("device.h2d", rows=P):
+        arrays_dev = {k: jnp.asarray(v) for k, v in arrays.items()}
+    with trace.time("device.kernel", rows=P):
+        flat_dev = fn(arrays_dev)  # async dispatch
     elem_index = host_linearize(cols_np) if host_elem else None
-    flat = np.asarray(flat_dev)
-    out = _split_flat(flat, dev_fetch, P, obj_cap)
+    with trace.time("device.readback", rows=P):
+        flat = np.asarray(flat_dev)
+    with trace.time("device.materialize", rows=P):
+        out = _split_flat(flat, dev_fetch, P, obj_cap)
     if host_elem:
         out["elem_index"] = elem_index
     return out
@@ -1043,11 +1051,12 @@ def merge_columns(cols_np, linearize: str = "auto", fetch=None, n_objs=None,
         )
     ):
         need = fetch if fetch is not None else ALL_OUTPUTS
-        out = native.merge_cols(
-            cols_np,
-            n_objs if n_objs is not None else len(cols_np["action"]),
-            want_elem_index="elem_index" in need,
-        )
+        with trace.time("merge.host", rows=len(cols_np["action"])):
+            out = native.merge_cols(
+                cols_np,
+                n_objs if n_objs is not None else len(cols_np["action"]),
+                want_elem_index="elem_index" in need,
+            )
         return {k: out[k] for k in need}
 
     # the jit kernels need bucket-padded shapes; callers may hand over the
@@ -1081,34 +1090,38 @@ def merge_columns(cols_np, linearize: str = "auto", fetch=None, n_objs=None,
             n_props,
         )
 
-    cols = {k: jnp.asarray(v) for k, v in cols_np.items()}
+    with trace.time("device.h2d", rows=len(cols_np["action"])):
+        cols = {k: jnp.asarray(v) for k, v in cols_np.items()}
     if linearize == "auto":
         linearize = "native" if native.preorder_available() else "device"
     need = set(fetch) if fetch is not None else set(ALL_OUTPUTS)
 
     def pull(out, keys):
         host = {}
-        for k in keys:
-            v = out[k]
-            if k in ("obj_vis_len", "obj_text_width") and n_objs is not None:
-                v = v[: n_objs + 2]
-            host[k] = np.asarray(v)
+        with trace.time("device.readback", rows=len(cols_np["action"])):
+            for k in keys:
+                v = out[k]
+                if k in ("obj_vis_len", "obj_text_width") and n_objs is not None:
+                    v = v[: n_objs + 2]
+                host[k] = np.asarray(v)
         return host
 
     if linearize == "native":
         P = len(cols_np["action"])
-        if (
-            n_objs is not None
-            and n_props is not None
-            and scatter_geometry_ok(P, n_objs, n_props)
-        ):
-            out = scatter_kernel_core(n_objs, n_props)(cols)
-        else:
-            out = merge_kernel_core(cols)
+        with trace.time("device.kernel", rows=P):
+            if (
+                n_objs is not None
+                and n_props is not None
+                and scatter_geometry_ok(P, n_objs, n_props)
+            ):
+                out = scatter_kernel_core(n_objs, n_props)(cols)
+            else:
+                out = merge_kernel_core(cols)
         host = pull(out, need - {"elem_index"})
         if "elem_index" in need:
             # ranked from the host-resident columns — zero device traffic
             host["elem_index"] = host_linearize(cols_np)
         return host
-    out = merge_kernel(cols)
+    with trace.time("device.kernel", rows=len(cols_np["action"])):
+        out = merge_kernel(cols)
     return pull(out, need)

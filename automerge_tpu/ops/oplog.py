@@ -110,7 +110,11 @@ class OpLog:
         "pred_tgt",
         "expand",
         "mark_name_idx",
+        "elem_key",
+        "pred_key",
         "_actor_order",
+        "_hash_set",
+        "_bufs",
     )
 
     def __init__(self):
@@ -121,7 +125,11 @@ class OpLog:
         self.mark_names: List[str] = []
         self.n = 0
         self.n_objs = 1
+        self.elem_key = None
+        self.pred_key = None
         self._actor_order = None
+        self._hash_set = None
+        self._bufs = None
 
     # -- construction --------------------------------------------------
 
@@ -165,13 +173,16 @@ class OpLog:
                 ch.op_col_data is not None or ch.cached_cols is not None
                 for ch in deduped
             )
+        from .. import trace
+
         if fast:
             from .. import native
             from .assemble import AssembleError, assemble_log
             from .extract import ExtractError
 
             try:
-                return assemble_log(log, deduped, rank_of)
+                with trace.time("device.extract", changes=len(deduped)):
+                    return assemble_log(log, deduped, rank_of)
             except (
                 AssembleError, ExtractError, native.NativeUnavailable,
                 ValueError,
@@ -185,7 +196,8 @@ class OpLog:
                     stacklevel=2,
                 )
             try:
-                return cls._collect_fast(log, deduped, rank_of)
+                with trace.time("device.extract", changes=len(deduped)):
+                    return cls._collect_fast(log, deduped, rank_of)
             except (ExtractError, native.NativeUnavailable, ValueError) as e:
                 if os.environ.get("AUTOMERGE_TPU_DEBUG"):
                     raise
@@ -195,7 +207,8 @@ class OpLog:
                     RuntimeWarning,
                     stacklevel=2,
                 )
-        return cls._collect_slow(log, deduped, rank_of)
+        with trace.time("device.extract", changes=len(deduped)):
+            return cls._collect_slow(log, deduped, rank_of)
 
     @classmethod
     def _collect_slow(cls, log, deduped, rank_of) -> "OpLog":
@@ -394,6 +407,10 @@ class OpLog:
         log.pred_src = inv[pred_src] if len(pred_src) else np.empty(0, np.int32)
         tgt = rows_of(pred_key, -1) if len(pred_key) else np.empty(0, np.int32)
         log.pred_tgt = tgt.astype(np.int32)
+        # packed reference keys retained for the incremental append path
+        # (re-resolving MISSING refs when the referenced op arrives later)
+        log.elem_key = elem
+        log.pred_key = pred_key
         return log
 
     @classmethod
@@ -550,6 +567,489 @@ class OpLog:
         if pos < self.n and self.id_key[pos] == key:
             return pos
         raise KeyError(f"no op with id {self.export_id(key)}")
+
+    # -- incremental append -------------------------------------------------
+
+    def hashes(self) -> set:
+        hs = self._hash_set
+        if hs is None:
+            hs = self._hash_set = {ch.hash for ch in self.changes}
+        return hs
+
+    def _ensure_ref_keys(self) -> bool:
+        """Materialize the packed reference-key columns (``elem_key`` per
+        row, ``pred_key`` per edge) the append path splices and re-resolves.
+        Logs built by ``_finalize`` carry them; assembler-built logs
+        reconstruct them from the resolved row refs — impossible only when
+        a ref is MISSING (partial history), in which case the caller falls
+        back to a full rebuild."""
+        if self.elem_key is None:
+            er = self.elem_ref
+            if self.n and np.any(er == ELEM_MISSING):
+                return False
+            safe = np.clip(er, 0, max(self.n - 1, 0))
+            self.elem_key = np.where(
+                er == ELEM_MAP,
+                np.int64(-1),
+                np.where(er == ELEM_HEAD, np.int64(0), self.id_key[safe]),
+            ).astype(np.int64)
+        if self.pred_key is None:
+            if len(self.pred_tgt) and np.any(self.pred_tgt < 0):
+                return False
+            self.pred_key = (
+                self.id_key[self.pred_tgt].astype(np.int64)
+                if len(self.pred_tgt)
+                else np.empty(0, np.int64)
+            )
+        return True
+
+    def _splice_col(self, name, old, new_vals, row_map, new_rows, tail, m):
+        """One column's splice into a capacity-bucketed backing buffer.
+
+        Tail appends into a still-roomy buffer write only the k new slots;
+        everything else allocates at the bucket capacity and scatters both
+        sides through the position maps (one vectorized pass per column)."""
+        old = np.asarray(old)
+        new_vals = np.asarray(new_vals).astype(old.dtype, copy=False)
+        n = len(old)
+        buf = self._bufs.get(name)
+        if tail and buf is not None and old.base is buf and len(buf) >= m:
+            buf[n:m] = new_vals
+            return buf[:m]
+        nbuf = np.empty(_capacity(m), old.dtype)
+        out = nbuf[:m]
+        if tail:
+            out[:n] = old
+            out[n:] = new_vals
+        else:
+            out[row_map] = old
+            out[new_rows] = new_vals
+        self._bufs[name] = nbuf
+        return out
+
+    def append_changes(self, changes: Iterable[StoredChange]):
+        """Splice new changes into the existing columns WITHOUT re-collecting
+        prior replicas: extract only the fresh changes (vectorized, through
+        the per-change-hash column cache), merge their rows into the
+        Lamport order with searchsorted position arithmetic, re-resolve
+        references that touch the delta, and report the dirty object set.
+
+        Returns an ``AppendInfo`` on success, or ``None`` when the log
+        cannot be updated in place (no retained column bytes, partial
+        history with unreconstructable refs, packed-id collisions) — the
+        caller then rebuilds via ``from_changes``. New actors are handled
+        in place: actor ranks are byte-ordered, so inserting actors remaps
+        every packed key through a MONOTONE rank map, which preserves the
+        existing sort order.
+
+        Caller contract: the active text encoding must match the one the
+        resident columns were built under (as in ``from_documents``).
+        """
+        from .. import trace
+
+        known = self.hashes()
+        fresh: List[StoredChange] = []
+        batch_seen = set()
+        for ch in changes:
+            if ch.hash is None or ch.hash in known or ch.hash in batch_seen:
+                continue
+            batch_seen.add(ch.hash)
+            fresh.append(ch)
+        if not fresh:
+            return AppendInfo(self.n, 0, np.empty(0, np.int64), None, True,
+                              np.empty(0, np.int64), None, False, 0)
+        if any(
+            ch.op_col_data is None and ch.cached_cols is None for ch in fresh
+        ):
+            trace.count("oplog.append_fallback", reason="no_columns")
+            return None
+        if not self._ensure_ref_keys():
+            trace.count("oplog.append_fallback", reason="missing_refs")
+            return None
+
+        # -- actor universe (monotone rank remap keeps old order sorted) --
+        old_bytes = [a.bytes for a in self.actors]
+        delta_bytes = {bytes(a) for ch in fresh for a in ch.actors}
+        actors_changed = not delta_bytes.issubset(old_bytes_set := set(old_bytes))
+        if actors_changed:
+            all_bytes = sorted(old_bytes_set | delta_bytes)
+            if len(all_bytes) >= (1 << ACTOR_BITS):
+                trace.count("oplog.append_fallback", reason="too_many_actors")
+                return None
+        else:
+            all_bytes = old_bytes
+        rank_of = {b: i for i, b in enumerate(all_bytes)}
+        if actors_changed:
+            rank_map = np.fromiter(
+                (rank_of[b] for b in old_bytes), np.int64, count=len(old_bytes)
+            )
+
+            def remap_packed(key):
+                key = np.asarray(key, np.int64)
+                idx = np.where(key > 0, key, 0) & ACTOR_MASK
+                return np.where(
+                    key > 0,
+                    ((key >> ACTOR_BITS) << ACTOR_BITS) | rank_map[idx],
+                    key,
+                )
+        else:
+            def remap_packed(key):
+                return np.asarray(key, np.int64)
+
+        # -- extract ONLY the fresh changes -------------------------------
+        with trace.time("device.extract", changes=len(fresh)):
+            r = self._extract_delta(fresh, rank_of)
+        if r is None:
+            return None
+        a = r["a"]
+        k = int(a["n"])
+
+        n = self.n
+        old_id = remap_packed(self.id_key) if n else np.empty(0, np.int64)
+
+        if k == 0:
+            # dependency-only changes: commit bookkeeping, no rows
+            self._commit_actors(all_bytes, actors_changed, remap_packed, old_id)
+            self.changes.extend(fresh)
+            known.update(batch_seen)
+            return AppendInfo(n, 0, np.empty(0, np.int64), None, True,
+                              np.empty(0, np.int64), None, actors_changed,
+                              len(fresh))
+
+        order = np.argsort(r["id_key"], kind="stable")
+        d_id = r["id_key"][order]
+        if np.any(d_id[1:] == d_id[:-1]):
+            trace.count("oplog.append_fallback", reason="dup_op_id")
+            return None
+        pos = np.searchsorted(old_id, d_id)
+        if n:
+            posc = np.clip(pos, 0, n - 1)
+            if np.any(old_id[posc] == d_id):
+                trace.count("oplog.append_fallback", reason="id_collision")
+                return None
+        tail = n == 0 or pos[0] == n
+        m = n + k
+        new_rows = pos + np.arange(k, dtype=np.int64)
+        if tail:
+            row_map = None
+        else:
+            cnt = np.bincount(pos, minlength=n + 1)
+            row_map = np.arange(n, dtype=np.int64) + np.cumsum(cnt[:n])
+        if self._bufs is None:
+            self._bufs = {}
+
+        # -- string tables (old ids stable; new names appended) ------------
+        props, d_prop = _merge_table(self.props, a["key_table"],
+                                     r["prop_ids"], order)
+        mark_ids = a.get("mark_ids")
+        if mark_ids is None:
+            mark_names = list(self.mark_names)
+            d_mark = np.full(k, -1, np.int32)
+        else:
+            mark_names, d_mark = _merge_table(self.mark_names,
+                                              a["mark_table"], mark_ids, order)
+
+        # -- splice the plain per-row columns ------------------------------
+        sp = lambda name, old, new: self._splice_col(  # noqa: E731
+            name, old, new, row_map, new_rows, tail, m
+        )
+        id_new = sp("id_key", old_id, d_id)
+        obj_new = sp("obj_key", remap_packed(self.obj_key), r["obj"][order])
+        ek_new = sp("elem_key", remap_packed(self.elem_key), r["elem"][order])
+        action_new = sp("action", self.action, a["action"][order])
+        prop_new = sp("prop", self.prop, d_prop)
+        insert_new = sp("insert", np.asarray(self.insert, np.bool_),
+                        np.asarray(a["insert"], np.bool_)[order])
+        vtag_new = sp("value_tag", self.value_tag,
+                      np.minimum(a["vcode"], TAG_UNKNOWN)[order])
+        vint_new = sp("value_int", self.value_int, a["value_int"][order])
+        width_new = sp("width", self.width, a["width"][order])
+        expand_new = sp("expand", np.asarray(self.expand, np.bool_),
+                        np.asarray(a["expand"], np.bool_)[order])
+        mark_new = sp("mark_name_idx", self.mark_name_idx, d_mark)
+
+        from .. import native
+
+        if native.available():
+            def rows_of(keys):
+                return native.join_rows(id_new, np.asarray(keys, np.int64),
+                                        ELEM_MISSING)
+        else:
+            def rows_of(keys):
+                keys = np.asarray(keys, np.int64)
+                p = np.searchsorted(id_new, keys)
+                pc = np.clip(p, 0, m - 1).astype(np.int32)
+                hit = id_new[pc] == keys
+                return np.where(hit, pc, np.int32(ELEM_MISSING)).astype(np.int32)
+
+        # -- element references --------------------------------------------
+        old_er = self.elem_ref
+        if not tail:
+            old_er = np.where(
+                old_er >= 0, row_map[np.clip(old_er, 0, max(n - 1, 0))], old_er
+            )
+        d_ek = r["elem"][order]
+        d_er = np.where(
+            d_ek == -1,
+            np.int32(ELEM_MAP),
+            np.where(d_ek == 0, np.int32(ELEM_HEAD), rows_of(d_ek)),
+        ).astype(np.int32)
+        er_new = sp("elem_ref", old_er.astype(np.int32, copy=False), d_er)
+        # previously-MISSING refs may now resolve (their target arrived)
+        rere_rows = np.empty(0, np.int64)
+        miss = np.flatnonzero(er_new == ELEM_MISSING)
+        if len(miss):
+            res = rows_of(ek_new[miss])
+            got = res != ELEM_MISSING
+            if np.any(got):
+                er_new[miss[got]] = res[got]
+                rere_rows = miss[got]
+
+        # -- pred edges (appended at the end; order is irrelevant) ---------
+        q = len(self.pred_src)
+        old_ps = self.pred_src
+        old_pt = self.pred_tgt
+        if not tail:
+            safe_n = max(n - 1, 0)
+            old_ps = row_map[np.clip(old_ps, 0, safe_n)].astype(np.int32) \
+                if q else old_ps
+            old_pt = np.where(
+                old_pt >= 0, row_map[np.clip(old_pt, 0, safe_n)], old_pt
+            ).astype(np.int32) if q else old_pt
+        inv = np.empty(k, np.int64)
+        inv[order] = np.arange(k)
+        d_ps = new_rows[inv[r["pred_src"]]].astype(np.int32) \
+            if len(r["pred_src"]) else np.empty(0, np.int32)
+        d_pk = r["pred_key"]
+        d_pt = rows_of(d_pk).astype(np.int32) if len(d_pk) \
+            else np.empty(0, np.int32)
+        d_pt = np.where(d_pt == ELEM_MISSING, np.int32(-1), d_pt)
+        qm = q + len(d_ps)
+        cat = lambda name, old, new: self._splice_col(  # noqa: E731
+            name, np.asarray(old), new, None, None, True, qm
+        )
+        ps_new = cat("pred_src", old_ps, d_ps)
+        pt_new = cat("pred_tgt", old_pt, d_pt)
+        pk_new = cat("pred_key", remap_packed(self.pred_key), d_pk)
+        # previously-unresolved pred targets may now resolve
+        rere_pred = np.empty(0, np.int64)
+        pmiss = np.flatnonzero(pt_new == -1)
+        if len(pmiss):
+            res = rows_of(pk_new[pmiss])
+            got = res != ELEM_MISSING
+            if np.any(got):
+                pt_new[pmiss[got]] = res[got]
+                rere_pred = pmiss[got]
+
+        # -- object table / dense ids --------------------------------------
+        old_table = remap_packed(self.obj_table)
+        make_new = d_id[np.isin(a["action"][order], MAKE_ACTIONS)]
+        add = np.concatenate([make_new, r["obj"][order]])
+        new_table = np.union1d(old_table, add)
+        if len(new_table) == len(old_table):
+            obj_remap = None
+            od_old = self.obj_dense
+            self.obj_table = new_table
+        else:
+            obj_remap = np.searchsorted(new_table, old_table).astype(np.int32)
+            od_old = obj_remap[self.obj_dense]
+            self.obj_table = new_table
+        od_new = np.searchsorted(new_table, r["obj"][order]).astype(np.int32)
+        od_all = sp("obj_dense", od_old.astype(np.int32, copy=False), od_new)
+
+        # -- values heap ----------------------------------------------------
+        self._splice_values(a, order, row_map, new_rows, tail, m)
+
+        # -- dirty objects (NEW dense numbering) ---------------------------
+        parts = [od_new, np.searchsorted(new_table, make_new)]
+        if len(rere_rows):
+            parts.append(od_all[rere_rows])
+        if len(rere_pred):
+            src = ps_new[rere_pred]
+            tgt = pt_new[rere_pred]
+            parts.append(od_all[src])
+            parts.append(od_all[np.clip(tgt, 0, m - 1)])
+        if len(d_pt):
+            hit = d_pt >= 0
+            if np.any(hit):
+                parts.append(od_all[d_pt[hit]])
+        dirty = np.unique(np.concatenate(parts)).astype(np.int64)
+
+        # -- commit ---------------------------------------------------------
+        self.id_key = id_new
+        self.obj_key = obj_new
+        self.elem_key = ek_new
+        self.action = action_new
+        self.prop = prop_new
+        self.insert = insert_new
+        self.value_tag = vtag_new
+        self.value_int = vint_new
+        self.width = width_new
+        self.expand = expand_new
+        self.mark_name_idx = mark_new
+        self.elem_ref = er_new
+        self.obj_dense = od_all
+        self.pred_src = ps_new
+        self.pred_tgt = pt_new
+        self.pred_key = pk_new
+        self.props = props
+        self.mark_names = mark_names
+        self.n = m
+        self.n_objs = len(new_table)
+        self.actors = [ActorId(b) for b in all_bytes]
+        self._actor_order = None
+        self.changes.extend(fresh)
+        known.update(batch_seen)
+        trace.count("oplog.append_rows", n=k)
+        trace.event(
+            "oplog.append", rows=k, total=m, tail=int(tail),
+            dirty_objs=len(dirty), actors_changed=int(actors_changed),
+        )
+        return AppendInfo(n, k, new_rows, row_map, tail, dirty, obj_remap,
+                          actors_changed, len(fresh), n_pred_old=q,
+                          rere_elem_rows=rere_rows, rere_pred_edges=rere_pred)
+
+    def _commit_actors(self, all_bytes, actors_changed, remap_packed, old_id):
+        if not actors_changed:
+            return
+        self.id_key = old_id
+        self.obj_key = remap_packed(self.obj_key)
+        self.elem_key = remap_packed(self.elem_key)
+        self.pred_key = remap_packed(self.pred_key)
+        self.obj_table = remap_packed(self.obj_table)
+        self.actors = [ActorId(b) for b in all_bytes]
+        self._actor_order = None
+        # remapped arrays no longer alias the backing buffers
+        self._bufs = {}
+
+    def _extract_delta(self, fresh, rank_of):
+        """ranked_batch-shaped columns for the fresh changes only, through
+        whichever vectorized path is available (cached-cols assembler
+        input first, then raw batch extraction)."""
+        from .. import native
+
+        try:
+            from .assemble import AssembleError, ranked_from_caches
+
+            return ranked_from_caches(list(fresh), rank_of)
+        except (AssembleError, native.NativeUnavailable, ValueError):
+            pass
+        except Exception:
+            if os.environ.get("AUTOMERGE_TPU_DEBUG"):
+                raise
+        try:
+            from .extract import ExtractError, ranked_batch
+
+            return ranked_batch(list(fresh), rank_of)
+        except (ExtractError, native.NativeUnavailable, ValueError):
+            from .. import trace
+
+            trace.count("oplog.append_fallback", reason="extract_failed")
+            return None
+
+    def _splice_values(self, a, order, row_map, new_rows, tail, m):
+        from .extract import LazyValues
+
+        vals = self.values
+        d_code = a["vcode"][order].astype(np.int32)
+        d_off = a["voff"][order].astype(np.int64)
+        d_ln = a["vlen"][order].astype(np.int64)
+        d_raw = a["vraw"]
+        if isinstance(vals, LazyValues):
+            base = len(vals.raw)
+            code = self._splice_col("vcode", vals.code, d_code,
+                                    row_map, new_rows, tail, m)
+            off = self._splice_col("voff", vals.off, d_off + base,
+                                   row_map, new_rows, tail, m)
+            ln = self._splice_col("vlen", vals.ln, d_ln,
+                                  row_map, new_rows, tail, m)
+            # append-only raw heap: a bytearray grows geometrically, so a
+            # delta stream costs O(delta) amortized instead of re-copying
+            # the resident bytes each append (offsets of old rows never
+            # move, so sharing the buffer with prior LazyValues is safe)
+            raw = vals.raw
+            if not isinstance(raw, bytearray):
+                raw = bytearray(raw)
+            raw += d_raw
+            nv = LazyValues(code, off, ln, raw, cap=vals.cap)
+            nv.hits, nv.misses = vals.hits, vals.misses
+            self.values = nv
+            return
+        # eager python list (slow collection path): object-array splice
+        dv = LazyValues(d_code, d_off, d_ln, d_raw)
+        new_list = [dv[i] for i in range(len(d_code))]
+        arr = np.empty(m, object)
+        if tail:
+            arr[: len(vals)] = vals
+            arr[len(vals):] = new_list
+        else:
+            arr[row_map] = vals
+            arr[new_rows] = new_list
+        self.values = arr.tolist()
+
+
+class AppendInfo:
+    """What an in-place ``OpLog.append_changes`` did — everything a resident
+    consumer (DeviceDoc) needs to splice its own row-indexed state.
+
+    ``row_map`` maps old row index -> new row index (None = identity, the
+    tail-append fast path); ``new_rows`` are the spliced rows' positions;
+    ``dirty_objs`` are the dense object ids (NEW numbering) whose resolution
+    is stale; ``obj_remap`` maps old dense ids -> new (None = identity)."""
+
+    __slots__ = (
+        "n_old", "n_new", "new_rows", "row_map", "tail", "dirty_objs",
+        "obj_remap", "actors_changed", "n_changes", "n_pred_old",
+        "rere_elem_rows", "rere_pred_edges",
+    )
+
+    def __init__(self, n_old, n_new, new_rows, row_map, tail, dirty_objs,
+                 obj_remap, actors_changed, n_changes, n_pred_old=0,
+                 rere_elem_rows=None, rere_pred_edges=None):
+        self.n_old = n_old
+        self.n_new = n_new
+        self.new_rows = new_rows
+        self.row_map = row_map
+        self.tail = tail
+        self.dirty_objs = dirty_objs
+        self.obj_remap = obj_remap
+        self.actors_changed = actors_changed
+        self.n_changes = n_changes
+        # edge bookkeeping for host-side delta resolution: edges before
+        # index n_pred_old are carried; rere_* name previously-MISSING
+        # references that resolved when their target arrived in this append
+        self.n_pred_old = n_pred_old
+        self.rere_elem_rows = (
+            rere_elem_rows if rere_elem_rows is not None
+            else np.empty(0, np.int64)
+        )
+        self.rere_pred_edges = (
+            rere_pred_edges if rere_pred_edges is not None
+            else np.empty(0, np.int64)
+        )
+
+
+def _merge_table(old: List[str], delta_table, ids, order) -> Tuple[List[str], np.ndarray]:
+    """Union a delta's string table into the resident one (old ids stable,
+    new names appended) and translate the delta's per-row ids."""
+    merged = list(old)
+    k = len(order)
+    if not delta_table:
+        return merged, np.full(k, -1, np.int32)
+    pos_of = {s: i for i, s in enumerate(merged)}
+    remap = np.empty(len(delta_table), np.int32)
+    for j, s in enumerate(delta_table):
+        gi = pos_of.get(s)
+        if gi is None:
+            gi = len(merged)
+            merged.append(s)
+            pos_of[s] = gi
+        remap[j] = gi
+    ids = np.asarray(ids)
+    out = np.where(
+        ids >= 0, remap[np.clip(ids, 0, len(delta_table) - 1)], np.int32(-1)
+    ).astype(np.int32)
+    return merged, out[order]
 
 
 def _value_tag(v: ScalarValue) -> int:

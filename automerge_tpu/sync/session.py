@@ -30,7 +30,13 @@ classic ARQ toolbox:
 
 All recovery paths emit ``trace.count`` counters: ``sync.retry``,
 ``sync.reset``, ``sync.resync``, ``sync.dup``, ``sync.malformed``,
-``sync.rejected``.
+``sync.rejected``, ``sync.device_feed_error``.
+
+A session may carry a resident ``DeviceDoc`` (``device_doc=``): changes
+received off the wire feed its incremental append/re-resolve path
+(ops/device_doc.apply_changes), so a device-resident replica tracks the
+host document at O(delta) per round instead of rebuilding from the full
+change history.
 
 The session is transport- and clock-agnostic: ``poll(now)`` may return
 frame bytes to put on the wire, ``receive(data)`` feeds bytes taken off
@@ -133,10 +139,15 @@ class SyncSession:
         *,
         config: Optional[SessionConfig] = None,
         epoch: int = 1,
+        device_doc=None,
     ):
         # accept an AutoDoc (auto-commits) or a core Document
         self._autodoc = doc if hasattr(doc, "doc") else None
         self._doc = doc.doc if self._autodoc is not None else doc
+        # optional resident DeviceDoc: received changes feed its
+        # incremental append/re-resolve path directly (O(delta) instead of
+        # a from-scratch device rebuild per sync round)
+        self.device_doc = device_doc
         self.state = state or SyncState()
         self.config = config or SessionConfig()
         self.epoch = epoch
@@ -323,6 +334,13 @@ class SyncSession:
             return False
         if self._autodoc is not None:
             self._autodoc._notify_patches()
+        if self.device_doc is not None and msg.changes:
+            # feed the resident device document incrementally; device-side
+            # trouble must never break the host sync session
+            try:
+                self.device_doc.apply_changes(msg.changes)
+            except Exception as e:  # noqa: BLE001 — isolate the sidecar
+                trace.count("sync.device_feed_error", error=str(e)[:200])
         self.stats["received"] += 1
         self._awaiting = False
         self._retries = 0

@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import logging
 import os
-import time
+from time import perf_counter as _perf_counter
 
 logger = logging.getLogger("automerge_tpu")
 
@@ -72,13 +72,60 @@ class span:
 
     def __enter__(self):
         if logger.isEnabledFor(_DEBUG):
-            self.t0 = time.perf_counter()
+            self.t0 = _perf_counter()
             event(self.name, phase="begin", **self.fields)
         return self
 
     def __exit__(self, *exc):
         if logger.isEnabledFor(_DEBUG):
-            ms = (time.perf_counter() - self.t0) * 1e3
+            ms = (_perf_counter() - self.t0) * 1e3
             status = "error" if exc[0] else "ok"
             event(self.name, phase="end", status=status, ms=round(ms, 2), **self.fields)
         return False
+
+
+# -- timed spans -------------------------------------------------------------
+# Phase attribution (device.extract, device.h2d, device.kernel,
+# device.readback, device.materialize, ...): like the counters these always
+# accumulate — two perf_counter reads and a dict update per span — so the
+# bench can export wall-time breakdowns without tracing enabled. An
+# ``event`` line is additionally emitted when tracing is on.
+
+timings: dict = {}  # name -> [total_seconds, count]
+
+
+class time:  # noqa: A001 — the public name IS trace.time
+    """``with trace.time("device.kernel", rows=n):`` — accumulate wall time
+    under the named phase in ``trace.timings``."""
+
+    __slots__ = ("name", "fields", "t0")
+
+    def __init__(self, name: str, **fields):
+        self.name = name
+        self.fields = fields
+        self.t0 = 0.0
+
+    def __enter__(self):
+        self.t0 = _perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = _perf_counter() - self.t0
+        slot = timings.get(self.name)
+        if slot is None:
+            timings[self.name] = [dt, 1]
+        else:
+            slot[0] += dt
+            slot[1] += 1
+        if logger.isEnabledFor(_DEBUG):
+            event(self.name, ms=round(dt * 1e3, 3), **self.fields)
+        return False
+
+
+def reset_timers() -> None:
+    timings.clear()
+
+
+def timing_summary() -> dict:
+    """{name: {"s": total seconds, "n": span count}} snapshot."""
+    return {k: {"s": round(v[0], 6), "n": v[1]} for k, v in timings.items()}

@@ -388,6 +388,77 @@ def main():
     }
     note(f"fanin: {results['fanin']}")
 
+    # ---- config 2b: incremental device merge (persistent DeviceDoc) --------
+    # K small deltas (one live replica typing against a large resident doc)
+    # applied through the incremental append + dirty-set re-resolution path;
+    # the divisor is the from-scratch extract+resolve at the SAME final
+    # state. p50 per-delta latency is the headline (the first delta pays the
+    # new-actor rank remap; the median is the steady state the sync path
+    # sees). Device-phase spans (trace.time) are exported as phases_s.
+    from automerge_tpu import trace as T
+
+    inc_k = env_int("BENCH_INC_DELTAS", 16)
+    inc_ops = env_int("BENCH_INC_OPS", 250)
+    inc = {}
+    try:
+        deltas = W.synth_delta_chain(base, trace, inc_k, inc_ops, base_edits)
+        resident_changes = list(base.changes)
+        final_changes = resident_changes + [c for b in deltas for c in b]
+        _, _, (t_fex, t_fmg) = device_merge_timed(final_changes, reps)
+        t_scratch = t_fex + t_fmg
+        dev = DeviceDoc.resolve(OpLog.from_changes(resident_changes))
+        # clean per-config phase attribution WITHOUT losing the whole-run
+        # totals the top-level trace_timings reports: stash + merge back
+        saved_timings = {k: list(v) for k, v in T.timings.items()}
+        T.reset_timers()
+        lats = []
+        for b in deltas:
+            t0 = time.perf_counter()
+            dev.apply_changes(b)
+            lats.append(time.perf_counter() - t0)
+        full = DeviceDoc.resolve(OpLog.from_changes(final_changes))
+        assert dev.text(base.text_exid) == full.text(base.text_exid), (
+            "incremental/full divergence"
+        )
+        lat = sorted(lats)
+        p50 = lat[len(lat) // 2]
+        delta_ops = sum(len(c.ops) for b in deltas for c in b) / max(
+            len(deltas), 1
+        )
+        inc = {
+            "deltas": len(deltas),
+            "ops_per_delta": int(delta_ops),
+            "resident_ops": dev.log.n,
+            "p50_delta_latency_s": round(p50, 5),
+            "max_delta_latency_s": round(lat[-1], 5),
+            "delta_ops_per_sec": round(delta_ops / p50, 1),
+            "from_scratch_s": round(t_scratch, 4),
+            "speedup_vs_rebuild": round(t_scratch / p50, 2),
+            "phases_s": {
+                k: v["s"] for k, v in T.timing_summary().items()
+            },
+            "counters": {
+                k: v
+                for k, v in T.counters.items()
+                if k.startswith(("oplog.", "device.", "extract."))
+            },
+        }
+        for k, v in T.timings.items():
+            s = saved_timings.setdefault(k, [0.0, 0])
+            s[0] += v[0]
+            s[1] += v[1]
+        T.timings.clear()
+        T.timings.update(saved_timings)
+        del dev, full, deltas, final_changes
+    except Exception as e:  # noqa: BLE001 — degrade, record, continue
+        import traceback
+
+        tb = traceback.format_exc()
+        inc = {"incremental_error": repr(e)[:500]}
+        print(f"incremental config failed:\n{tb}", file=sys.stderr, flush=True)
+    results["incremental"] = inc
+    note(f"incremental: {results['incremental']}")
+
     # ---- config 3: Map+Counter commutative merge ---------------------------
     # BASELINE.json size: 10k actors x 1k increments = ~10M ops
     mc_actors = env_int("BENCH_MC_ACTORS", 10_000)
@@ -589,6 +660,10 @@ def main():
         "unit": "ops/s",
         "vs_baseline": results["fanin"]["vs_baseline"],
         "configs": results,
+        # cumulative device-phase attribution across the whole run
+        # (trace.time spans: device.extract / h2d / kernel / readback /
+        # materialize, merge.host)
+        "trace_timings": T.timing_summary(),
     }
     print(json.dumps(out))
 

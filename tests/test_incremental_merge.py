@@ -1,0 +1,323 @@
+"""Differential tests for the incremental device merge path.
+
+A persistent DeviceDoc fed deltas through ``apply_changes`` (OpLog splice +
+dirty-set / delta re-resolution) must be indistinguishable from a
+from-scratch ``OpLog.from_changes`` + full resolution at every step: same
+reads, same patches, same heads, same historical ``at(heads)`` views — for
+randomized seeded interleavings of change batches, duplicate re-delivery,
+and out-of-order (dependency-gapped) delivery.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from automerge_tpu.api import AutoDoc
+from automerge_tpu.ops import DeviceDoc, OpLog
+from automerge_tpu.types import ActorId, ObjType, ScalarValue
+
+
+def actor(i: int) -> ActorId:
+    return ActorId(bytes([i]) * 16)
+
+
+def build_base():
+    base = AutoDoc(actor=actor(1))
+    t = base.put_object("_root", "t", ObjType.TEXT)
+    base.splice_text(t, 0, 0, "the quick brown fox")
+    lst = base.put_object("_root", "l", ObjType.LIST)
+    for i in range(5):
+        base.insert(lst, i, i * 10)
+    base.put("_root", "c", ScalarValue("counter", 5))
+    base.put("_root", "k", "base")
+    base.commit()
+    return base, t, lst
+
+
+def patches_repr(dev):
+    return [
+        (p.obj, tuple(p.path), type(p.action).__name__, str(p.action.__dict__))
+        for p in dev.make_patches()
+    ]
+
+
+def assert_same_doc(dev, full, heads_to_check=()):
+    assert dev.hydrate() == full.hydrate()
+    assert sorted(dev.current_heads()) == sorted(full.current_heads())
+    assert patches_repr(dev) == patches_repr(full)
+    for h in heads_to_check:
+        assert dev.at(h).hydrate() == full.at(h).hydrate()
+
+
+def edit_fork(f, t, lst, rng, tag):
+    """A few random edits + commit on fork ``f``."""
+    ln = f.length(t)
+    pos = rng.randrange(0, max(ln, 1))
+    if rng.random() < 0.3 and ln > 1:
+        f.splice_text(t, min(pos, ln - 1), 1, "")
+    else:
+        f.splice_text(t, pos, 0, f"<{tag}>")
+    r = rng.random()
+    if r < 0.3:
+        f.increment("_root", "c", rng.randrange(1, 5))
+    elif r < 0.6:
+        f.put("_root", f"k{rng.randrange(3)}", tag)
+    elif f.length(lst):
+        if rng.random() < 0.5:
+            f.insert(lst, rng.randrange(0, f.length(lst) + 1), tag)
+        else:
+            f.delete(lst, rng.randrange(0, f.length(lst)))
+    f.commit()
+
+
+@pytest.mark.parametrize("seed", [0, 1, 7])
+def test_random_interleavings_match_from_scratch(seed):
+    rng = random.Random(seed)
+    base, t, lst = build_base()
+    base_changes = [a.stored for a in base.doc.history]
+    dev = DeviceDoc.resolve(OpLog.from_changes(base_changes))
+
+    # several divergent forks editing concurrently, synced through one host
+    forks = [base.fork(actor=actor(10 + i)) for i in range(3)]
+    host = base
+    seen = {c.hash for c in base_changes}
+    mid_heads = []
+    for rnd in range(6):
+        f = forks[rng.randrange(len(forks))]
+        edit_fork(f, t, lst, rng, f"{seed}.{rnd}")
+        delta = [
+            c for c in (a.stored for a in f.doc.history) if c.hash not in seen
+        ]
+        seen.update(c.hash for c in delta)
+        # deliver in random batch splits, occasionally with duplicates
+        rng.shuffle(delta)
+        while delta:
+            k = rng.randrange(1, len(delta) + 1)
+            batch = delta[:k]
+            delta = delta[k:]
+            if rng.random() < 0.3:
+                batch = batch + [batch[0]]  # duplicate re-delivery
+            dev.apply_changes(batch)
+        host.apply_changes(
+            [a.stored for a in f.doc.history if a.stored.hash is not None]
+        )
+        # forks converge through the host so later edits see merged state
+        for g in forks:
+            g.merge(host)
+        full = DeviceDoc.resolve(
+            OpLog.from_changes([a.stored for a in host.doc.history])
+        )
+        if rnd == 2:
+            mid_heads = full.current_heads()
+        assert dev.pending_changes() == 0
+        assert_same_doc(dev, full, [mid_heads] if mid_heads else [])
+        assert dev.text(t) == host.text(t)
+
+
+def test_out_of_order_delivery_buffers_until_deps_arrive():
+    base, t, lst = build_base()
+    base_changes = [a.stored for a in base.doc.history]
+    dev = DeviceDoc.resolve(OpLog.from_changes(base_changes))
+    f = base.fork(actor=actor(9))
+    seen = {c.hash for c in base_changes}
+    chain = []
+    for i in range(4):
+        f.splice_text(t, 0, 0, f"{i}:")
+        f.commit()
+        delta = [
+            c for c in (a.stored for a in f.doc.history) if c.hash not in seen
+        ]
+        seen.update(c.hash for c in delta)
+        chain.extend(delta)
+    # deliver newest-first: everything but the first must buffer
+    for ch in reversed(chain[1:]):
+        dev.apply_changes([ch])
+    assert dev.pending_changes() == len(chain) - 1
+    dev.apply_changes([chain[0]])  # the gap fills; all integrate
+    assert dev.pending_changes() == 0
+    full = DeviceDoc.resolve(
+        OpLog.from_changes(base_changes + chain)
+    )
+    assert_same_doc(dev, full)
+    assert dev.text(t) == f.text(t)
+
+
+def test_incremental_historical_views_and_diff():
+    base, t, lst = build_base()
+    base_changes = [a.stored for a in base.doc.history]
+    dev = DeviceDoc.resolve(OpLog.from_changes(base_changes))
+    heads0 = dev.current_heads()
+    f = base.fork(actor=actor(5))
+    seen = {c.hash for c in base_changes}
+    for i in range(3):
+        f.splice_text(t, f.length(t), 0, f"+{i}")
+        f.increment("_root", "c", 1)
+        f.commit()
+        delta = [
+            c for c in (a.stored for a in f.doc.history) if c.hash not in seen
+        ]
+        seen.update(c.hash for c in delta)
+        dev.apply_changes(delta)
+    full = DeviceDoc.resolve(
+        OpLog.from_changes([a.stored for a in f.doc.history])
+    )
+    assert dev.at(heads0).hydrate() == full.at(heads0).hydrate()
+    d1 = [(p.obj, type(p.action).__name__) for p in dev.diff(heads0)]
+    d2 = [(p.obj, type(p.action).__name__) for p in full.diff(heads0)]
+    assert d1 == d2
+    assert dev.at(heads0).text(t) == "the quick brown fox"
+
+
+def test_append_changes_matches_from_changes_columns():
+    """Low-level: spliced OpLog columns are identical to a rebuilt log."""
+    base, t, lst = build_base()
+    base_changes = [a.stored for a in base.doc.history]
+    forks = [base.fork(actor=actor(30 + i)) for i in range(3)]
+    deltas = []
+    seen = {c.hash for c in base_changes}
+    for i, f in enumerate(forks):
+        f.splice_text(t, i, 0, f"({i})")
+        f.put("_root", f"fk{i}", i)
+        f.commit()
+        d = [c for c in (a.stored for a in f.doc.history) if c.hash not in seen]
+        seen.update(c.hash for c in d)
+        deltas.append(d)
+    log = OpLog.from_changes(base_changes)
+    for d in deltas:
+        assert log.append_changes(d) is not None
+    full = OpLog.from_changes(base_changes + [c for d in deltas for c in d])
+    assert log.n == full.n
+    for field in (
+        "id_key", "obj_key", "prop", "elem_ref", "action", "value_tag",
+        "value_int", "width", "mark_name_idx", "obj_dense",
+    ):
+        assert np.array_equal(
+            np.asarray(getattr(log, field)), np.asarray(getattr(full, field))
+        ), field
+    assert np.array_equal(
+        np.asarray(log.insert, bool), np.asarray(full.insert, bool)
+    )
+    assert np.array_equal(log.obj_table, full.obj_table)
+    assert log.props == full.props
+    assert sorted(zip(log.pred_src.tolist(), log.pred_tgt.tolist())) == sorted(
+        zip(full.pred_src.tolist(), full.pred_tgt.tolist())
+    )
+    for i in range(log.n):
+        a, b = log.values[i], full.values[i]
+        assert a.tag == b.tag and a.value == b.value, i
+
+
+def test_new_actor_sorting_before_existing_remaps_in_place():
+    """A delta actor whose bytes sort BEFORE resident actors shifts every
+    packed-id rank; the resident DeviceDoc (incl. its object-type cache)
+    must follow the monotone remap, not rebuild."""
+    base, t, lst = build_base()  # base actor is \x01*16
+    mid = base.fork(actor=actor(200))
+    mid.splice_text(t, 0, 0, "Z")
+    mid.commit()
+    base_changes = [a.stored for a in mid.doc.history]
+    dev = DeviceDoc.resolve(OpLog.from_changes(base_changes))
+    f = mid.fork(actor=ActorId(b"\x00" + b"\x99" * 15))  # sorts first
+    f.splice_text(t, 1, 0, "!")
+    f.put("_root", "early", 1)
+    sub = f.put_object("_root", "m", ObjType.MAP)
+    f.put(sub, "x", 2)
+    f.commit()
+    seen = {c.hash for c in base_changes}
+    delta = [c for c in (a.stored for a in f.doc.history) if c.hash not in seen]
+    dev.apply_changes(delta)
+    full = DeviceDoc.resolve(
+        OpLog.from_changes([a.stored for a in f.doc.history])
+    )
+    assert_same_doc(dev, full)
+    assert dev.text(t) == f.text(t)
+    assert dev.object_type(dev.get("_root", "m")[0][2]) == ObjType.MAP
+
+
+def test_append_duplicate_batch_is_noop():
+    base, t, lst = build_base()
+    base_changes = [a.stored for a in base.doc.history]
+    f = base.fork(actor=actor(40))
+    f.splice_text(t, 0, 0, "dup")
+    f.commit()
+    delta = [
+        c
+        for c in (a.stored for a in f.doc.history)
+        if c.hash not in {b.hash for b in base_changes}
+    ]
+    log = OpLog.from_changes(base_changes)
+    info = log.append_changes(delta)
+    assert info is not None and info.n_new > 0
+    n = log.n
+    info2 = log.append_changes(delta)
+    assert info2 is not None and info2.n_new == 0 and log.n == n
+
+
+def test_sync_session_feeds_device_doc():
+    from automerge_tpu.sync.session import SyncSession
+
+    base, t, lst = build_base()
+    saved = base.save()
+    a_doc = AutoDoc.load(saved)
+    b_doc = AutoDoc.load(saved)
+    a_doc.splice_text(t, 0, 0, "A>")
+    a_doc.commit()
+    b_dev = DeviceDoc.resolve(
+        OpLog.from_changes([x.stored for x in b_doc.doc.history])
+    )
+    sa = SyncSession(a_doc, epoch=1)
+    sb = SyncSession(b_doc, epoch=2, device_doc=b_dev)
+    now = 0.0
+    for _ in range(20):
+        fa = sa.poll(now)
+        if fa is not None:
+            sb.receive(fa, now)
+        fb = sb.poll(now)
+        if fb is not None:
+            sa.receive(fb, now)
+        now += 1.0
+        if sa.converged() and sb.converged():
+            break
+    assert sa.converged() and sb.converged()
+    # the resident device doc tracked the host through the session
+    assert b_dev.text(t) == b_doc.text(t) == a_doc.text(t)
+    full = DeviceDoc.resolve(
+        OpLog.from_changes([x.stored for x in b_doc.doc.history])
+    )
+    assert_same_doc(b_dev, full)
+
+
+def test_lazy_values_cache_is_bounded():
+    from automerge_tpu.ops.extract import LazyValues
+
+    code = np.full(100, 4, np.int32)  # int sleb
+    off = np.arange(100, dtype=np.int64)
+    ln = np.ones(100, np.int64)
+    raw = bytes(range(100))
+    lv = LazyValues(code, off, ln, raw, cap=10)
+    for i in range(100):
+        lv[i]
+    assert len(lv.cache) <= 10
+    assert lv.misses == 100 and lv.hits == 0
+    lv[99]
+    assert lv.hits == 1
+    s = lv.stats()
+    assert s["cap"] == 10 and s["size"] <= 10
+
+
+def test_change_hash_extraction_cache_hits_on_redelivery():
+    import copy
+
+    from automerge_tpu import trace
+    from automerge_tpu.ops.assemble import ensure_change_cols
+
+    base, t, lst = build_base()
+    ch = [a.stored for a in base.doc.history][0]
+    fresh = copy.copy(ch)
+    fresh.cached_cols = None  # a re-parsed change: same hash, no memo
+    before = trace.counters.get("extract.change_cache_hit", 0)
+    ensure_change_cols([ch])  # populates the hash cache
+    ensure_change_cols([fresh])
+    assert trace.counters.get("extract.change_cache_hit", 0) > before
+    assert fresh.cached_cols is not None
