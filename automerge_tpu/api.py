@@ -430,6 +430,18 @@ class AutoDoc:
         return self.doc.save_incremental_after(heads)
 
     @classmethod
+    def open(cls, path, **kw):
+        """Open (or create) a crash-safe durable document at ``path``
+        (storage/durable.py): commits and sync-absorbed changes are
+        journaled before acking, the journal compacts into atomic
+        snapshots, and reopening replays snapshot + journal with
+        torn-tail recovery. Returns a ``DurableDocument`` that delegates
+        the whole AutoDoc surface."""
+        from .storage.durable import DurableDocument
+
+        return DurableDocument.open(path, doc_factory=cls, **kw)
+
+    @classmethod
     def load(
         cls,
         data: bytes,

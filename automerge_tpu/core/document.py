@@ -131,6 +131,11 @@ class Document:
         import weakref
 
         self.open_transactions = weakref.WeakSet()
+        # called with each StoredChange as it enters history — the durable
+        # write path (storage/durable.py) journals through this hook so a
+        # commit/merge/sync-receive only acks once the change is on disk.
+        # An exception here propagates: the caller must not ack.
+        self.change_listeners = []
 
     def _live_transaction(self):
         """The live (un-done) manual transaction, if any."""
@@ -472,6 +477,17 @@ class Document:
             self.deps.discard(dep)
         self.deps.add(applied.hash)
         self.max_op = max(self.max_op, applied.stored.max_op)
+        if self.change_listeners:
+            try:
+                for cb in self.change_listeners:
+                    cb(applied.stored)
+            except Exception:
+                # the change is in history but the caller's op-store
+                # bookkeeping for it will never complete (the exception
+                # unwinds through it): force a rebuild from history so
+                # reads stay consistent with the heads we now advertise
+                self._ops_stale = True
+                raise
 
     # -- transactions ------------------------------------------------------
 
@@ -1198,6 +1214,16 @@ class Document:
         for c in self.get_changes(heads):
             out += c.raw_bytes
         return bytes(out)
+
+    @classmethod
+    def open(cls, path, **kw):
+        """Open (or create) a crash-safe durable document at ``path``: every
+        committed or absorbed change is journaled before acking, the journal
+        compacts into atomic snapshots, and reopening replays snapshot +
+        journal with torn-tail recovery (storage/durable.py)."""
+        from ..storage.durable import DurableDocument
+
+        return DurableDocument.open(path, doc_factory=cls, **kw)
 
     @classmethod
     def load(

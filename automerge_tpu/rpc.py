@@ -30,6 +30,13 @@ even mid-request — is a clean shutdown. ``configure`` sets
 expose the resilient retry/backoff/reset sync sessions (sync/session.py)
 for lossy client links, and ``load`` accepts ``onError: "salvage"`` to
 recover damaged saves (the response then carries a ``salvage`` report).
+
+Durability: ``python -m automerge_tpu.rpc --durable DIR`` enables
+``openDurable {"name": ...}`` — each named document persists under
+``DIR/<name>`` through the crash-safe journal + snapshot layer
+(storage/durable.py), so every committed or sync-absorbed change is on
+disk before the response goes out; ``durableInfo`` / ``durableCompact``
+expose the journal state.
 """
 
 from __future__ import annotations
@@ -51,6 +58,12 @@ from .types import ActorId, ObjType, ScalarValue
 # being buffered whole (configurable via the ``configure`` method)
 DEFAULT_MAX_REQUEST_BYTES = 32 << 20
 DEFAULT_SYNC_TIMEOUT_MS = 5000
+
+# durable doc names become directory names under --durable DIR: one safe
+# path component, no leading dot
+import re as _re
+
+_DURABLE_NAME_RE = _re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
 
 _OBJTYPES = {"map": ObjType.MAP, "list": ObjType.LIST, "text": ObjType.TEXT,
              "table": ObjType.TABLE}
@@ -116,6 +129,7 @@ class RpcServer:
         self,
         max_request_bytes: int = DEFAULT_MAX_REQUEST_BYTES,
         sync_timeout_ms: int = DEFAULT_SYNC_TIMEOUT_MS,
+        durable_dir: Optional[str] = None,
     ):
         self._docs: Dict[int, AutoDoc] = {}
         self._syncs: Dict[int, SyncState] = {}
@@ -124,6 +138,10 @@ class RpcServer:
         self._next = 1
         self.max_request_bytes = max_request_bytes
         self.sync_timeout_ms = sync_timeout_ms
+        # --durable DIR mode: named documents persist under DIR/<name> via
+        # the crash-safe journal + snapshot layer (storage/durable.py)
+        self.durable_dir = durable_dir
+        self._durable_names: Dict[str, int] = {}  # name -> open handle
 
     # -- handle plumbing ----------------------------------------------------
 
@@ -189,9 +207,100 @@ class RpcServer:
                 "maxRequestBytes": self.max_request_bytes}
 
     def free(self, p):
-        self._docs.pop(p["doc"], None)
+        doc = self._docs.pop(p["doc"], None)
         self._patched.discard(p["doc"])
+        if doc is not None and hasattr(doc, "journal"):  # durable wrapper
+            # drop the name mapping BEFORE closing: if close raises, the
+            # name must not stay pointed at a dead handle
+            self._durable_names = {
+                n: h for n, h in self._durable_names.items() if h != p["doc"]
+            }
+            doc.close()
         return None
+
+    # -- durable documents (--durable DIR mode) -----------------------------
+
+    def _durable_path(self, name: str) -> str:
+        import os
+
+        if self.durable_dir is None:
+            raise ValueError("server is not running in --durable mode")
+        if not isinstance(name, str) or not _DURABLE_NAME_RE.match(name):
+            raise ValueError(f"invalid durable doc name {name!r}")
+        return os.path.join(self.durable_dir, name)
+
+    def openDurable(self, p):
+        """Open (or create) the named durable document under the server's
+        --durable directory; reopening an already-open name returns the
+        same handle (two live journals on one file would corrupt it)."""
+        name = p.get("name")
+        path = self._durable_path(name)
+        h = self._durable_names.get(name)
+        if h is not None and h in self._docs:
+            # a cached handle must not silently override the caller's
+            # requested durability: error on a policy mismatch
+            live = self._docs[h]
+            want = p.get("fsync")  # omitted = don't-care, like textEncoding
+            if want is not None and want != live.journal.fsync_policy:
+                raise ValueError(
+                    f"durable doc {name!r} is already open with "
+                    f"fsync={live.journal.fsync_policy!r}, not {want!r}"
+                )
+            want_enc = p.get("textEncoding")
+            # normalize: a doc opened without an explicit encoding stores
+            # None, which MEANS the process default — not a conflict with
+            # a client naming that same default explicitly
+            from .types import get_text_encoding
+
+            have_enc = live.doc.text_encoding or get_text_encoding()
+            if want_enc is not None and want_enc != have_enc:
+                raise ValueError(
+                    f"durable doc {name!r} is already open with "
+                    f"textEncoding={have_enc!r}, not {want_enc!r}"
+                )
+            return {"doc": h}
+        dd = AutoDoc.open(
+            path,
+            fsync=p.get("fsync", "always"),
+            text_encoding=p.get("textEncoding"),
+        )
+        h = self._reg(self._docs, dd)
+        self._durable_names[name] = h
+        return {"doc": h}
+
+    def _durable_doc(self, p):
+        doc = self._doc(p)
+        if not hasattr(doc, "journal"):
+            raise ValueError(f"doc handle {p.get('doc')} is not durable")
+        return doc
+
+    def durableCompact(self, p):
+        doc = self._durable_doc(p)
+        compacted = doc.compact()
+        return {"compacted": compacted,
+                "journalRecords": doc.journal.record_count}
+
+    def durableInfo(self, p):
+        doc = self._durable_doc(p)
+        return {
+            "path": doc.path,
+            "journalRecords": doc.journal.record_count,
+            "journalBytes": doc.journal.size_bytes,
+            "fsync": doc.journal.fsync_policy,
+        }
+
+    def close_durables(self) -> None:
+        """Flush and close every open durable document (their close()
+        commits pending autocommit edits and releases the journal locks);
+        serve() calls this on every exit path."""
+        self._durable_names.clear()
+        for h, doc in list(self._docs.items()):
+            if hasattr(doc, "journal"):
+                try:
+                    doc.close()
+                except Exception:
+                    pass  # shutdown must not die half-way through the list
+                self._docs.pop(h, None)
 
     def fork(self, p):
         doc = self._doc(p)
@@ -447,6 +556,7 @@ class RpcServer:
         "syncSessionNew", "syncSessionRestore", "syncSessionPoll",
         "syncSessionReceive", "syncSessionStats", "syncSessionEncode",
         "syncSessionFree",
+        "openDurable", "durableCompact", "durableInfo",
     })
 
     def handle(self, req: dict) -> dict:
@@ -542,26 +652,48 @@ class RpcServer:
                         if not tail or tail.endswith("\n"):
                             break
                 return line
-        while True:
-            try:
-                line = readline()
-            except Exception:
-                return  # broken pipe / undecodable stream: clean shutdown
-            if not line:  # EOF (including mid-request cut-offs)
-                return
-            resp, stop = self._handle_line(line)
-            if resp is not None:
+        try:
+            while True:
                 try:
-                    stdout.write(self._encode_response(resp) + "\n")
-                    stdout.flush()
+                    line = readline()
                 except Exception:
-                    return  # client went away mid-response: clean shutdown
-            if stop:
-                return
+                    return  # broken pipe / undecodable stream: clean shutdown
+                if not line:  # EOF (including mid-request cut-offs)
+                    return
+                resp, stop = self._handle_line(line)
+                if resp is not None:
+                    try:
+                        stdout.write(self._encode_response(resp) + "\n")
+                        stdout.flush()
+                    except Exception:
+                        return  # client went away mid-response: shutdown
+                if stop:
+                    return
+        finally:
+            # every exit path flushes durable docs: a client that vanishes
+            # without free() must not strand a pending autocommit tx (or
+            # the journal flocks) any more than a clean shutdown would
+            self.close_durables()
 
 
-def main() -> int:
-    RpcServer().serve()
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="automerge_tpu.rpc",
+        description="line-delimited JSON-RPC frontend over stdio",
+    )
+    ap.add_argument(
+        "--durable", metavar="DIR", default=None,
+        help="persist named documents (openDurable) as crash-safe "
+             "journal+snapshot directories under DIR",
+    )
+    args = ap.parse_args(argv)
+    if args.durable:
+        import os
+
+        os.makedirs(args.durable, exist_ok=True)
+    RpcServer(durable_dir=args.durable).serve()
     return 0
 
 

@@ -1,0 +1,442 @@
+"""Crash-safe durable documents: journal + atomic snapshots + recovery.
+
+``DurableDocument`` wraps a document (core ``Document`` or autocommit
+``AutoDoc``) with a durable write path:
+
+* every change that enters the history — a committed transaction, a
+  merge, a change absorbed from sync — is appended to an append-only
+  write-ahead journal (storage/journal.py) *before* the mutating call
+  returns, so an acked change is on disk (durably, under
+  ``fsync="always"``);
+* when the journal grows past ``compact_max_records`` /
+  ``compact_max_bytes``, the full document is written to a temp file,
+  fsynced, atomically renamed over the snapshot, the directory entry is
+  fsynced, and only then is the journal truncated — recovery time stays
+  bounded by the compaction thresholds, never by the document's age;
+* ``open()`` replays snapshot + journal: the snapshot loads in salvage
+  mode (a damaged one degrades instead of refusing), the journal
+  truncates at the first torn record, and
+  ``trace.count("journal.replayed_records" / "journal.truncated_tail")``
+  report what recovery did.
+
+The on-disk layout is a directory::
+
+    <path>/snapshot.am    full document save (atomic-rename target)
+    <path>/journal.waj    append-only change journal
+
+Use via ``Document.open(path)`` / ``AutoDoc.open(path)``; every other
+document method delegates, with the ack-point methods (commit /
+apply_changes / merge / load_incremental / receive_sync_message) also
+checking compaction thresholds on the way out.
+
+Small latest-wins metadata rides in the journal as ``REC_META`` records
+(re-appended after every compaction): the sync layer persists each
+peer's ``shared_heads`` + epoch there (``attach_sync_session`` /
+``restore_sync_session``), so a restarted durable peer resumes an
+interrupted sync through the epoch/reset handshake instead of always
+renegotiating from scratch.
+
+Failure semantics: a journal append that raises leaves the in-memory
+document *ahead of* disk — indistinguishable from a crash at that
+instant, which is exactly the state recovery is built for.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import posixpath
+from typing import Dict, List, Optional
+
+from .. import trace
+from ..utils.leb128 import decode_uleb, encode_uleb
+from .change import parse_change
+from .journal import (
+    Journal,
+    OS_FS,
+    REC_CHANGE,
+    REC_META,
+    decode_meta,
+    encode_meta,
+)
+
+SNAPSHOT_NAME = "snapshot.am"
+JOURNAL_NAME = "journal.waj"
+
+_SYNC_META_PREFIX = "sync/"
+
+
+class DurableDocument:
+    """A document whose changes survive the process. See module docstring."""
+
+    # methods that ack durable state to a caller: wrapped so compaction
+    # thresholds are checked after each (never DURING — a snapshot taken
+    # mid-batch from the listener could race the op-store rebuild)
+    _ACK_METHODS = frozenset(
+        {"commit", "apply_changes", "merge", "load_incremental",
+         "receive_sync_message"}
+    )
+
+    def __init__(self, host, core, path, journal, *, fs,
+                 compact_max_records: int, compact_max_bytes: int):
+        self._host = host  # the wrapped Document or AutoDoc
+        self._core = core  # the underlying core Document
+        self.path = path
+        self._fs = fs
+        self._journal = journal
+        self.compact_max_records = compact_max_records
+        self.compact_max_bytes = compact_max_bytes
+        self._meta: Dict[str, bytes] = {}
+        self._compacting = False
+        self._closed = False
+        # set when a journal append failed AFTER its change entered the
+        # in-memory history: memory is ahead of disk, so acking anything
+        # more would strand dependents. compact() repairs (the snapshot
+        # carries the full history) and clears it.
+        self._broken = False
+        # >0 while inside a wrapped ack-point call: per-change fsyncs are
+        # deferred to ONE policy_sync at the ack boundary (same durability
+        # guarantee — on disk before the call returns — minus N-1 fsyncs
+        # for an N-change merge/sync batch)
+        self._ack_depth = 0
+        self.device_doc = None  # set by open(device=True)
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def open(
+        cls,
+        path: str,
+        *,
+        doc_factory=None,
+        actor=None,
+        text_encoding=None,
+        fsync: str = "always",
+        fsync_interval: int = 16,
+        compact_max_records: int = 1024,
+        compact_max_bytes: int = 4 << 20,
+        device: bool = False,
+        fs=None,
+    ) -> "DurableDocument":
+        """Open (or create) the durable document directory at ``path``.
+
+        ``doc_factory`` picks the wrapped surface — ``AutoDoc`` (default)
+        or core ``Document``. ``device=True`` additionally recovers a
+        resident ``DeviceDoc``: built once from the snapshot, then warmed
+        with the replayed journal changes through the incremental
+        ``OpLog.append_changes`` path (``trace.time("device.recover")``).
+        """
+        if doc_factory is None:
+            from ..api import AutoDoc
+
+            doc_factory = AutoDoc
+        fs = fs or OS_FS
+        path = str(path)
+        fs.makedirs(path)
+        # the doc directory's OWN entry in its parent must be durable, or
+        # a crash right after creation loses the whole directory no matter
+        # how diligently the files inside it were fsynced
+        fs.sync_dir(posixpath.dirname(path.rstrip("/")) or ".")
+        host = doc_factory(actor=actor, text_encoding=text_encoding)
+        core = host.doc if hasattr(host, "doc") else host
+
+        with trace.time("durable.open"):
+            # the journal's lock comes FIRST: reading the snapshot before
+            # holding it could pair an old snapshot with a journal another
+            # process compacted in between, silently losing acked changes
+            journal, records, tail = Journal.open(
+                posixpath.join(path, JOURNAL_NAME),
+                fs=fs, fsync=fsync, fsync_interval=fsync_interval,
+            )
+            try:
+                return cls._recover(
+                    host, core, path, journal, records, fs=fs, device=device,
+                    compact_max_records=compact_max_records,
+                    compact_max_bytes=compact_max_bytes,
+                )
+            except Exception:
+                journal.close()  # release the flock; don't wedge the dir
+                raise
+
+    @classmethod
+    def _recover(cls, host, core, path, journal, records, *, fs, device,
+                 compact_max_records, compact_max_bytes) -> "DurableDocument":
+        """Snapshot load + journal replay, under the already-held lock."""
+        snap_path = posixpath.join(path, SNAPSHOT_NAME)
+        if fs.exists(snap_path):
+            core.load_incremental(
+                fs.read_bytes(snap_path), on_partial="salvage"
+            )
+        dev = None
+        if device and core.history:
+            from ..ops.device_doc import DeviceDoc
+            from ..ops.oplog import OpLog
+
+            with trace.time("device.recover", phase="snapshot"):
+                dev = DeviceDoc.resolve(
+                    OpLog.from_changes([a.stored for a in core.history])
+                )
+        meta: Dict[str, bytes] = {}
+        replayed: List = []
+        for rec in records:
+            if rec.rec_type == REC_CHANGE:
+                try:
+                    change, _ = parse_change(rec.payload)
+                except Exception:
+                    # CRC-valid record with an unparseable chunk body:
+                    # treat like a salvage drop, keep replaying
+                    trace.count("journal.rejected_records")
+                    continue
+                replayed.append(change)
+            elif rec.rec_type == REC_META:
+                name, blob = decode_meta(rec.payload)
+                meta[name] = blob
+        trace.count("journal.replayed_records", n=len(replayed))
+        if replayed:
+            core.apply_changes(replayed)
+            if device:
+                from ..ops.device_doc import DeviceDoc
+                from ..ops.oplog import OpLog
+
+                with trace.time("device.recover", changes=len(replayed)):
+                    if dev is None:
+                        dev = DeviceDoc.resolve(OpLog.from_changes(replayed))
+                    else:
+                        dev.apply_changes(replayed)
+
+        dd = cls(
+            host, core, path, journal, fs=fs,
+            compact_max_records=compact_max_records,
+            compact_max_bytes=compact_max_bytes,
+        )
+        dd._meta = meta
+        dd.device_doc = dev
+        core.change_listeners.append(dd._on_change)
+        return dd
+
+    # -- delegation ----------------------------------------------------------
+
+    def __getattr__(self, name):
+        # only reached for names this wrapper does not define itself
+        attr = getattr(object.__getattribute__(self, "_host"), name)
+        if name in DurableDocument._ACK_METHODS and callable(attr):
+            def _acked(*a, _attr=attr, **kw):
+                with self.ack_scope():
+                    return _attr(*a, **kw)
+
+            # bound host methods are stable for this instance's lifetime:
+            # memoize the wrapper so hot-path calls (commit per edit) skip
+            # the __getattr__ fallback + closure rebuild from now on
+            self.__dict__[name] = _acked
+            return _acked
+        return attr
+
+    @contextlib.contextmanager
+    def ack_scope(self):
+        """Context manager marking one ack boundary: per-change fsyncs
+        inside it are deferred to a single policy fsync (plus a compaction
+        check) on exit — even on error, whatever DID enter history must be
+        durable at ack. The sync session wraps each received message in
+        this when the document is durable."""
+        self._ack_depth += 1
+        try:
+            yield
+        finally:
+            self._ack_depth -= 1
+            # a double fault in append() can poison the journal closed
+            # while the original I/O error is still unwinding — syncing
+            # then would only mask it with 'journal is closed'
+            if not self._journal.closed:
+                self._journal.policy_sync()
+                self.maybe_compact()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- the durable write path ----------------------------------------------
+
+    def _on_change(self, stored) -> None:
+        """Change listener (core/document.py ``_update_history``): journal
+        every change the moment it enters history, before the mutating
+        call acks to its caller."""
+        from .journal import JournalError
+
+        if self._broken:
+            # refusing BEFORE the append keeps every later change un-acked
+            # while memory is ahead of disk — no silently stranded deps
+            raise JournalError(
+                "durable document out of sync with its journal after a "
+                "failed append; compact() or reopen to recover"
+            )
+        raw = stored.raw_bytes
+        if raw is None:
+            from ..errors import AutomergeError
+
+            # the change is already in history: memory is ahead of disk
+            # exactly as in the append-failure case below
+            self._broken = True
+            raise AutomergeError(
+                "durable document received a change without raw bytes"
+            )
+        # inside a wrapped ack call the fsync is deferred to its boundary;
+        # an unwrapped path (e.g. a manual Transaction.commit) syncs here
+        try:
+            self._journal.append(
+                REC_CHANGE, raw, auto_sync=self._ack_depth == 0
+            )
+        except Exception:
+            # the change is already in history (listeners fire after the
+            # bookkeeping): memory is now ahead of disk. Poison until a
+            # compaction re-establishes disk >= memory.
+            self._broken = True
+            raise
+
+    @property
+    def journal(self) -> Journal:
+        return self._journal
+
+    @property
+    def meta(self) -> Dict[str, bytes]:
+        """Latest-wins journal metadata (read the dict, write via set_meta)."""
+        return dict(self._meta)
+
+    def set_meta(self, name: str, blob: bytes) -> None:
+        self._meta[name] = blob
+        # inside an ack scope (e.g. sync-session persistence riding a
+        # received message) the record joins the boundary's single fsync
+        self._journal.append(
+            REC_META, encode_meta(name, blob), auto_sync=self._ack_depth == 0
+        )
+
+    def sync(self) -> None:
+        """Force-fsync the journal regardless of policy."""
+        self._journal.sync()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        # an AutoDoc host may hold a pending autocommit transaction; every
+        # other exit surface (save / sync) auto-commits it, so close must
+        # too — silently dropping acked-looking edits would betray the
+        # whole layer. (A live MANUAL transaction stays the caller's
+        # responsibility, as everywhere else.)
+        try:
+            commit = getattr(self._host, "commit", None)
+            if callable(commit):
+                commit()  # journals through the listener; close syncs below
+        finally:
+            # even if that last commit fails, the journal handle (and its
+            # flock) must be released or the document is wedged for the
+            # life of the process
+            self._closed = True
+            try:
+                self._core.change_listeners.remove(self._on_change)
+            except ValueError:
+                pass
+            self._journal.close()
+
+    # -- compaction ----------------------------------------------------------
+
+    def maybe_compact(self) -> bool:
+        """Compact iff the journal crossed a threshold. Called after every
+        ack-point method; cheap when below threshold."""
+        j = self._journal
+        if (
+            j.record_count <= self.compact_max_records
+            and j.size_bytes <= self.compact_max_bytes
+        ):
+            return False
+        return self.compact()
+
+    def compact(self) -> bool:
+        """Snapshot-then-truncate: write the full save to a temp file,
+        fsync it, atomically rename over the snapshot, fsync the
+        directory entry, then truncate the journal (metadata records are
+        re-appended so they survive). Every step durable before the next
+        — the orderings the crash suite proves are exactly these."""
+        if self._compacting or self._closed or self._journal.closed:
+            # a poisoned-closed journal cannot be truncated: only a reopen
+            # recovers (the snapshot-repair path needs a live journal)
+            return False
+        live = self._core._live_transaction()
+        if live is not None and live.pending_ops():
+            return False  # mid-manual-transaction: defer to the next ack
+        self._compacting = True
+        try:
+            with trace.time("compact.total"):
+                data = self._host.save()
+                snap = posixpath.join(self.path, SNAPSHOT_NAME)
+                tmp = snap + ".tmp"
+                with trace.time("compact.snapshot", bytes=len(data)):
+                    f = self._fs.open(tmp, "wb")
+                    try:
+                        f.write(data)
+                        self._fs.fsync(f)
+                    finally:
+                        f.close()
+                    self._fs.replace(tmp, snap)
+                    self._fs.sync_dir(self.path)
+                with trace.time("compact.truncate"):
+                    self._journal.truncate()
+                    for name, blob in self._meta.items():
+                        self._journal.append(
+                            REC_META, encode_meta(name, blob), auto_sync=False
+                        )
+                    self._journal.sync()
+            trace.count("compact.runs")
+            # the snapshot carries the FULL in-memory history, so disk is
+            # caught up even if a journal append had failed earlier
+            self._broken = False
+            return True
+        finally:
+            self._compacting = False
+
+    # -- sync-session persistence (shared_heads survive restarts) ------------
+
+    @staticmethod
+    def _sync_key(peer: str) -> str:
+        return _SYNC_META_PREFIX + peer
+
+    def attach_sync_session(self, peer: str, session):
+        """Persist ``session``'s shared_heads (plus its epoch) under
+        ``peer`` whenever they change; returns the session."""
+        key = self._sync_key(peer)
+
+        def _persist(encoded: bytes, _sess=session) -> None:
+            body = bytearray()
+            encode_uleb(_sess.epoch, body)
+            body += encoded
+            self.set_meta(key, bytes(body))
+
+        session.persist = _persist
+        return session
+
+    def restore_sync_session(self, peer: str, *, config=None):
+        """Rebuild the sync session for ``peer`` after a restart: the
+        persisted shared_heads seed the state and the epoch is bumped so
+        the surviving peer runs the epoch/reset handshake instead of a
+        full resync. A peer never seen before gets a fresh session."""
+        from ..sync.session import SyncSession
+
+        blob = self._meta.get(self._sync_key(peer))
+        # the session drives the WRAPPER (self): receives and commits hit
+        # the ack path, so batches fsync once and compaction keeps
+        # happening mid-sync
+        if blob is None:
+            sess = SyncSession(self, epoch=1, config=config,
+                               device_doc=self.device_doc)
+        else:
+            epoch, pos = decode_uleb(blob, 0)
+            sess = SyncSession.restore(
+                self, bytes(blob[pos:]), epoch=epoch + 1, config=config
+            )
+            sess.device_doc = self.device_doc
+        self.attach_sync_session(peer, sess)
+        # persist the bumped epoch NOW: a second crash-restart with no
+        # sync progress in between must still present a fresh epoch, or
+        # the survivor's dup suppression eats the new incarnation's frames
+        sess._maybe_persist()
+        return sess

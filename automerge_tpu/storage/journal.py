@@ -1,0 +1,440 @@
+"""Append-only write-ahead journal of change chunks.
+
+The durable write path (storage/durable.py) routes every change through
+this journal before acking; recovery replays it on top of the latest
+snapshot. The format is deliberately dumb — a fixed header followed by a
+flat sequence of CRC-framed records — because torn-write recovery must
+be decidable by a forward scan alone:
+
+    header  := b"AMJ1"
+    record  := checksum (4 bytes) | rec_type (1 byte) | ULEB(len) | payload
+
+The checksum is the first 4 bytes of ``chunk_hash(rec_type, payload)`` —
+the exact machinery that frames automerge chunks (storage/chunk.py), so a
+journal record and a chunk verify identically. Unlike a document save the
+journal never resynchronises past damage: it is append-only, so the first
+record that fails to verify IS the torn tail — everything before it is
+intact, everything after it is dropped and the file is truncated back to
+the valid prefix (``trace.count("journal.truncated_tail")`` reports the
+bytes lost).
+
+Record types:
+
+* ``REC_CHANGE`` (1): payload is a raw change chunk (magic + checksum +
+  type + data), exactly the bytes sync puts on the wire.
+* ``REC_META`` (3): payload is ``ULEB(len(name)) | name | blob`` — small
+  latest-wins key/value state that must ride with the journal (e.g. a
+  sync peer's persisted ``shared_heads``).
+
+Durability is governed by the fsync policy:
+
+* ``"always"``  — fsync after every append (an acked record is durable)
+* ``"interval"``— fsync every ``fsync_interval`` appends (bounded loss)
+* ``"never"``   — no automatic fsync (crash loses the OS write-back
+  window; the journal is still torn-tail-consistent)
+
+All file operations go through an injectable filesystem object (``fs``)
+so the crash-injection harness (storage/crashsim.py) can simulate
+kill-at-every-write-boundary, torn writes, and rename reordering; the
+default ``OS_FS`` is the real OS.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, NamedTuple, Optional, Tuple
+
+from .. import trace
+from ..utils.leb128 import LEBDecodeError, decode_uleb, encode_uleb
+from .chunk import chunk_hash
+
+JOURNAL_MAGIC = b"AMJ1"
+
+REC_CHANGE = 1
+REC_META = 3
+
+_REC_TYPES = frozenset({REC_CHANGE, REC_META})
+
+FSYNC_POLICIES = ("always", "interval", "never")
+
+
+class JournalError(Exception):
+    pass
+
+
+class OsFS:
+    """The real filesystem, behind the narrow interface the durable layer
+    uses (so storage/crashsim.py can substitute a fault-injecting one)."""
+
+    def open(self, path: str, mode: str):
+        return open(path, mode)
+
+    def fsync(self, f) -> None:
+        f.flush()
+        os.fsync(f.fileno())
+
+    def replace(self, src: str, dst: str) -> None:
+        os.replace(src, dst)
+
+    def sync_dir(self, path: str) -> None:
+        """Make preceding renames in ``path`` durable (POSIX dir fsync;
+        best-effort where the platform cannot)."""
+        try:
+            fd = os.open(path, os.O_RDONLY)
+        except OSError:
+            return
+        try:
+            os.fsync(fd)
+        except OSError:
+            pass
+        finally:
+            os.close(fd)
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def getsize(self, path: str) -> int:
+        return os.path.getsize(path)
+
+    def read_bytes(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+    def makedirs(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+
+    def remove(self, path: str) -> None:
+        os.remove(path)
+
+    def lock(self, f) -> None:
+        """Advisory exclusive lock on an open file, released automatically
+        when the process dies (never a stale-lockfile hazard). Raises
+        ``JournalError`` when another live process holds it."""
+        try:
+            import fcntl
+        except ImportError:  # non-POSIX: no cross-process guard
+            return
+        try:
+            fcntl.flock(f.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError as e:
+            raise JournalError(
+                f"journal is locked by another process: {e}"
+            ) from e
+
+
+OS_FS = OsFS()
+
+
+class JournalRecord(NamedTuple):
+    rec_type: int
+    payload: bytes
+    offset: int  # byte position of the record header in the file
+    end: int  # byte position just past the payload
+
+
+class TailReport(NamedTuple):
+    """What a scan found past the valid prefix."""
+
+    valid_bytes: int  # file is intact up to here
+    total_bytes: int  # physical file size at scan time
+    records: int  # records in the valid prefix
+    reason: str  # "" when the file ends exactly on a record boundary
+
+    @property
+    def torn(self) -> bool:
+        return self.valid_bytes < self.total_bytes
+
+    @property
+    def dropped_bytes(self) -> int:
+        return self.total_bytes - self.valid_bytes
+
+
+def encode_record(rec_type: int, payload: bytes) -> bytes:
+    out = bytearray(chunk_hash(rec_type, payload)[:4])
+    out.append(rec_type)
+    encode_uleb(len(payload), out)
+    out += payload
+    return bytes(out)
+
+
+def encode_meta(name: str, blob: bytes) -> bytes:
+    nb = name.encode("utf-8")
+    out = bytearray()
+    encode_uleb(len(nb), out)
+    out += nb
+    out += blob
+    return bytes(out)
+
+
+def decode_meta(payload: bytes) -> Tuple[str, bytes]:
+    n, pos = decode_uleb(payload, 0)
+    if pos + n > len(payload):
+        raise JournalError("meta record name runs past payload end")
+    return payload[pos : pos + n].decode("utf-8"), bytes(payload[pos + n :])
+
+
+def scan_records(data: bytes) -> Tuple[List[JournalRecord], TailReport]:
+    """Forward scan: every verifiable record plus where the tail tore.
+
+    Read-only — callers that own the file decide whether to truncate
+    (``Journal.open`` does; ``journal-info`` reports without modifying).
+    """
+    n = len(data)
+    if n < len(JOURNAL_MAGIC):
+        # includes the 0-byte file a crashed create leaves behind: the
+        # caller re-initialises it with a fresh header
+        return [], TailReport(0, n, 0, "missing journal header")
+    if data[: len(JOURNAL_MAGIC)] != JOURNAL_MAGIC:
+        return [], TailReport(0, n, 0, "bad journal magic")
+    records: List[JournalRecord] = []
+    pos = len(JOURNAL_MAGIC)
+    reason = ""
+    while pos < n:
+        # checksum(4) + type(1) before the length field
+        if pos + 5 > n:
+            reason = "truncated record header"
+            break
+        checksum = bytes(data[pos : pos + 4])
+        rec_type = data[pos + 4]
+        if rec_type not in _REC_TYPES:
+            reason = f"unknown record type {rec_type}"
+            break
+        try:
+            length, body = decode_uleb(data, pos + 5)
+        except LEBDecodeError:
+            reason = "truncated record length"
+            break
+        end = body + length
+        if end > n:
+            reason = "record payload extends past end of file"
+            break
+        payload = bytes(data[body:end])
+        if chunk_hash(rec_type, payload)[:4] != checksum:
+            reason = "record checksum mismatch"
+            break
+        records.append(JournalRecord(rec_type, payload, pos, end))
+        pos = end
+    # a clean scan consumes the whole file, so valid == n there; after a
+    # break the valid prefix ends at the last verified record
+    valid = records[-1].end if records else len(JOURNAL_MAGIC)
+    if not reason:
+        valid = n
+    return records, TailReport(valid, n, len(records), reason)
+
+
+def salvage_header_scan(data: bytes) -> List[JournalRecord]:
+    """Records recoverable from a file whose 4-byte header is damaged:
+    they are individually CRC-framed, so they re-verify under a synthetic
+    good header. The single source of truth for what ``Journal.open``'s
+    header salvage (and ``journal-info``'s report of it) will keep."""
+    if len(data) <= len(JOURNAL_MAGIC):
+        return []
+    records, _ = scan_records(JOURNAL_MAGIC + bytes(data[len(JOURNAL_MAGIC):]))
+    return records
+
+
+class Journal:
+    """One open journal file: appends with a configurable fsync policy.
+
+    Construct via ``Journal.open`` — it scans the existing file, truncates
+    any torn tail back to the last verifiable record, and returns the
+    surviving records for replay.
+    """
+
+    def __init__(self, path: str, f, *, fs, fsync: str, fsync_interval: int,
+                 size: int, count: int):
+        if fsync not in FSYNC_POLICIES:
+            raise ValueError(f"unknown fsync policy {fsync!r}")
+        self.path = path
+        self.fs = fs
+        self.fsync_policy = fsync
+        self.fsync_interval = max(1, int(fsync_interval))
+        self._f = f
+        self._size = size
+        self._count = count
+        self._unsynced = 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @classmethod
+    def open(
+        cls,
+        path: str,
+        *,
+        fs=None,
+        fsync: str = "always",
+        fsync_interval: int = 16,
+    ) -> Tuple["Journal", List[JournalRecord], TailReport]:
+        """Open (creating if absent), recover, and position for appends.
+
+        Returns ``(journal, records, tail_report)``; when the tail was
+        torn the file has already been truncated back to the valid prefix
+        and ``trace.count("journal.truncated_tail")`` records the bytes
+        dropped.
+        """
+        fs = fs or OS_FS
+        # open append-mode: creates the file if absent but NEVER truncates
+        # — a losing opener in a create race must not destroy the winner's
+        # live journal before its own lock attempt fails. The lock comes
+        # before any read or write; O_APPEND keeps every write at the
+        # physical end, which is exactly the journal discipline anyway.
+        f = fs.open(path, "ab")
+        try:
+            fs.lock(f)
+        except Exception:
+            f.close()
+            raise
+        data = fs.read_bytes(path)
+        records, tail = scan_records(data)
+        if tail.reason in ("missing journal header", "bad journal magic"):
+            # brand new file, a fresh create that crashed mid-header, or a
+            # header hit by localized damage. The records BEYOND a corrupt
+            # header are still individually CRC-framed
+            # (salvage_header_scan); rebuild ATOMICALLY — write the rescued
+            # content to a temp file, fsync, rename over the journal — so a
+            # crash mid-salvage leaves either the old damaged file (salvage
+            # reruns) or the complete new one, never an empty husk.
+            salvaged = (
+                salvage_header_scan(data)
+                if tail.reason == "bad journal magic"
+                else []
+            )
+            kept = sum(r.end - r.offset for r in salvaged)
+            dropped = len(data) - kept
+            if dropped:
+                trace.count("journal.truncated_tail", n=dropped)
+            tmp = path + ".tmp"
+            nf = fs.open(tmp, "wb")
+            try:
+                fs.lock(nf)
+                nf.write(JOURNAL_MAGIC)
+                for r in salvaged:
+                    nf.write(encode_record(r.rec_type, r.payload))
+                fs.fsync(nf)
+                fs.replace(tmp, path)
+                # the file's DIRECTORY ENTRY must be durable too, or a
+                # crash loses the whole journal regardless of record fsyncs
+                fs.sync_dir(os.path.dirname(path) or ".")
+            except Exception:
+                nf.close()
+                raise
+            # nf IS the inode now at `path` (and holds its lock); the old
+            # handle's inode is unlinked, so its lock guards nothing
+            f.close()
+            size = len(JOURNAL_MAGIC) + kept
+            if not len(data):
+                tail = TailReport(size, size, len(salvaged), "")
+            return (
+                cls(path, nf, fs=fs, fsync=fsync, fsync_interval=fsync_interval,
+                    size=size, count=len(salvaged)),
+                salvaged,
+                tail,
+            )
+        if tail.torn:
+            trace.count("journal.truncated_tail", n=tail.dropped_bytes)
+            f.truncate(tail.valid_bytes)
+            fs.fsync(f)
+        return (
+            cls(path, f, fs=fs, fsync=fsync, fsync_interval=fsync_interval,
+                size=tail.valid_bytes, count=len(records)),
+            records,
+            tail,
+        )
+
+    @property
+    def closed(self) -> bool:
+        """True once closed (explicitly, or poisoned by a double fault in
+        ``append``): every further append/sync raises."""
+        return self._f is None
+
+    def close(self) -> None:
+        if self._f is None:
+            return
+        try:
+            if self._unsynced:
+                self.sync()
+        finally:
+            self._f.close()
+            self._f = None
+
+    # -- appends -------------------------------------------------------------
+
+    @property
+    def record_count(self) -> int:
+        return self._count
+
+    @property
+    def size_bytes(self) -> int:
+        return self._size
+
+    def append(self, rec_type: int, payload: bytes,
+               auto_sync: bool = True) -> None:
+        """Append one record; durable on return iff the policy says so.
+
+        ``auto_sync=False`` defers the policy fsync — the caller promises
+        to invoke ``policy_sync()`` before acking (the durable layer uses
+        this to pay ONE fsync per public call instead of one per change
+        in a merge/sync batch)."""
+        if self._f is None:
+            raise JournalError("journal is closed")
+        rec = encode_record(rec_type, payload)
+        with trace.time("journal.append", bytes=len(rec)):
+            try:
+                self._f.write(rec)
+            except Exception:
+                # a partial write (ENOSPC/EIO mid-record) would leave torn
+                # bytes MID-file: later successful appends would land after
+                # the tear and be dropped at recovery. Cut back to the last
+                # known-good size; if even that fails, poison the journal.
+                try:
+                    self._f.truncate(self._size)
+                except Exception:
+                    self._f.close()
+                    self._f = None  # closed journal: every append raises
+                raise
+        self._size += len(rec)
+        self._count += 1
+        self._unsynced += 1
+        if auto_sync:
+            self.policy_sync()
+
+    def policy_sync(self) -> None:
+        """Apply the fsync policy to whatever is pending: "always" syncs,
+        "interval" syncs when the pending count crosses the interval,
+        "never" does nothing."""
+        if self._unsynced and (
+            self.fsync_policy == "always"
+            or (
+                self.fsync_policy == "interval"
+                and self._unsynced >= self.fsync_interval
+            )
+        ):
+            self.sync()
+
+    def append_change(self, raw_chunk: bytes) -> None:
+        self.append(REC_CHANGE, raw_chunk)
+
+    def append_meta(self, name: str, blob: bytes) -> None:
+        self.append(REC_META, encode_meta(name, blob))
+
+    def sync(self) -> None:
+        """Force everything appended so far onto stable storage."""
+        if self._f is None:
+            raise JournalError("journal is closed")
+        if self._unsynced == 0:
+            return
+        with trace.time("journal.fsync"):
+            self.fs.fsync(self._f)
+        self._unsynced = 0
+
+    def truncate(self) -> None:
+        """Reset to an empty journal (post-compaction): the truncation is
+        fsynced before return so stale records cannot resurrect."""
+        if self._f is None:
+            raise JournalError("journal is closed")
+        self._f.truncate(len(JOURNAL_MAGIC))
+        self._f.seek(len(JOURNAL_MAGIC))
+        self._unsynced = 1  # force the fsync below
+        self.sync()
+        self._size = len(JOURNAL_MAGIC)
+        self._count = 0

@@ -46,6 +46,7 @@ it. ``now`` is any monotonic number — integer ticks in the fault harness
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import random
 import zlib
@@ -66,6 +67,18 @@ SESSION_FRAME_TYPE = 0x45
 FLAG_RESET = 0x01
 
 _SEEN_LIMIT = 256  # digests remembered for duplicate detection
+
+
+def _is_durability_error(e: Exception) -> bool:
+    """True for failures of the durable write path (journal I/O), which
+    must never be absorbed as protocol-level rejections."""
+    if isinstance(e, OSError):
+        return True
+    try:
+        from ..storage.journal import JournalError
+    except Exception:  # storage layer absent: nothing to classify
+        return False
+    return isinstance(e, JournalError)
 
 
 class SessionConfig:
@@ -140,8 +153,12 @@ class SyncSession:
         config: Optional[SessionConfig] = None,
         epoch: int = 1,
         device_doc=None,
+        persist=None,
     ):
-        # accept an AutoDoc (auto-commits) or a core Document
+        # accept an AutoDoc (auto-commits) or a core Document; the outer
+        # object is kept as-is so a durable wrapper's ack_scope (batched
+        # journal fsync per received message) is reachable
+        self._outer = doc
         self._autodoc = doc if hasattr(doc, "doc") else None
         self._doc = doc.doc if self._autodoc is not None else doc
         # optional resident DeviceDoc: received changes feed its
@@ -167,6 +184,12 @@ class SyncSession:
         self._noprogress = 0
         self._seq = 0
         self._seen: OrderedDict = OrderedDict()
+        # optional persistence hook: called with self.encode() whenever
+        # shared_heads change, so a durable peer (storage/durable.py
+        # attach_sync_session) survives a restart with its sync progress.
+        # Persistence failure must never break the live session.
+        self.persist = persist
+        self._persisted_shared: Optional[tuple] = None
 
     # -- public surface -----------------------------------------------------
 
@@ -323,15 +346,30 @@ class SyncSession:
         if self._autodoc is not None:
             self._autodoc.commit()
         before = self._doc.get_heads()
-        try:
-            receive_sync_message(self._doc, self.state, msg)
-        except Exception as e:
-            # a well-framed message whose changes the document rejects
-            # (e.g. duplicate (actor, seq) from a peer that lost its doc
-            # and re-created divergent history): absorb, count, keep going
-            self.stats["rejected"] += 1
-            trace.count("sync.rejected", error=str(e))
-            return False
+        # a durable document batches this message's journal fsyncs into
+        # one at the scope exit; the except below stays narrowly around
+        # the PROTOCOL apply so observer/journal failures propagate
+        # instead of being miscounted as rejected frames
+        scope = getattr(self._outer, "ack_scope", None)
+        with scope() if scope is not None else contextlib.nullcontext():
+            try:
+                receive_sync_message(self._doc, self.state, msg)
+            except Exception as e:
+                # a durable write-path failure (the journal listener fires
+                # inside apply_changes) is NOT a rejected frame: the ack
+                # guarantee is at stake, so it must propagate
+                if _is_durability_error(e):
+                    raise
+                # a well-framed message whose changes the document rejects
+                # (e.g. duplicate (actor, seq) from a peer that lost its
+                # doc and re-created divergent history): absorb, count,
+                # keep going
+                self.stats["rejected"] += 1
+                trace.count("sync.rejected", error=str(e))
+                return False
+            # persist inside the scope: the meta record rides the same
+            # single boundary fsync as the message's change records
+            self._maybe_persist()
         if self._autodoc is not None:
             self._autodoc._notify_patches()
         if self.device_doc is not None and msg.changes:
@@ -355,6 +393,21 @@ class SyncSession:
             self._noprogress += 1
         return True
 
+    def _maybe_persist(self) -> None:
+        if self.persist is None:
+            return
+        cur = tuple(self.state.shared_heads)
+        if cur == self._persisted_shared:
+            return
+        try:
+            self.persist(self.encode())
+        except Exception as e:  # noqa: BLE001 — persistence is best-effort
+            # NOT marked persisted: a transient failure retries on the
+            # next call even if shared_heads never change again
+            trace.count("sync.persist_error", error=str(e)[:200])
+        else:
+            self._persisted_shared = cur
+
     def _on_peer_reset(self, new_epoch: int) -> None:
         self.peer_epoch = new_epoch
         self._hard_reset(keep_shared=True)
@@ -372,6 +425,9 @@ class SyncSession:
         self._awaiting = False
         self._cur_timeout = self.config.timeout
         self._noprogress = 0
+        # a reset that cleared shared_heads must persist that too, or a
+        # restart would resurrect heads the resync just disowned
+        self._maybe_persist()
 
     def _force_resync(self, now: float) -> Optional[bytes]:
         """Divergence detected: renegotiate from nothing and tell the peer

@@ -654,6 +654,62 @@ def main():
     results["micro"] = micro
     note(f"micro: {micro}")
 
+    # ---- config: durable write path (journal + compaction + recovery) ------
+    # N commits through a DurableDocument: journal append overhead per
+    # commit, compaction count at the default thresholds, and — the
+    # recovery-time headline — a reopen that replays snapshot + journal.
+    # Counters/timings (journal.append/fsync, compact.*,
+    # journal.replayed_records) surface in the JSON for observability.
+    import shutil
+    import tempfile
+
+    dur = {}
+    n_dur = env_int("BENCH_DURABLE_COMMITS", 2000)
+    dur_fsync = os.environ.get("BENCH_DURABLE_FSYNC", "interval")
+    tmpd = tempfile.mkdtemp(prefix="amtpu_bench_durable_")
+    try:
+        dd = AutoDoc.open(
+            os.path.join(tmpd, "doc"), fsync=dur_fsync,
+            actor=ActorId(bytes([14]) * 16),
+        )
+        t0 = time.perf_counter()
+        for i in range(n_dur):
+            dd.put("_root", f"k{i % 512:04}", i)
+            dd.commit()
+        t_commits = time.perf_counter() - t0
+        dd.close()
+        compactions = T.counters.get("compact.runs", 0)
+        tj = T.timing_summary()
+        pre_replayed = T.counters.get("journal.replayed_records", 0)
+        t0 = time.perf_counter()
+        dd2 = AutoDoc.open(os.path.join(tmpd, "doc"))
+        t_reopen = time.perf_counter() - t0
+        replayed = T.counters.get("journal.replayed_records", 0) - pre_replayed
+        n_history = len(dd2.doc.history)
+        dd2.close()
+        dur = {
+            "commits": n_dur,
+            "fsync": dur_fsync,
+            "commits_per_sec": round(n_dur / t_commits, 1),
+            "journal_append_s": tj.get("journal.append", {}).get("s", 0.0),
+            "journal_fsync_s": tj.get("journal.fsync", {}).get("s", 0.0),
+            "compactions": compactions,
+            "reopen_s": round(t_reopen, 4),
+            "replayed_records": replayed,
+            "history_after_reopen": n_history,
+        }
+        assert replayed < n_dur or compactions == 0, dur  # replay is bounded
+    except Exception as e:  # noqa: BLE001 — degrade, record, continue
+        import traceback
+
+        tb = traceback.format_exc()
+        dur = {"durable_error": repr(e)[:500]}
+        print(f"durable config failed:\n{tb}", file=sys.stderr, flush=True)
+    finally:
+        shutil.rmtree(tmpd, ignore_errors=True)
+    results["durable"] = dur
+    note(f"durable: {results['durable']}")
+
     out = {
         "metric": "edit_trace_fanin_merge_ops_per_sec",
         "value": results["fanin"]["ops_per_sec"],

@@ -135,6 +135,84 @@ def test_export_salvage_recovers_damaged_save(tmp_path, capsys):
     assert "dropped span" in err
 
 
+def _durable_doc(tmp_path, n=3):
+    d = str(tmp_path / "ddoc")
+    dd = AutoDoc.open(d, fsync="never", actor=actor(1))
+    for i in range(n):
+        dd.put("_root", f"k{i}", i)
+        dd.commit()
+    dd.close()
+    return d
+
+
+def test_journal_info(tmp_path):
+    d = _durable_doc(tmp_path)
+    out = tmp_path / "info.json"
+    assert main(["journal-info", d, "-o", str(out)]) == 0
+    info = json.loads(out.read_text())
+    assert info["records"] == 3 and info["change_records"] == 3
+    assert info["torn_tail"] is None
+    assert info["bytes"] == info["valid_bytes"] > 0
+    assert info["snapshot_bytes"] is None  # never compacted yet
+
+
+def test_journal_info_reports_torn_tail_read_only(tmp_path):
+    d = _durable_doc(tmp_path)
+    jp = tmp_path / "ddoc" / "journal.waj"
+    jp.write_bytes(jp.read_bytes() + b"\x99torn-garbage")
+    size_before = jp.stat().st_size
+    out = tmp_path / "info.json"
+    assert main(["journal-info", d, "-o", str(out)]) == 0
+    info = json.loads(out.read_text())
+    assert info["torn_tail"] is not None
+    assert info["torn_tail"]["dropped_bytes"] == len(b"\x99torn-garbage")
+    assert info["records"] == 3
+    assert jp.stat().st_size == size_before  # inspection never repairs
+
+
+def test_journal_info_missing_dir(tmp_path):
+    assert main(["journal-info", str(tmp_path / "nope")]) == 1
+
+
+def test_journal_info_reports_bad_header_as_recoverable(tmp_path):
+    """A damaged header must not be reported as total loss when the
+    records behind it are what open() will actually recover."""
+    d = _durable_doc(tmp_path)
+    jp = tmp_path / "ddoc" / "journal.waj"
+    data = bytearray(jp.read_bytes())
+    data[0] ^= 0xFF
+    jp.write_bytes(bytes(data))
+    out = tmp_path / "info.json"
+    assert main(["journal-info", d, "-o", str(out)]) == 0
+    info = json.loads(out.read_text())
+    assert info["records"] == 3  # recoverable, not zero
+    assert "header will be rewritten" in info["torn_tail"]["reason"]
+
+
+def test_compact_missing_dir_errors_without_creating(tmp_path):
+    """A mistyped path must fail, not silently create a fresh durable doc."""
+    target = tmp_path / "aplha"
+    assert main(["compact", str(target)]) == 1
+    assert not target.exists()
+
+
+def test_compact_then_reopen(tmp_path):
+    d = _durable_doc(tmp_path)
+    out = tmp_path / "compact.json"
+    assert main(["compact", d, "-o", str(out)]) == 0
+    result = json.loads(out.read_text())
+    assert result["compacted"] is True
+    assert result["records_before"] == 3 and result["records_after"] == 0
+    info_out = tmp_path / "info.json"
+    assert main(["journal-info", d, "-o", str(info_out)]) == 0
+    info = json.loads(info_out.read_text())
+    assert info["records"] == 0 and info["snapshot_bytes"] > 0
+    # the document survives the CLI round-trip intact
+    dd = AutoDoc.open(d)
+    assert dd.hydrate() == {"k0": 0, "k1": 1, "k2": 2}
+    dd.close()
+
+
 def test_examine_sync_session_frame(tmp_path):
     """examine-sync understands session frames (0x45 envelope) as well as
     bare protocol messages."""

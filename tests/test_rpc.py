@@ -354,3 +354,69 @@ def test_request_limit_counts_bytes_not_characters():
     srv.serve(stdin=io.StringIO(big + "\n"), stdout=out)
     resp = json.loads(out.getvalue().splitlines()[0])
     assert resp["error"]["type"] == "RequestTooLarge"
+
+
+def test_durable_mode_persists_across_server_restarts(tmp_path):
+    """--durable DIR mode: openDurable documents journal every change; a
+    fresh server over the same directory recovers them."""
+    srv = RpcServer(durable_dir=str(tmp_path))
+    d = call(srv, "openDurable", name="alpha")["doc"]
+    # reopening the same name returns the same handle (one journal owner),
+    # but never silently with a different durability than requested
+    assert call(srv, "openDurable", name="alpha")["doc"] == d
+    resp = srv.handle({"id": 1, "method": "openDurable",
+                       "params": {"name": "alpha", "fsync": "never"}})
+    assert "already open" in resp["error"]["message"]
+    t = call(srv, "putObject", doc=d, obj="_root", prop="t", type="text")["$obj"]
+    call(srv, "spliceText", doc=d, obj=t, pos=0, text="durable")
+    call(srv, "put", doc=d, obj="_root", prop="n", value=7)
+    call(srv, "commit", doc=d)
+    info = call(srv, "durableInfo", doc=d)
+    assert info["journalRecords"] >= 1 and info["fsync"] == "always"
+    assert call(srv, "durableCompact", doc=d)["journalRecords"] == 0
+    call(srv, "put", doc=d, obj="_root", prop="post", value=1)
+    call(srv, "commit", doc=d)
+    call(srv, "free", doc=d)  # closes the journal
+
+    srv2 = RpcServer(durable_dir=str(tmp_path))
+    d2 = call(srv2, "openDurable", name="alpha")["doc"]
+    assert call(srv2, "materialize", doc=d2) == {"t": "durable", "n": 7,
+                                                "post": 1}
+    call(srv2, "free", doc=d2)
+
+
+def test_durable_mode_rejects_bad_names_and_nondurable_server(tmp_path):
+    srv = RpcServer(durable_dir=str(tmp_path))
+    for bad in ("../evil", "a/b", "", ".hidden", None, 7, "x" * 100):
+        resp = srv.handle({"id": 1, "method": "openDurable",
+                           "params": {"name": bad}})
+        assert "error" in resp, bad
+    # durableInfo on a plain doc is an error, not a crash
+    plain = call(srv, "create")["doc"]
+    resp = srv.handle({"id": 1, "method": "durableInfo",
+                       "params": {"doc": plain}})
+    assert "error" in resp
+
+    nondurable = RpcServer()
+    resp = nondurable.handle({"id": 1, "method": "openDurable",
+                              "params": {"name": "alpha"}})
+    assert resp["error"]["message"].startswith("server is not running")
+
+
+def test_durable_docs_flushed_on_eof_without_free(tmp_path):
+    """A client that vanishes (EOF) without free() must not strand a
+    pending autocommit transaction: serve() closes durable docs on every
+    exit path."""
+    import io
+
+    srv = RpcServer(durable_dir=str(tmp_path))
+    stream = (
+        '{"id":1,"method":"openDurable","params":{"name":"a"}}\n'
+        '{"id":2,"method":"put","params":{"doc":1,"obj":"_root","prop":"n","value":7}}\n'
+    )  # no commit, no free, then EOF
+    out = io.StringIO()
+    srv.serve(stdin=io.StringIO(stream), stdout=out)
+    srv2 = RpcServer(durable_dir=str(tmp_path))
+    d2 = call(srv2, "openDurable", name="a")["doc"]
+    assert call(srv2, "get", doc=d2, obj="_root", prop="n") == 7
+    call(srv2, "free", doc=d2)

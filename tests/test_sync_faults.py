@@ -307,6 +307,150 @@ def test_trace_counters_emitted():
         assert trace.counters.get("sync.dup", 0) == total_dups
 
 
+def test_durable_peer_restart_resumes_without_full_resync(tmp_path):
+    """A durable peer persists shared_heads (journal metadata) as sync
+    progresses; after a restart the restored session resumes through the
+    epoch/reset handshake with its sync progress intact — no stall-forced
+    full resync, no renegotiation from empty shared_heads."""
+    a = AutoDoc(actor=actor(1))
+    for i in range(4):
+        a.put("_root", f"a{i}", i)
+        a.commit()
+    bd = AutoDoc.open(str(tmp_path / "b"), fsync="never", actor=actor(2))
+    bd.put("_root", "b0", 0)
+    bd.commit()
+    sa = SyncSession(a, epoch=1)
+    sb = bd.attach_sync_session("peer-a", SyncSession(bd, epoch=2))
+    stats = SyncDriver(a, bd, session_a=sa, session_b=sb).run()
+    assert stats.converged
+    shared = list(sb.state.shared_heads)
+    assert shared  # progress was made AND persisted
+    assert "sync/peer-a" in bd.meta
+    bd.close()  # "crash": the session object is gone, only disk survives
+
+    bd2 = AutoDoc.open(str(tmp_path / "b"))
+    sb2 = bd2.restore_sync_session("peer-a")
+    assert sb2.state.shared_heads == shared
+    assert sb2.epoch != sb.epoch  # the survivor must notice the restart
+    # diverge both sides, then resume (reliable link: any resync here
+    # could only come from the restart itself, so asserting zero is
+    # exactly the "no forced full resync" property)
+    a.put("_root", "new_a", 1)
+    a.commit()
+    bd2.put("_root", "new_b", 2)
+    bd2.commit()
+    stats2 = SyncDriver(a, bd2, session_a=sa, session_b=sb2).run()
+    assert stats2.converged
+    assert a.get_heads() == bd2.get_heads()
+    assert sa.stats["resets"] >= 1  # epoch handshake ran
+    assert stats2.a["resyncs"] + stats2.b["resyncs"] == 0  # no full resync
+    assert sb2.state.shared_heads  # progress persisted for the NEXT restart
+
+    # a second restart mid-divergence resumes over a lossy link too
+    shared2 = list(sb2.state.shared_heads)
+    bd2.close()
+    bd3 = AutoDoc.open(str(tmp_path / "b"))
+    sb3 = bd3.restore_sync_session("peer-a")
+    assert sb3.state.shared_heads == shared2
+    a.put("_root", "new_a2", 3)
+    a.commit()
+    drv = SyncDriver(
+        a, bd3,
+        FaultyChannel(seed=11, drop=0.2, dup=0.2, reorder=0.2),
+        FaultyChannel(seed=12, drop=0.2, dup=0.2, reorder=0.2),
+        session_a=sa, session_b=sb3,
+    )
+    stats3 = drv.run(max_ticks=MAX_TICKS)
+    assert stats3.converged
+    assert a.get_heads() == bd3.get_heads()
+    bd3.close()
+
+
+def test_durable_sync_state_survives_compaction(tmp_path):
+    """Compaction truncates the journal but re-appends metadata, so the
+    persisted shared_heads survive a snapshot cycle + restart."""
+    a = AutoDoc(actor=actor(3))
+    a.put("_root", "x", 1)
+    a.commit()
+    bd = AutoDoc.open(str(tmp_path / "b"), fsync="never", actor=actor(4))
+    sb = bd.attach_sync_session("a", SyncSession(bd, epoch=1))
+    stats = SyncDriver(a, bd, session_a=SyncSession(a, epoch=2), session_b=sb).run()
+    assert stats.converged
+    shared = list(sb.state.shared_heads)
+    assert shared
+    assert bd.compact()
+    bd.close()
+    bd2 = AutoDoc.open(str(tmp_path / "b"))
+    assert bd2.restore_sync_session("a").state.shared_heads == shared
+    bd2.close()
+
+
+def test_durable_restore_bumps_epoch_even_without_progress(tmp_path):
+    """Two crash-restarts with NO sync progress in between must still
+    present distinct epochs — the bumped epoch is persisted eagerly at
+    restore time, not lazily on the next shared_heads change."""
+    d = str(tmp_path / "b")
+    bd = AutoDoc.open(d, fsync="never", actor=actor(6))
+    bd.attach_sync_session("p", SyncSession(bd, epoch=1))._maybe_persist()
+    bd.close()
+    epochs = []
+    for _ in range(3):
+        bd = AutoDoc.open(d)
+        epochs.append(bd.restore_sync_session("p").epoch)
+        bd.close()  # crash again before any sync frame is exchanged
+    assert len(set(epochs)) == 3, epochs
+
+
+def test_durable_sync_receive_batches_fsync(tmp_path):
+    """An N-change sync message absorbed by a durable peer's session pays
+    ONE journal fsync at the ack boundary, not N."""
+    peer = AutoDoc(actor=actor(7))
+    for i in range(10):
+        peer.put("_root", f"p{i}", i)
+        peer.commit()
+    gs = SyncSession(peer, epoch=5)
+    gs.state.their_have = []
+    gs.state.their_need = [c.hash for c in peer.doc.get_changes([])]
+    frame = gs.poll(0)  # carries all 10 changes
+
+    dd = AutoDoc.open(str(tmp_path / "b"), fsync="always", actor=actor(8))
+    sess = SyncSession(dd, epoch=1)
+    trace.reset_timers()
+    assert sess.receive(frame, 0) is True
+    t = trace.timing_summary()
+    assert t["journal.append"]["n"] == 10
+    assert t["journal.fsync"]["n"] == 1
+    dd.close()
+
+
+def test_patch_callback_exception_propagates_not_rejected():
+    """A raising patch OBSERVER is not a rejected frame: the exception
+    must propagate out of receive (as it always did) and the message must
+    still count as applied, not swallowed into stats['rejected']."""
+    a, b = make_peers(random.Random(8))
+    gs = SyncSession(b, epoch=5)
+    gs.state.their_have = []
+    gs.state.their_need = [c.hash for c in b.doc.get_changes([])]
+    frame = gs.poll(0)  # carries b's changes
+
+    def boom(patches):
+        raise RuntimeError("observer failed")
+
+    a.set_patch_callback(boom)
+    sess = SyncSession(a, epoch=1)
+    with pytest.raises(RuntimeError, match="observer failed"):
+        sess.receive(frame, 0)
+    assert sess.stats["rejected"] == 0  # the changes DID apply
+
+
+def test_durable_restore_unknown_peer_is_fresh(tmp_path):
+    bd = AutoDoc.open(str(tmp_path / "b"), fsync="never", actor=actor(5))
+    sess = bd.restore_sync_session("never-met")
+    assert sess.state.shared_heads == []
+    assert sess.epoch == 1
+    bd.close()
+
+
 def test_session_absorbs_apply_rejected_changes():
     """A CRC-valid frame whose changes the document rejects (peer lost its
     doc and re-created divergent history under the same actor) must be
