@@ -32,4 +32,6 @@ from .types import (  # noqa: F401
 #   .testing    conflict-aware test DSL (assert_doc / map_ / list_)
 #   .errors     typed error hierarchy
 #   .capi       C ABI frontend build helpers
-#   .trace      tracing instrumentation
+#   .obs        observability: labeled metrics registry, hierarchical
+#               spans (Perfetto export), Prometheus exposition
+#   .trace      tracing shims over .obs (count/time/span/event)

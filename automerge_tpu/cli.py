@@ -1,5 +1,5 @@
 """Command-line interface: export / import / merge / examine / examine-sync
-/ change / journal-info / compact.
+/ change / journal-info / compact / metrics.
 
 Mirrors the reference CLI's subcommands (reference:
 rust/automerge-cli/src/main.rs:81-161). Documents read and write the
@@ -309,6 +309,58 @@ def cmd_compact(args) -> int:
     return 0
 
 
+def cmd_metrics(args) -> int:
+    """Exercise the instrumented load path on a document (a save file or
+    a durable directory), then dump the metrics registry — Prometheus
+    text by default, ``--format json`` for the structured snapshot,
+    ``--trace-out trace.json`` for a Perfetto/Chrome-trace span dump of
+    everything the load did."""
+    import os
+
+    from . import obs
+
+    if args.input:
+        if os.path.isdir(args.input):
+            from .storage.journal import JournalError
+
+            try:
+                dd = AutoDoc.open(args.input, fsync="never")
+            except JournalError as e:
+                print(f"metrics: {e}", file=sys.stderr)
+                return 1
+            try:
+                n = len(dd.doc.history)
+            finally:
+                dd.close()
+            print(f"metrics: replayed durable doc ({n} changes)",
+                  file=sys.stderr)
+        else:
+            doc = AutoDoc.load(_read(args.input), on_error="salvage")
+            rep = doc.salvage_report
+            if rep is not None and rep.dropped:
+                print(f"metrics: {rep.summary()}", file=sys.stderr)
+    if args.format == "json":
+        body = json.dumps(
+            {
+                "metrics": obs.snapshot(),
+                "counters": dict(obs.legacy_counters),
+                "timings": obs.timing_summary(),
+            },
+            indent=2,
+        ) + "\n"
+    else:
+        body = obs.render_prometheus()
+    _write(args.out, body.encode())
+    if args.trace_out:
+        n_spans = obs.export_trace(args.trace_out)
+        print(
+            f"metrics: wrote {n_spans} spans to {args.trace_out} "
+            "(open at https://ui.perfetto.dev)",
+            file=sys.stderr,
+        )
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="automerge_tpu", description=__doc__)
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -349,6 +401,18 @@ def build_parser() -> argparse.ArgumentParser:
     sp = add("compact", cmd_compact,
              help="snapshot a durable document and truncate its journal")
     sp.add_argument("input", help="durable document directory")
+
+    sp = add("metrics", cmd_metrics,
+             help="load a document (file or durable dir) and dump the "
+                  "metrics registry (Prometheus text or JSON)")
+    sp.add_argument("input", nargs="?",
+                    help="optional .automerge file or durable document "
+                         "directory to load first (instruments the load)")
+    sp.add_argument("--format", choices=("prometheus", "json"),
+                    default="prometheus")
+    sp.add_argument("--trace-out", default=None, metavar="PATH",
+                    help="also export recorded spans as Perfetto/"
+                         "Chrome-trace JSON to PATH")
 
     sp = add("change", cmd_change, help="apply an edit script to a document")
     sp.add_argument("input", nargs="?", help="input .automerge file (omit to start empty)")

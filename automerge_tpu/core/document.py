@@ -250,10 +250,10 @@ class Document:
 
     def apply_changes(self, changes: Iterable[StoredChange]) -> None:
         changes = list(changes)
-        from .. import trace
+        from .. import obs
 
-        if trace.enabled():
-            trace.event(
+        if obs.enabled():
+            obs.event(
                 "apply_changes", changes=len(changes),
                 ops=sum(len(c.ops) for c in changes),
             )
@@ -957,10 +957,10 @@ class Document:
         appended as trailing change chunks so they survive a save/load
         cycle (reference: SaveOptions{retain_orphans}, automerge.rs:959-963)
         unless ``retain_orphans=False``."""
-        from .. import trace
+        from .. import obs
 
         self._check_no_pending_tx("save")
-        with trace.span("save"):
+        with obs.span("save"):
             data = self._save_document(deflate)
         if retain_orphans:
             for orphan in self.queue:
@@ -1251,12 +1251,12 @@ class Document:
         leave a ``SalvageReport`` of what was dropped on
         ``doc.salvage_report``.
         """
-        from .. import trace
+        from .. import obs
 
         if on_error is not None:
             on_partial = on_error
         doc = cls(actor, text_encoding=text_encoding)
-        with trace.span("load", bytes=len(data)):
+        with obs.span("load", bytes=len(data)):
             doc.load_incremental(data, verify=verify, on_partial=on_partial)
         if string_migration == "convert_to_text":
             doc.convert_scalar_strings_to_text()
@@ -1312,7 +1312,7 @@ class Document:
         """Degrade-gracefully load: apply every verifiable chunk, record
         every dropped span in ``self.salvage_report``, never raise on
         corrupt input."""
-        from .. import trace
+        from .. import obs
         from ..storage.change import parse_change_data
         from ..storage.chunk import write_chunk
         from ..storage.document import (
@@ -1349,9 +1349,9 @@ class Document:
                 )
         report.applied_chunks = applied
         self.salvage_report = report
-        trace.count("load.salvaged_chunks", n=applied)
+        obs.count("load.salvaged_chunks", n=applied)
         if report.dropped:
-            trace.count("load.dropped_chunks", n=len(report.dropped))
+            obs.count("load.dropped_chunks", n=len(report.dropped))
         return applied
 
 

@@ -987,10 +987,10 @@ class Transaction:
         self.doc.open_transactions.discard(self)
         if not self.operations and not self._session_ops and self.message is None:
             return None
-        from .. import trace
+        from .. import obs
 
-        if trace.enabled():
-            trace.event("commit", ops=self.pending_ops(), seq=self.seq)
+        if obs.enabled():
+            obs.event("commit", ops=self.pending_ops(), seq=self.seq)
         change = self._export_change()
         applied = AppliedChange(
             change, self.actor_idx, self._export_actor_map(change)

@@ -1,0 +1,257 @@
+"""Unified observability: labeled metrics + hierarchical spans.
+
+The single entry point the rest of the codebase instruments against
+(``trace.py`` keeps its ``count``/``time``/``span``/``event`` names as
+thin shims over this module):
+
+* ``obs.count(name, n, labels={...}, **fields)`` — labeled counter; the
+  aggregate (label-summed) value also lands in the legacy
+  ``trace.counters`` dict so existing consumers keep working.
+* ``obs.gauge_set(name, v, labels=...)`` — last-write-wins gauge.
+* ``obs.observe(name, v, labels=...)`` — histogram observation.
+* ``with obs.span(name, labels=..., **fields):`` — hierarchical timed
+  span: nests via a contextvar, accumulates wall time into the legacy
+  ``trace.timings`` dict AND a log-bucketed histogram (p50/p95/p99), and
+  lands in the bounded ring buffer that ``obs.export_trace(path)`` dumps
+  as Chrome-trace/Perfetto JSON.
+* ``obs.render_prometheus()`` — text exposition of every instrument
+  (scraped via the RPC ``metrics`` method or the CLI ``metrics``
+  subcommand).
+
+Env knobs: ``AUTOMERGE_TPU_TRACE=1`` turns on per-event debug log lines
+(same as before); ``AUTOMERGE_TPU_SPAN_BUFFER=N`` sizes the span ring
+buffer (default 4096, 0 disables span recording while keeping the
+timing/histogram accumulation).
+
+Everything here is thread-safe: one registry RLock guards instruments and
+the legacy dicts (the RPC server and the device staging path touch them
+concurrently).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import threading
+from time import perf_counter as _perf_counter
+from typing import Optional
+
+from .metrics import (  # noqa: F401 — re-exported API
+    FACTOR,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    parse_prometheus,
+    sanitize_metric_name,
+)
+from .spans import (  # noqa: F401 — re-exported API
+    _ORIGIN,
+    SpanRecord,
+    SpanRecorder,
+    current_span,
+    next_span_id,
+)
+
+logger = logging.getLogger("automerge_tpu")
+
+if os.environ.get("AUTOMERGE_TPU_TRACE"):
+    logger.setLevel(logging.DEBUG)
+    if not logger.handlers:
+        logging.basicConfig()
+
+_DEBUG = logging.DEBUG
+
+
+def enabled() -> bool:
+    return logger.isEnabledFor(_DEBUG)
+
+
+# -- globals -----------------------------------------------------------------
+
+registry = MetricsRegistry()
+
+_SPAN_BUFFER = int(os.environ.get("AUTOMERGE_TPU_SPAN_BUFFER", "4096"))
+recorder = SpanRecorder(_SPAN_BUFFER)
+
+# the legacy back-compat views (trace.counters / trace.timings alias these
+# exact dict objects): counters hold the label-aggregated totals; timings
+# hold [total_seconds, count] per span name. Mutated only under
+# ``registry.lock`` by this module; external consumers (bench stash/
+# restore) read and swap contents single-threaded.
+legacy_counters: dict = {}
+legacy_timings: dict = {}
+
+
+# -- structured event lines --------------------------------------------------
+
+_NEEDS_QUOTE = re.compile(r'[\s"=\\]')
+
+
+def _fmt_field(v) -> str:
+    """One ``k=v`` value: quoted + escaped when it contains whitespace,
+    ``=``, quotes or backslashes, so trace lines stay machine-parseable
+    even for error messages."""
+    s = str(v)
+    if _NEEDS_QUOTE.search(s) or not s:
+        s = (
+            '"'
+            + s.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+            + '"'
+        )
+    return s
+
+
+def event(name: str, **fields) -> None:
+    """One structured trace line: ``name k=v k=v`` (values quoted as
+    needed)."""
+    if logger.isEnabledFor(_DEBUG):
+        body = " ".join(f"{k}={_fmt_field(v)}" for k, v in fields.items())
+        logger.debug("%s %s", name, body)
+
+
+_EVENT_TOKEN = re.compile(r'(\w+)=("(?:[^"\\]|\\.)*"|\S*)')
+
+
+def parse_event_fields(body: str) -> dict:
+    """Inverse of the ``event`` field encoding (for log consumers/tests)."""
+    from .metrics import _unescape_label_value
+
+    out = {}
+    for m in _EVENT_TOKEN.finditer(body):
+        k, v = m.group(1), m.group(2)
+        if v.startswith('"') and v.endswith('"') and len(v) >= 2:
+            # single-pass unescape: sequential str.replace would decode
+            # an escaped backslash-then-n ('\\\\n') as backslash+newline
+            v = _unescape_label_value(v[1:-1])
+        out[k] = v
+    return out
+
+
+# -- counters / gauges / histograms ------------------------------------------
+
+
+def count(name: str, n: int = 1, labels: Optional[dict] = None, **fields) -> None:
+    """Increment the named (optionally labeled) counter. The aggregate
+    across labels also lands in the legacy ``trace.counters`` dict; a
+    debug event line is emitted when tracing is on."""
+    with registry.lock:
+        registry._get_locked(name, "counter", labels or {})._inc_locked(n)
+        total = legacy_counters.get(name, 0) + n
+        legacy_counters[name] = total
+    if logger.isEnabledFor(_DEBUG):
+        event(name, n=n, total=total, **(labels or {}), **fields)
+
+
+def gauge_set(name: str, value: float, labels: Optional[dict] = None) -> None:
+    registry.gauge(name, **(labels or {})).set(value)
+
+
+def observe(name: str, value: float, labels: Optional[dict] = None) -> None:
+    registry.histogram(name, **(labels or {})).observe(value)
+
+
+def reset_counters() -> None:
+    """Clear the legacy counter view (the registry's Prometheus counters
+    stay monotone over process life, as scrapers expect)."""
+    with registry.lock:
+        legacy_counters.clear()
+
+
+def reset_timers() -> None:
+    """Clear the legacy timings view (histograms/spans are unaffected)."""
+    with registry.lock:
+        legacy_timings.clear()
+
+
+def timing_summary() -> dict:
+    """{name: {"s": total seconds, "n": span count}} snapshot of the
+    legacy timing accumulators."""
+    with registry.lock:
+        return {
+            k: {"s": round(v[0], 6), "n": v[1]}
+            for k, v in legacy_timings.items()
+        }
+
+
+def percentiles(name: str, qs=(0.5, 0.95, 0.99), labels: Optional[dict] = None) -> dict:
+    """{q: estimate} from the named histogram (0.0s when empty)."""
+    h = registry.histogram(name, **(labels or {}))
+    return {q: h.percentile(q) for q in qs}
+
+
+# -- hierarchical spans ------------------------------------------------------
+
+
+class span:
+    """``with obs.span("device.kernel", rows=n):`` — a timed span that
+    nests under the contextually-active span, accumulates into
+    ``trace.timings`` and the ``name`` histogram, and records into the
+    ring buffer for Perfetto export. Always on; cost is two clock reads,
+    one lock round-trip and a deque append."""
+
+    __slots__ = ("name", "labels", "fields", "t0", "_id", "_parent", "_token")
+
+    def __init__(self, name: str, labels: Optional[dict] = None, **fields):
+        self.name = name
+        self.labels = labels
+        self.fields = fields
+        self.t0 = 0.0
+
+    def __enter__(self):
+        self._parent = current_span.get()
+        self._id = next_span_id()
+        self._token = current_span.set(self._id)
+        self.t0 = _perf_counter()
+        return self
+
+    def __exit__(self, etype, evalue, tb):
+        t1 = _perf_counter()
+        dur = t1 - self.t0
+        current_span.reset(self._token)
+        name = self.name
+        with registry.lock:
+            slot = legacy_timings.get(name)
+            if slot is None:
+                legacy_timings[name] = [dur, 1]
+            else:
+                slot[0] += dur
+                slot[1] += 1
+            registry._get_locked(
+                name, "histogram", self.labels or {}
+            )._observe_locked(dur)
+        if recorder.capacity > 0:
+            recorder.record(SpanRecord(
+                name, self._id, self._parent, self.t0 - _ORIGIN, dur,
+                threading.get_ident(), self.fields,
+                "error" if etype is not None else "ok",
+            ))
+        if logger.isEnabledFor(_DEBUG):
+            event(name, ms=round(dur * 1e3, 3),
+                  **(self.labels or {}), **self.fields)
+        return False
+
+
+def export_trace(path: str) -> int:
+    """Dump the span ring buffer as Chrome-trace/Perfetto JSON; returns
+    the number of span events written. Open the file at
+    https://ui.perfetto.dev (or chrome://tracing)."""
+    return recorder.export_chrome_trace(path)
+
+
+def render_prometheus() -> str:
+    return registry.render_prometheus()
+
+
+def snapshot() -> list:
+    return registry.snapshot()
+
+
+def reset_all() -> None:
+    """Full reset (tests): registry, legacy views and the span buffer."""
+    with registry.lock:
+        registry.reset()
+        legacy_counters.clear()
+        legacy_timings.clear()
+    recorder.clear()

@@ -1,0 +1,128 @@
+"""Hierarchical spans: a contextvar parent chain and a bounded ring buffer
+of completed spans, exportable as Chrome-trace/Perfetto JSON.
+
+A span nests under whatever span is active in the same context when it
+starts (``contextvars`` — so async tasks and threads each get their own
+chain), records wall time on exit, and lands in the ``SpanRecorder`` ring
+buffer. The buffer is bounded (``AUTOMERGE_TPU_SPAN_BUFFER`` entries,
+default 4096; 0 disables recording) so always-on span collection costs a
+deque append, never unbounded memory.
+
+``export_chrome_trace`` writes the buffer in the Chrome trace-event JSON
+format (``{"traceEvents": [{"ph": "X", ...}]}``) that
+https://ui.perfetto.dev and chrome://tracing open directly: one complete
+("X") event per span, nested by time containment per thread, with the
+span's fields (and its span/parent ids) under ``args``.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import itertools
+import json
+import os
+import threading
+from collections import deque
+from time import perf_counter
+from typing import List, Optional
+
+# all span timestamps are seconds since this process-wide origin, so the
+# exported trace starts near ts=0 regardless of perf_counter's epoch
+_ORIGIN = perf_counter()
+
+_ids = itertools.count(1)
+current_span: contextvars.ContextVar[Optional[int]] = contextvars.ContextVar(
+    "automerge_tpu_span", default=None
+)
+
+
+def next_span_id() -> int:
+    return next(_ids)
+
+
+class SpanRecord:
+    __slots__ = ("name", "span_id", "parent_id", "start", "duration",
+                 "thread_id", "fields", "status")
+
+    def __init__(self, name, span_id, parent_id, start, duration,
+                 thread_id, fields, status):
+        self.name = name
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.start = start          # seconds since _ORIGIN
+        self.duration = duration    # seconds
+        self.thread_id = thread_id
+        self.fields = fields
+        self.status = status        # "ok" | "error"
+
+    def to_chrome_event(self, pid: int) -> dict:
+        args = {str(k): _arg(v) for k, v in self.fields.items()}
+        args["span_id"] = self.span_id
+        if self.parent_id is not None:
+            args["parent_id"] = self.parent_id
+        if self.status != "ok":
+            args["status"] = self.status
+        return {
+            "name": self.name,
+            "cat": "automerge_tpu",
+            "ph": "X",
+            "ts": round(self.start * 1e6, 3),
+            "dur": round(self.duration * 1e6, 3),
+            "pid": pid,
+            "tid": self.thread_id,
+            "args": args,
+        }
+
+
+def _arg(v):
+    if isinstance(v, (int, float, bool, str)) or v is None:
+        return v
+    return str(v)
+
+
+class SpanRecorder:
+    """Bounded ring of completed SpanRecords, newest-wins."""
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._buf: deque = deque(maxlen=max(capacity, 0))
+
+    def record(self, rec: SpanRecord) -> None:
+        if self.capacity <= 0:
+            return
+        with self._lock:
+            self._buf.append(rec)
+
+    def snapshot(self) -> List[SpanRecord]:
+        with self._lock:
+            return list(self._buf)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+    def export_chrome_trace(self, path: str) -> int:
+        """Write the buffered spans as Chrome-trace JSON; returns the
+        number of events written."""
+        records = self.snapshot()
+        pid = os.getpid()
+        events = [r.to_chrome_event(pid) for r in records]
+        events.sort(key=lambda e: e["ts"])
+        doc = {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {"producer": "automerge_tpu.obs"},
+        }
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        return len(events)
+
+
+def now() -> float:
+    """Seconds since the recorder origin (what SpanRecord.start uses)."""
+    return perf_counter() - _ORIGIN

@@ -22,7 +22,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .. import trace
+from .. import obs
 from ..core.marks import Mark
 from ..patches.patch import (
     DeleteMap,
@@ -185,10 +185,10 @@ class DeviceDoc:
         ready = self._take_ready(changes)
         if not ready:
             return 0
-        with trace.time("device.apply", changes=len(ready)):
+        with obs.span("device.apply", changes=len(ready)):
             info = self.log.append_changes(ready) if incremental else None
             if info is None:
-                trace.count("device.apply_rebuild")
+                obs.count("device.apply_rebuild")
                 self._rebuild(list(self.log.changes) + ready)
                 return len(ready)
             self._apply_append(info, ready)
@@ -219,7 +219,7 @@ class DeviceDoc:
                 if inflight is not None:
                     self._collect_async(inflight)
                     inflight = None
-                trace.count("device.apply_rebuild")
+                obs.count("device.apply_rebuild")
                 self._rebuild(list(self.log.changes) + ready)
                 total += len(ready)
                 continue
@@ -276,7 +276,7 @@ class DeviceDoc:
                     del pend[h]
                     progress = True
         if pend:
-            trace.count("device.apply_deferred", n=len(pend))
+            obs.count("device.apply_deferred", n=len(pend))
         return ready
 
     def _rebuild(self, changes: list) -> None:
@@ -318,7 +318,7 @@ class DeviceDoc:
             if info.actors_changed:
                 self._all_elems_cache.clear()
             return
-        with trace.time("device.materialize", rows=info.n_new):
+        with obs.span("device.materialize", rows=info.n_new):
             nr = np.asarray(info.new_rows, np.int64)
             mk = nr[np.isin(np.asarray(log.action)[nr], MAKE_ACTIONS)]
             for r in mk:
@@ -472,7 +472,7 @@ class DeviceDoc:
         if len(c_seq) and np.any(~insert[c_seq] & (er[c_seq] < 0)):
             return False  # sentinel-keyed update groups: let the kernel decide
 
-        with trace.time("device.delta_resolve", rows=len(cand)):
+        with obs.span("device.delta_resolve", rows=len(cand)):
             # group membership (two vectorized passes over the columns)
             heads = np.unique(np.where(insert[c_seq], c_seq, er[c_seq]))
             member = np.zeros(m, np.bool_)
@@ -551,7 +551,7 @@ class DeviceDoc:
             # document order: splice the new subtrees in by anchor position
             if len(ni):
                 self._splice_elem_order(ni)
-        trace.count("device.delta_resolve")
+        obs.count("device.delta_resolve")
         return True
 
     def _splice_elem_order(self, ni: np.ndarray) -> None:
@@ -710,8 +710,8 @@ class DeviceDoc:
         if frac > self._dirty_fraction_limit() or len(dirty) >= log.n_objs:
             # cost model says re-resolving everything is cheaper than the
             # bookkeeping win (still NO re-extraction — columns are resident)
-            trace.count("device.reresolve_full")
-            trace.event("device.reresolve", mode="full", rows=m,
+            obs.count("device.reresolve_full")
+            obs.event("device.reresolve", mode="full", rows=m,
                         dirty_rows=len(rows), frac=round(frac, 4))
             res = merge_columns(
                 log.columns(), fetch=self.READ_FETCH, n_objs=log.n_objs,
@@ -732,8 +732,8 @@ class DeviceDoc:
             self.res["obj_vis_len"][:take] = ovl[:take]
             self.res["obj_text_width"][:take] = otw[:take]
             return
-        trace.count("device.reresolve_subset")
-        trace.event("device.reresolve", mode="subset", rows=m,
+        obs.count("device.reresolve_subset")
+        obs.event("device.reresolve", mode="subset", rows=m,
                     dirty_rows=len(rows), frac=round(frac, 4))
         cols = self._subset_cols(rows, dirty)
         res_sub = merge_columns(
@@ -771,7 +771,7 @@ class DeviceDoc:
         D = len(dirty)
         cols_np = pad_columns(self._subset_cols(rows, dirty), D)
         P = len(cols_np["action"])
-        with trace.time("device.h2d", rows=P):
+        with obs.span("device.h2d", rows=P):
             cols_dev = {k: jnp.asarray(v) for k, v in cols_np.items()}
         n_props = len(log.props)
         fn = (
@@ -779,7 +779,7 @@ class DeviceDoc:
             if scatter_geometry_ok(P, D, n_props)
             else merge_kernel_core
         )
-        with trace.time("device.kernel", rows=P):
+        with obs.span("device.kernel", rows=P):
             out = fn(cols_dev)  # async dispatch
         # element order overlaps the kernel — it needs only the columns
         ei = host_linearize(cols_np)
@@ -791,7 +791,7 @@ class DeviceDoc:
         out = handle["out"]
         S = len(handle["rows"])
         D = len(handle["dirty"])
-        with trace.time("device.readback", rows=S):
+        with obs.span("device.readback", rows=S):
             res_sub = {
                 "visible": np.asarray(out["visible"]),
                 "winner": np.asarray(out["winner"]),

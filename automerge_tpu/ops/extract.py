@@ -644,7 +644,7 @@ def _change_cache() -> "OrderedDict[bytes, object]":
 
 def cached_cols_for_hash(h: Optional[bytes]):
     """Decoded ChangeCols for a change hash, or None (counts hit/miss)."""
-    from .. import trace
+    from .. import obs
 
     if h is None:
         return None
@@ -652,9 +652,9 @@ def cached_cols_for_hash(h: Optional[bytes]):
     cc = cache.get(h)
     if cc is not None:
         cache.move_to_end(h)
-        trace.count("extract.change_cache_hit")
+        obs.count("extract.change_cache_hit")
     else:
-        trace.count("extract.change_cache_miss")
+        obs.count("extract.change_cache_miss")
     return cc
 
 

@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import trace
+from .. import obs
 from .oplog import ELEM_HEAD, PAD_ACTION, TAG_COUNTER, _capacity, _next_pow2
 
 _DELETE = 3
@@ -951,14 +951,14 @@ def _packed_merge(cols_np, fetch, n_objs, n_props=None):
         fn = _packed_cache[key] = _runs_fn(
             dev_fetch, obj_cap, static_key, P, Q, scatter_geom
         )
-    with trace.time("device.h2d", rows=P):
+    with obs.span("device.h2d", rows=P):
         arrays_dev = {k: jnp.asarray(v) for k, v in arrays.items()}
-    with trace.time("device.kernel", rows=P):
+    with obs.span("device.kernel", rows=P):
         flat_dev = fn(arrays_dev)  # async dispatch
     elem_index = host_linearize(cols_np) if host_elem else None
-    with trace.time("device.readback", rows=P):
+    with obs.span("device.readback", rows=P):
         flat = np.asarray(flat_dev)
-    with trace.time("device.materialize", rows=P):
+    with obs.span("device.materialize", rows=P):
         out = _split_flat(flat, dev_fetch, P, obj_cap)
     if host_elem:
         out["elem_index"] = elem_index
@@ -1051,7 +1051,7 @@ def merge_columns(cols_np, linearize: str = "auto", fetch=None, n_objs=None,
         )
     ):
         need = fetch if fetch is not None else ALL_OUTPUTS
-        with trace.time("merge.host", rows=len(cols_np["action"])):
+        with obs.span("merge.host", rows=len(cols_np["action"])):
             out = native.merge_cols(
                 cols_np,
                 n_objs if n_objs is not None else len(cols_np["action"]),
@@ -1090,7 +1090,7 @@ def merge_columns(cols_np, linearize: str = "auto", fetch=None, n_objs=None,
             n_props,
         )
 
-    with trace.time("device.h2d", rows=len(cols_np["action"])):
+    with obs.span("device.h2d", rows=len(cols_np["action"])):
         cols = {k: jnp.asarray(v) for k, v in cols_np.items()}
     if linearize == "auto":
         linearize = "native" if native.preorder_available() else "device"
@@ -1098,7 +1098,7 @@ def merge_columns(cols_np, linearize: str = "auto", fetch=None, n_objs=None,
 
     def pull(out, keys):
         host = {}
-        with trace.time("device.readback", rows=len(cols_np["action"])):
+        with obs.span("device.readback", rows=len(cols_np["action"])):
             for k in keys:
                 v = out[k]
                 if k in ("obj_vis_len", "obj_text_width") and n_objs is not None:
@@ -1108,7 +1108,7 @@ def merge_columns(cols_np, linearize: str = "auto", fetch=None, n_objs=None,
 
     if linearize == "native":
         P = len(cols_np["action"])
-        with trace.time("device.kernel", rows=P):
+        with obs.span("device.kernel", rows=P):
             if (
                 n_objs is not None
                 and n_props is not None
@@ -1122,6 +1122,6 @@ def merge_columns(cols_np, linearize: str = "auto", fetch=None, n_objs=None,
             # ranked from the host-resident columns — zero device traffic
             host["elem_index"] = host_linearize(cols_np)
         return host
-    with trace.time("device.kernel", rows=len(cols_np["action"])):
+    with obs.span("device.kernel", rows=len(cols_np["action"])):
         out = merge_kernel(cols)
     return pull(out, need)

@@ -173,7 +173,7 @@ class OpLog:
                 ch.op_col_data is not None or ch.cached_cols is not None
                 for ch in deduped
             )
-        from .. import trace
+        from .. import obs
 
         if fast:
             from .. import native
@@ -181,7 +181,7 @@ class OpLog:
             from .extract import ExtractError
 
             try:
-                with trace.time("device.extract", changes=len(deduped)):
+                with obs.span("device.extract", changes=len(deduped)):
                     return assemble_log(log, deduped, rank_of)
             except (
                 AssembleError, ExtractError, native.NativeUnavailable,
@@ -196,7 +196,7 @@ class OpLog:
                     stacklevel=2,
                 )
             try:
-                with trace.time("device.extract", changes=len(deduped)):
+                with obs.span("device.extract", changes=len(deduped)):
                     return cls._collect_fast(log, deduped, rank_of)
             except (ExtractError, native.NativeUnavailable, ValueError) as e:
                 if os.environ.get("AUTOMERGE_TPU_DEBUG"):
@@ -207,7 +207,7 @@ class OpLog:
                     RuntimeWarning,
                     stacklevel=2,
                 )
-        with trace.time("device.extract", changes=len(deduped)):
+        with obs.span("device.extract", changes=len(deduped)):
             return cls._collect_slow(log, deduped, rank_of)
 
     @classmethod
@@ -645,7 +645,7 @@ class OpLog:
         Caller contract: the active text encoding must match the one the
         resident columns were built under (as in ``from_documents``).
         """
-        from .. import trace
+        from .. import obs
 
         known = self.hashes()
         fresh: List[StoredChange] = []
@@ -661,10 +661,10 @@ class OpLog:
         if any(
             ch.op_col_data is None and ch.cached_cols is None for ch in fresh
         ):
-            trace.count("oplog.append_fallback", reason="no_columns")
+            obs.count("oplog.append_fallback", labels={"reason": "no_columns"})
             return None
         if not self._ensure_ref_keys():
-            trace.count("oplog.append_fallback", reason="missing_refs")
+            obs.count("oplog.append_fallback", labels={"reason": "missing_refs"})
             return None
 
         # -- actor universe (monotone rank remap keeps old order sorted) --
@@ -674,7 +674,7 @@ class OpLog:
         if actors_changed:
             all_bytes = sorted(old_bytes_set | delta_bytes)
             if len(all_bytes) >= (1 << ACTOR_BITS):
-                trace.count("oplog.append_fallback", reason="too_many_actors")
+                obs.count("oplog.append_fallback", labels={"reason": "too_many_actors"})
                 return None
         else:
             all_bytes = old_bytes
@@ -697,7 +697,7 @@ class OpLog:
                 return np.asarray(key, np.int64)
 
         # -- extract ONLY the fresh changes -------------------------------
-        with trace.time("device.extract", changes=len(fresh)):
+        with obs.span("device.extract", changes=len(fresh)):
             r = self._extract_delta(fresh, rank_of)
         if r is None:
             return None
@@ -719,13 +719,13 @@ class OpLog:
         order = np.argsort(r["id_key"], kind="stable")
         d_id = r["id_key"][order]
         if np.any(d_id[1:] == d_id[:-1]):
-            trace.count("oplog.append_fallback", reason="dup_op_id")
+            obs.count("oplog.append_fallback", labels={"reason": "dup_op_id"})
             return None
         pos = np.searchsorted(old_id, d_id)
         if n:
             posc = np.clip(pos, 0, n - 1)
             if np.any(old_id[posc] == d_id):
-                trace.count("oplog.append_fallback", reason="id_collision")
+                obs.count("oplog.append_fallback", labels={"reason": "id_collision"})
                 return None
         tail = n == 0 or pos[0] == n
         m = n + k
@@ -900,8 +900,8 @@ class OpLog:
         self._actor_order = None
         self.changes.extend(fresh)
         known.update(batch_seen)
-        trace.count("oplog.append_rows", n=k)
-        trace.event(
+        obs.count("oplog.append_rows", n=k)
+        obs.event(
             "oplog.append", rows=k, total=m, tail=int(tail),
             dirty_objs=len(dirty), actors_changed=int(actors_changed),
         )
@@ -942,9 +942,9 @@ class OpLog:
 
             return ranked_batch(list(fresh), rank_of)
         except (ExtractError, native.NativeUnavailable, ValueError):
-            from .. import trace
+            from .. import obs
 
-            trace.count("oplog.append_fallback", reason="extract_failed")
+            obs.count("oplog.append_fallback", labels={"reason": "extract_failed"})
             return None
 
     def _splice_values(self, a, order, row_map, new_rows, tail, m):

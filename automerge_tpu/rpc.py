@@ -37,6 +37,14 @@ Durability: ``python -m automerge_tpu.rpc --durable DIR`` enables
 (storage/durable.py), so every committed or sync-absorbed change is on
 disk before the response goes out; ``durableInfo`` / ``durableCompact``
 expose the journal state.
+
+Observability: every request is counted and timed into the labeled
+metrics registry (``rpc.request{method=...}`` latency histograms,
+``rpc.bytes_in``/``rpc.bytes_out``, ``rpc.errors{method=,type=}``,
+``rpc.request_bytes``), and the ``metrics`` method returns the whole
+registry — Prometheus text by default, ``{"format": "json"}`` for the
+structured snapshot — so an operator can scrape a running server over
+the same stdio channel.
 """
 
 from __future__ import annotations
@@ -47,6 +55,7 @@ import sys
 import time
 from typing import Dict, Optional
 
+from . import obs
 from .api import AutoDoc
 from .sync import SessionConfig, SyncSession, SyncState
 from .types import ActorId, ObjType, ScalarValue
@@ -539,6 +548,27 @@ class RpcServer:
         self._sessions.pop(p.get("session"), None)
         return None
 
+    # -- observability ------------------------------------------------------
+
+    def metrics(self, p):
+        """Metrics exposition for a live server. Default is Prometheus
+        text (``{"method": "metrics"}`` -> ``result.body``); ``{"format":
+        "json"}`` returns the structured snapshot plus the legacy
+        counter/timing views."""
+        fmt = p.get("format", "prometheus")
+        if fmt == "prometheus":
+            return {"format": "prometheus", "body": obs.render_prometheus()}
+        if fmt == "json":
+            with obs.registry.lock:
+                counters = dict(obs.legacy_counters)
+            return {
+                "format": "json",
+                "metrics": obs.snapshot(),
+                "counters": counters,
+                "timings": obs.timing_summary(),
+            }
+        raise ValueError(f"unknown metrics format {fmt!r}")
+
     # -- dispatch -----------------------------------------------------------
 
     # explicit allowlist: getattr dispatch must never reach serve/handle or
@@ -557,6 +587,7 @@ class RpcServer:
         "syncSessionReceive", "syncSessionStats", "syncSessionEncode",
         "syncSessionFree",
         "openDurable", "durableCompact", "durableInfo",
+        "metrics",
     })
 
     def handle(self, req: dict) -> dict:
@@ -565,15 +596,25 @@ class RpcServer:
         # the isinstance guard keeps unhashable method values (lists,
         # dicts) from raising out of the membership test
         if not isinstance(method, str) or method not in self.METHODS:
+            # "unknown" keeps the method label bounded by the allowlist
+            # (+1) no matter what a hostile client sends
+            obs.count("rpc.errors",
+                      labels={"method": "unknown", "type": "UnknownMethod"})
             return {"id": rid, "error": {"type": "UnknownMethod",
                                          "message": str(method)}}
-        try:
-            return {"id": rid, "result": getattr(self, method)(req.get("params") or {})}
-        except Exception as e:  # errors answer the request, never kill us
-            return {
-                "id": rid,
-                "error": {"type": type(e).__name__, "message": str(e)},
-            }
+        # the span doubles as the per-method request counter (histogram
+        # count) and latency distribution (rpc.request{method=...})
+        with obs.span("rpc.request", labels={"method": method}):
+            try:
+                return {"id": rid,
+                        "result": getattr(self, method)(req.get("params") or {})}
+            except Exception as e:  # errors answer the request, never kill us
+                obs.count("rpc.errors", labels={"method": method,
+                                                "type": type(e).__name__})
+                return {
+                    "id": rid,
+                    "error": {"type": type(e).__name__, "message": str(e)},
+                }
 
     @staticmethod
     def _json_default(v):
@@ -606,7 +647,11 @@ class RpcServer:
             len(line) if line.isascii()
             else len(line.encode("utf-8", errors="surrogatepass"))
         )
+        obs.count("rpc.bytes_in", n=nbytes)
+        obs.observe("rpc.request_bytes", nbytes)
         if nbytes > self.max_request_bytes:
+            obs.count("rpc.errors", labels={"method": "unknown",
+                                            "type": "RequestTooLarge"})
             return {"id": None, "error": {
                 "type": "RequestTooLarge",
                 "message": f"request of {nbytes} bytes exceeds limit "
@@ -614,9 +659,13 @@ class RpcServer:
         try:
             req = json.loads(line)
         except json.JSONDecodeError as e:
+            obs.count("rpc.errors", labels={"method": "unknown",
+                                            "type": "ParseError"})
             return {"id": None,
                     "error": {"type": "ParseError", "message": str(e)}}, False
         if not isinstance(req, dict):
+            obs.count("rpc.errors", labels={"method": "unknown",
+                                            "type": "ParseError"})
             return {"id": None, "error": {
                 "type": "ParseError",
                 "message": "request must be a JSON object"}}, False
@@ -662,8 +711,10 @@ class RpcServer:
                     return
                 resp, stop = self._handle_line(line)
                 if resp is not None:
+                    payload = self._encode_response(resp) + "\n"
+                    obs.count("rpc.bytes_out", n=len(payload))
                     try:
-                        stdout.write(self._encode_response(resp) + "\n")
+                        stdout.write(payload)
                         stdout.flush()
                     except Exception:
                         return  # client went away mid-response: shutdown

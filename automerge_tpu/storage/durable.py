@@ -16,7 +16,7 @@
 * ``open()`` replays snapshot + journal: the snapshot loads in salvage
   mode (a damaged one degrades instead of refusing), the journal
   truncates at the first torn record, and
-  ``trace.count("journal.replayed_records" / "journal.truncated_tail")``
+  ``obs.count("journal.replayed_records" / "journal.truncated_tail")``
   report what recovery did.
 
 The on-disk layout is a directory::
@@ -47,7 +47,7 @@ import contextlib
 import posixpath
 from typing import Dict, List, Optional
 
-from .. import trace
+from .. import obs
 from ..utils.leb128 import decode_uleb, encode_uleb
 from .change import parse_change
 from .journal import (
@@ -123,7 +123,7 @@ class DurableDocument:
         or core ``Document``. ``device=True`` additionally recovers a
         resident ``DeviceDoc``: built once from the snapshot, then warmed
         with the replayed journal changes through the incremental
-        ``OpLog.append_changes`` path (``trace.time("device.recover")``).
+        ``OpLog.append_changes`` path (``obs.span("device.recover")``).
         """
         if doc_factory is None:
             from ..api import AutoDoc
@@ -139,7 +139,7 @@ class DurableDocument:
         host = doc_factory(actor=actor, text_encoding=text_encoding)
         core = host.doc if hasattr(host, "doc") else host
 
-        with trace.time("durable.open"):
+        with obs.span("durable.open"):
             # the journal's lock comes FIRST: reading the snapshot before
             # holding it could pair an old snapshot with a journal another
             # process compacted in between, silently losing acked changes
@@ -171,7 +171,7 @@ class DurableDocument:
             from ..ops.device_doc import DeviceDoc
             from ..ops.oplog import OpLog
 
-            with trace.time("device.recover", phase="snapshot"):
+            with obs.span("device.recover", phase="snapshot"):
                 dev = DeviceDoc.resolve(
                     OpLog.from_changes([a.stored for a in core.history])
                 )
@@ -184,20 +184,20 @@ class DurableDocument:
                 except Exception:
                     # CRC-valid record with an unparseable chunk body:
                     # treat like a salvage drop, keep replaying
-                    trace.count("journal.rejected_records")
+                    obs.count("journal.rejected_records")
                     continue
                 replayed.append(change)
             elif rec.rec_type == REC_META:
                 name, blob = decode_meta(rec.payload)
                 meta[name] = blob
-        trace.count("journal.replayed_records", n=len(replayed))
+        obs.count("journal.replayed_records", n=len(replayed))
         if replayed:
             core.apply_changes(replayed)
             if device:
                 from ..ops.device_doc import DeviceDoc
                 from ..ops.oplog import OpLog
 
-                with trace.time("device.recover", changes=len(replayed)):
+                with obs.span("device.recover", changes=len(replayed)):
                     if dev is None:
                         dev = DeviceDoc.resolve(OpLog.from_changes(replayed))
                     else:
@@ -366,11 +366,11 @@ class DurableDocument:
             return False  # mid-manual-transaction: defer to the next ack
         self._compacting = True
         try:
-            with trace.time("compact.total"):
+            with obs.span("compact.total"):
                 data = self._host.save()
                 snap = posixpath.join(self.path, SNAPSHOT_NAME)
                 tmp = snap + ".tmp"
-                with trace.time("compact.snapshot", bytes=len(data)):
+                with obs.span("compact.snapshot", bytes=len(data)):
                     f = self._fs.open(tmp, "wb")
                     try:
                         f.write(data)
@@ -379,14 +379,14 @@ class DurableDocument:
                         f.close()
                     self._fs.replace(tmp, snap)
                     self._fs.sync_dir(self.path)
-                with trace.time("compact.truncate"):
+                with obs.span("compact.truncate"):
                     self._journal.truncate()
                     for name, blob in self._meta.items():
                         self._journal.append(
                             REC_META, encode_meta(name, blob), auto_sync=False
                         )
                     self._journal.sync()
-            trace.count("compact.runs")
+            obs.count("compact.runs")
             # the snapshot carries the FULL in-memory history, so disk is
             # caught up even if a journal append had failed earlier
             self._broken = False

@@ -15,7 +15,7 @@ journal record and a chunk verify identically. Unlike a document save the
 journal never resynchronises past damage: it is append-only, so the first
 record that fails to verify IS the torn tail — everything before it is
 intact, everything after it is dropped and the file is truncated back to
-the valid prefix (``trace.count("journal.truncated_tail")`` reports the
+the valid prefix (``obs.count("journal.truncated_tail")`` reports the
 bytes lost).
 
 Record types:
@@ -44,7 +44,7 @@ from __future__ import annotations
 import os
 from typing import List, NamedTuple, Optional, Tuple
 
-from .. import trace
+from .. import obs
 from ..utils.leb128 import LEBDecodeError, decode_uleb, encode_uleb
 from .chunk import chunk_hash
 
@@ -269,7 +269,7 @@ class Journal:
 
         Returns ``(journal, records, tail_report)``; when the tail was
         torn the file has already been truncated back to the valid prefix
-        and ``trace.count("journal.truncated_tail")`` records the bytes
+        and ``obs.count("journal.truncated_tail")`` records the bytes
         dropped.
         """
         fs = fs or OS_FS
@@ -302,7 +302,7 @@ class Journal:
             kept = sum(r.end - r.offset for r in salvaged)
             dropped = len(data) - kept
             if dropped:
-                trace.count("journal.truncated_tail", n=dropped)
+                obs.count("journal.truncated_tail", n=dropped)
             tmp = path + ".tmp"
             nf = fs.open(tmp, "wb")
             try:
@@ -331,7 +331,7 @@ class Journal:
                 tail,
             )
         if tail.torn:
-            trace.count("journal.truncated_tail", n=tail.dropped_bytes)
+            obs.count("journal.truncated_tail", n=tail.dropped_bytes)
             f.truncate(tail.valid_bytes)
             fs.fsync(f)
         return (
@@ -378,7 +378,7 @@ class Journal:
         if self._f is None:
             raise JournalError("journal is closed")
         rec = encode_record(rec_type, payload)
-        with trace.time("journal.append", bytes=len(rec)):
+        with obs.span("journal.append", bytes=len(rec)):
             try:
                 self._f.write(rec)
             except Exception:
@@ -423,7 +423,7 @@ class Journal:
             raise JournalError("journal is closed")
         if self._unsynced == 0:
             return
-        with trace.time("journal.fsync"):
+        with obs.span("journal.fsync", labels={"policy": self.fsync_policy}):
             self.fs.fsync(self._f)
         self._unsynced = 0
 

@@ -28,9 +28,12 @@ classic ARQ toolbox:
   transport destroyed), the session clears
   ``shared_heads``/``sent_hashes`` and forces a full resync on both ends.
 
-All recovery paths emit ``trace.count`` counters: ``sync.retry``,
-``sync.reset``, ``sync.resync``, ``sync.dup``, ``sync.malformed``,
-``sync.rejected``, ``sync.device_feed_error``.
+All recovery paths emit labeled ``obs`` counters (``sync.retry``,
+``sync.reset{source=peer|epoch}``, ``sync.resync``, ``sync.dup``,
+``sync.malformed{stage=frame|message}``, ``sync.rejected``,
+``sync.device_feed_error``), and the round phases run inside
+``obs.span``s (``sync.generate``, ``sync.receive`` > ``sync.apply``) so
+a whole session renders as a flame chart via ``obs.export_trace``.
 
 A session may carry a resident ``DeviceDoc`` (``device_doc=``): changes
 received off the wire feed its incremental append/re-resolve path
@@ -53,7 +56,7 @@ import zlib
 from collections import OrderedDict
 from typing import Optional
 
-from .. import trace
+from .. import obs
 from ..utils.leb128 import decode_uleb, encode_uleb
 from .protocol import (
     Message,
@@ -208,7 +211,8 @@ class SyncSession:
         # empty change lists forever) → renegotiate from scratch
         if self._noprogress >= self.config.stall_rounds and not self.converged():
             return self._force_resync(now)
-        msg = generate_sync_message(self._doc, self.state)
+        with obs.span("sync.generate"):
+            msg = generate_sync_message(self._doc, self.state)
         if msg is not None:
             return self._send(msg, now)
 
@@ -240,6 +244,10 @@ class SyncSession:
         """Feed bytes off the wire. Returns True if they advanced the
         session, False if they were dropped (corrupt or duplicate).
         Never raises on untrusted input."""
+        with obs.span("sync.receive", bytes=len(data)):
+            return self._receive(data, now)
+
+    def _receive(self, data: bytes, now: float) -> bool:
         try:
             epoch, flags, _seq, inner = decode_frame(data)
         except Exception as e:
@@ -249,14 +257,15 @@ class SyncSession:
                 msg = Message.decode(data)
             except Exception:
                 self.stats["malformed"] += 1
-                trace.count("sync.malformed", error=str(e))
+                obs.count("sync.malformed", labels={"stage": "frame"},
+                          error=str(e))
                 return False
             return self._apply(msg, now)
 
         digest = hashlib.sha256(data).digest()[:16]
         if digest in self._seen:
             self.stats["dups"] += 1
-            trace.count("sync.dup")
+            obs.count("sync.dup")
             self._want_retransmit = True
             return False
         self._seen[digest] = None
@@ -271,7 +280,7 @@ class SyncSession:
         if flags & FLAG_RESET:
             self._hard_reset(keep_shared=False)
             self.stats["resets"] += 1
-            trace.count("sync.reset", source="peer")
+            obs.count("sync.reset", labels={"source": "peer"})
 
         if not inner:
             return True  # pure control frame (reset/ack)
@@ -279,7 +288,8 @@ class SyncSession:
             msg = Message.decode(inner)
         except Exception as e:
             self.stats["malformed"] += 1
-            trace.count("sync.malformed", error=str(e))
+            obs.count("sync.malformed", labels={"stage": "message"},
+                      error=str(e))
             return False
         return self._apply(msg, now)
 
@@ -336,13 +346,17 @@ class SyncSession:
                 self.config.max_timeout,
             )
         )
-        trace.count("sync.retry", attempt=self._retries)
+        obs.count("sync.retry", attempt=self._retries)
         return self._last_frame
 
     def _with_jitter(self, timeout: float) -> float:
         return timeout * (1.0 + self.config.jitter * self._rng.random())
 
     def _apply(self, msg: Message, now: float) -> bool:
+        with obs.span("sync.apply", changes=len(msg.changes)):
+            return self._apply_inner(msg, now)
+
+    def _apply_inner(self, msg: Message, now: float) -> bool:
         if self._autodoc is not None:
             self._autodoc.commit()
         before = self._doc.get_heads()
@@ -365,7 +379,7 @@ class SyncSession:
                 # doc and re-created divergent history): absorb, count,
                 # keep going
                 self.stats["rejected"] += 1
-                trace.count("sync.rejected", error=str(e))
+                obs.count("sync.rejected", error=str(e))
                 return False
             # persist inside the scope: the meta record rides the same
             # single boundary fsync as the message's change records
@@ -378,7 +392,7 @@ class SyncSession:
             try:
                 self.device_doc.apply_changes(msg.changes)
             except Exception as e:  # noqa: BLE001 — isolate the sidecar
-                trace.count("sync.device_feed_error", error=str(e)[:200])
+                obs.count("sync.device_feed_error", error=str(e)[:200])
         self.stats["received"] += 1
         self._awaiting = False
         self._retries = 0
@@ -404,7 +418,7 @@ class SyncSession:
         except Exception as e:  # noqa: BLE001 — persistence is best-effort
             # NOT marked persisted: a transient failure retries on the
             # next call even if shared_heads never change again
-            trace.count("sync.persist_error", error=str(e)[:200])
+            obs.count("sync.persist_error", error=str(e)[:200])
         else:
             self._persisted_shared = cur
 
@@ -412,7 +426,7 @@ class SyncSession:
         self.peer_epoch = new_epoch
         self._hard_reset(keep_shared=True)
         self.stats["resets"] += 1
-        trace.count("sync.reset", source="epoch")
+        obs.count("sync.reset", labels={"source": "epoch"})
 
     def _hard_reset(self, keep_shared: bool) -> None:
         shared = list(self.state.shared_heads) if keep_shared else []
@@ -433,7 +447,7 @@ class SyncSession:
         """Divergence detected: renegotiate from nothing and tell the peer
         (RESET flag) to drop its suppressing sent_hashes too."""
         self.stats["resyncs"] += 1
-        trace.count("sync.resync")
+        obs.count("sync.resync")
         self._hard_reset(keep_shared=False)
         self._send_reset = True
         msg = generate_sync_message(self._doc, self.state)

@@ -1,131 +1,53 @@
-"""Tracing instrumentation (the reference weaves the ``tracing`` crate
-through load/commit/insert — automerge.rs:579,600, op_set.rs:232,
-transaction/inner.rs:80,122; here the standard logging module plays that
-role).
+"""Back-compat tracing facade over ``automerge_tpu.obs``.
 
-Disabled by default and free when off: every hook is guarded by
-``logger.isEnabledFor`` so the hot paths pay one cached attribute check.
-Enable with e.g.::
+Historically this module held two bare dicts (``counters``/``timings``)
+and standalone ``count``/``time``/``span``/``event`` helpers. The real
+implementation now lives in ``obs/`` — a thread-safe labeled metrics
+registry, hierarchical spans with Perfetto export, and Prometheus
+exposition — and these names are thin shims kept so every existing call
+site, test and bench consumer keeps working:
 
-    import logging
-    logging.getLogger("automerge_tpu").setLevel(logging.DEBUG)
-    logging.basicConfig()
+* ``trace.count(name, n, **fields)``  -> ``obs.count`` (lock-protected;
+  the old plain-dict increments raced between the RPC server and the
+  device staging path).
+* ``trace.time(name, **fields)`` / ``trace.span(...)`` -> ``obs.span``:
+  both now accumulate into ``trace.timings`` AND feed the span ring
+  buffer + per-name latency histograms (p50/p95/p99 via
+  ``obs.percentiles``).
+* ``trace.counters`` / ``trace.timings`` alias the same dict objects obs
+  maintains, so direct reads (bench JSON export, tests) see live data.
 
-or set AUTOMERGE_TPU_TRACE=1 in the environment before first import.
+Enable per-event log lines with ``AUTOMERGE_TPU_TRACE=1`` (or raise the
+``automerge_tpu`` logger to DEBUG); the metric/span accumulation is
+always on and cheap.
 """
 
 from __future__ import annotations
 
-import logging
-import os
-from time import perf_counter as _perf_counter
+from . import obs
 
-logger = logging.getLogger("automerge_tpu")
+logger = obs.logger
 
-if os.environ.get("AUTOMERGE_TPU_TRACE"):
-    logger.setLevel(logging.DEBUG)
-    if not logger.handlers:
-        logging.basicConfig()
+enabled = obs.enabled
+event = obs.event
 
-_DEBUG = logging.DEBUG
-
-
-def enabled() -> bool:
-    return logger.isEnabledFor(_DEBUG)
-
-
-def event(name: str, **fields) -> None:
-    """One structured trace line: ``name k=v k=v``."""
-    if logger.isEnabledFor(_DEBUG):
-        body = " ".join(f"{k}={v}" for k, v in fields.items())
-        logger.debug("%s %s", name, body)
-
-
-# -- counters ---------------------------------------------------------------
-# Degradation observability (sync.retry, sync.reset, load.salvaged_chunks,
-# ...): recovery paths are rare, so these always accumulate — one dict
-# increment — and additionally emit an ``event`` line when tracing is on.
-
-counters: dict = {}
+# the legacy dict views: same OBJECTS as obs.legacy_* (callers that stash,
+# clear and restore their contents — bench.py — keep working)
+counters = obs.legacy_counters
+timings = obs.legacy_timings
 
 
 def count(name: str, n: int = 1, **fields) -> None:
     """Increment the named counter and trace it (``name n=… k=v``)."""
-    counters[name] = counters.get(name, 0) + n
-    if logger.isEnabledFor(_DEBUG):
-        event(name, n=n, total=counters[name], **fields)
+    obs.count(name, n, **fields)
 
 
-def reset_counters() -> None:
-    counters.clear()
+# ``with trace.span("load", bytes=n):`` and ``with trace.time("device.kernel",
+# rows=n):`` are the same instrument now: a hierarchical obs span. (span
+# formerly only logged; it gains the always-on timing accumulation.)
+span = obs.span
+time = obs.span  # noqa: A001 — the public name IS trace.time
 
-
-class span:
-    """``with span("load", bytes=n):`` — logs entry/exit with wall time."""
-
-    __slots__ = ("name", "fields", "t0")
-
-    def __init__(self, name: str, **fields):
-        self.name = name
-        self.fields = fields
-        self.t0 = 0.0
-
-    def __enter__(self):
-        if logger.isEnabledFor(_DEBUG):
-            self.t0 = _perf_counter()
-            event(self.name, phase="begin", **self.fields)
-        return self
-
-    def __exit__(self, *exc):
-        if logger.isEnabledFor(_DEBUG):
-            ms = (_perf_counter() - self.t0) * 1e3
-            status = "error" if exc[0] else "ok"
-            event(self.name, phase="end", status=status, ms=round(ms, 2), **self.fields)
-        return False
-
-
-# -- timed spans -------------------------------------------------------------
-# Phase attribution (device.extract, device.h2d, device.kernel,
-# device.readback, device.materialize, ...): like the counters these always
-# accumulate — two perf_counter reads and a dict update per span — so the
-# bench can export wall-time breakdowns without tracing enabled. An
-# ``event`` line is additionally emitted when tracing is on.
-
-timings: dict = {}  # name -> [total_seconds, count]
-
-
-class time:  # noqa: A001 — the public name IS trace.time
-    """``with trace.time("device.kernel", rows=n):`` — accumulate wall time
-    under the named phase in ``trace.timings``."""
-
-    __slots__ = ("name", "fields", "t0")
-
-    def __init__(self, name: str, **fields):
-        self.name = name
-        self.fields = fields
-        self.t0 = 0.0
-
-    def __enter__(self):
-        self.t0 = _perf_counter()
-        return self
-
-    def __exit__(self, *exc):
-        dt = _perf_counter() - self.t0
-        slot = timings.get(self.name)
-        if slot is None:
-            timings[self.name] = [dt, 1]
-        else:
-            slot[0] += dt
-            slot[1] += 1
-        if logger.isEnabledFor(_DEBUG):
-            event(self.name, ms=round(dt * 1e3, 3), **self.fields)
-        return False
-
-
-def reset_timers() -> None:
-    timings.clear()
-
-
-def timing_summary() -> dict:
-    """{name: {"s": total seconds, "n": span count}} snapshot."""
-    return {k: {"s": round(v[0], 6), "n": v[1]} for k, v in timings.items()}
+reset_counters = obs.reset_counters
+reset_timers = obs.reset_timers
+timing_summary = obs.timing_summary

@@ -395,7 +395,21 @@ def main():
     # state. p50 per-delta latency is the headline (the first delta pays the
     # new-actor rank remap; the median is the steady state the sync path
     # sees). Device-phase spans (trace.time) are exported as phases_s.
+    from automerge_tpu import obs
     from automerge_tpu import trace as T
+
+    def _latency_percentiles(hist_name, latencies):
+        """Feed raw per-iteration latencies into the named obs histogram
+        and report its log-bucket-derived p50/p95/p99 (what a scraper of
+        the Prometheus exposition would compute)."""
+        h = obs.registry.histogram(hist_name)
+        for x in latencies:
+            h.observe(x)
+        return {
+            "latency_p50_s": round(h.percentile(0.50), 6),
+            "latency_p95_s": round(h.percentile(0.95), 6),
+            "latency_p99_s": round(h.percentile(0.99), 6),
+        }
 
     inc_k = env_int("BENCH_INC_DELTAS", 16)
     inc_ops = env_int("BENCH_INC_OPS", 250)
@@ -431,6 +445,7 @@ def main():
             "resident_ops": dev.log.n,
             "p50_delta_latency_s": round(p50, 5),
             "max_delta_latency_s": round(lat[-1], 5),
+            **_latency_percentiles("bench.incremental.delta_latency", lats),
             "delta_ops_per_sec": round(delta_ops / p50, 1),
             "from_scratch_s": round(t_scratch, 4),
             "speedup_vs_rebuild": round(t_scratch / p50, 2),
@@ -548,10 +563,11 @@ def main():
         s1, s2 = SyncState(), SyncState()
         ph = {"gen_ahead": 0.0, "gen_behind": 0.0,
               "recv_behind": 0.0, "recv_ahead": 0.0, "read": 0.0}
+        round_lats = []
         t0 = time.perf_counter()
         rounds = 0
         while True:
-            t = time.perf_counter()
+            t = r0 = time.perf_counter()
             m1 = ahead.generate_sync_message(s1)
             ph["gen_ahead"] += time.perf_counter() - t
             t = time.perf_counter()
@@ -568,6 +584,7 @@ def main():
                 ahead.receive_sync_message(s1, m2)
                 ph["recv_ahead"] += time.perf_counter() - t
             rounds += 1
+            round_lats.append(time.perf_counter() - r0)
             if rounds > 100:
                 raise RuntimeError("sync did not converge")
         # one read inside the timed region: op-store materialization is
@@ -578,12 +595,17 @@ def main():
         dt = time.perf_counter() - t0
         assert behind.get_heads() == ahead.get_heads()
         assert behind_text == ahead_text
-        return dt, rounds, ph
+        return dt, rounds, ph, round_lats
 
-    # best-of-reps like every other config (a fresh replica per rep)
-    t_sync, rounds, phases = sync_once()
+    # best-of-reps like every other config (a fresh replica per rep);
+    # per-round latencies from EVERY rep feed the histogram (the spread
+    # is the signal — best-of hides the tail)
+    all_round_lats = []
+    t_sync, rounds, phases, rl = sync_once()
+    all_round_lats.extend(rl)
     for _ in range(reps - 1):
-        dt, r, p = sync_once()
+        dt, r, p, rl = sync_once()
+        all_round_lats.extend(rl)
         if dt < t_sync:
             t_sync, rounds, phases = dt, r, p
     sync_rate = n_synced / t_sync
@@ -592,6 +614,7 @@ def main():
         "rounds": rounds,
         "seconds": round(t_sync, 3),
         "phases_s": {k: round(v, 3) for k, v in phases.items()},
+        **_latency_percentiles("bench.sync.round_latency", all_round_lats),
         "ops_per_sec": round(sync_rate, 1),
         "vs_baseline": round(sync_rate / RUST_PIN_APPLY, 4),
     }
@@ -672,10 +695,13 @@ def main():
             os.path.join(tmpd, "doc"), fsync=dur_fsync,
             actor=ActorId(bytes([14]) * 16),
         )
+        commit_lats = []
         t0 = time.perf_counter()
         for i in range(n_dur):
+            c0 = time.perf_counter()
             dd.put("_root", f"k{i % 512:04}", i)
             dd.commit()
+            commit_lats.append(time.perf_counter() - c0)
         t_commits = time.perf_counter() - t0
         dd.close()
         compactions = T.counters.get("compact.runs", 0)
@@ -691,6 +717,7 @@ def main():
             "commits": n_dur,
             "fsync": dur_fsync,
             "commits_per_sec": round(n_dur / t_commits, 1),
+            **_latency_percentiles("bench.durable.commit_latency", commit_lats),
             "journal_append_s": tj.get("journal.append", {}).get("s", 0.0),
             "journal_fsync_s": tj.get("journal.fsync", {}).get("s", 0.0),
             "compactions": compactions,
@@ -720,6 +747,17 @@ def main():
         # (trace.time spans: device.extract / h2d / kernel / readback /
         # materialize, merge.host)
         "trace_timings": T.timing_summary(),
+        # tail attribution: per-phase latency distributions from the span
+        # histograms (log-bucketed; "what is p99 merge latency")
+        "phase_percentiles": {
+            e["name"] + "".join(
+                "{%s=%s}" % (k, v) for k, v in sorted(e["labels"].items())
+            ): {k: round(e[k], 6) for k in ("p50", "p95", "p99")}
+            for e in obs.snapshot()
+            if e["type"] == "histogram"
+            and e["name"].startswith(("device.", "merge.", "journal.",
+                                      "sync.", "compact."))
+        },
     }
     print(json.dumps(out))
 

@@ -1,0 +1,470 @@
+"""Unified observability (automerge_tpu/obs): labeled metrics registry,
+log-bucketed histograms + percentiles, hierarchical spans with Perfetto
+export, Prometheus exposition, and the trace.py back-compat shims."""
+
+import json
+import logging
+import math
+import threading
+
+import numpy as np
+import pytest
+
+from automerge_tpu import obs, trace
+from automerge_tpu.api import AutoDoc
+from automerge_tpu.obs.metrics import (
+    FACTOR,
+    MetricsRegistry,
+    parse_prometheus,
+)
+from automerge_tpu.types import ActorId
+
+
+# -- histogram bucket boundaries & percentile math ---------------------------
+
+
+def test_histogram_bucket_boundaries():
+    reg = MetricsRegistry()
+    h = reg.histogram("x")
+    # 1.0 sits exactly on the upper bound of bucket 0 -> (FACTOR^-1, 1.0]
+    h.observe(1.0)
+    cum = h.cumulative_buckets()
+    assert cum == [(1.0, 1)]
+    # nudging past the boundary moves to the next bucket, le == FACTOR
+    h.observe(1.0 + 1e-9)
+    cum = dict(h.cumulative_buckets())
+    assert cum[1.0] == 1
+    assert math.isclose(max(cum), FACTOR)
+    # zero and negatives take the dedicated zero bucket (le == 0.0)
+    h.observe(0.0)
+    h.observe(-3.0)
+    assert dict(h.cumulative_buckets())[0.0] == 2
+    assert h.n == 4 and h.vmin == -3.0
+
+
+def test_histogram_percentiles_match_numpy():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat")
+    rng = np.random.default_rng(7)
+    xs = rng.lognormal(mean=-6.0, sigma=1.5, size=4000)
+    for x in xs:
+        h.observe(float(x))
+    for q in (0.50, 0.95, 0.99):
+        est = h.percentile(q)
+        exact = float(np.quantile(xs, q))
+        # one log bucket is ~19% wide; that bounds the estimate error
+        assert abs(est - exact) / exact < 0.2, (q, est, exact)
+    # exact accumulators are untouched by bucketing
+    assert h.n == len(xs)
+    assert math.isclose(h.total, float(xs.sum()), rel_tol=1e-9)
+    assert h.percentile(0.0) >= h.vmin and h.percentile(1.0) <= h.vmax
+
+
+def test_histogram_empty_and_summary():
+    reg = MetricsRegistry()
+    h = reg.histogram("empty")
+    assert h.percentile(0.5) == 0.0
+    s = h.summary()
+    assert s["count"] == 0 and s["sum"] == 0.0
+    h.observe(2.0)
+    s = h.summary()
+    assert s["count"] == 1 and s["p50"] == 2.0  # clamped to min==max
+
+
+# -- labels & cardinality ----------------------------------------------------
+
+
+def test_label_cardinality_cap():
+    reg = MetricsRegistry(max_label_sets=4)
+    for i in range(20):
+        reg.counter("req", peer=f"p{i}").inc()
+    fam = reg._families[("req", "counter")]
+    # 4 real children + the overflow catch-all
+    assert len(fam.children) == 5
+    overflow = reg.counter("req", overflow="true")
+    assert overflow.value == 16
+    total = sum(c.value for c in fam.children.values())
+    assert total == 20  # no increment is lost, only its label detail
+
+
+def test_same_name_counter_and_histogram_coexist():
+    reg = MetricsRegistry()
+    reg.counter("device.delta_resolve").inc()
+    reg.histogram("device.delta_resolve").observe(0.5)
+    text = reg.render_prometheus()
+    parsed = parse_prometheus(text)
+    assert parsed[("device_delta_resolve_total", ())] == 1.0
+    assert parsed[("device_delta_resolve_count", ())] == 1.0
+
+
+# -- concurrency -------------------------------------------------------------
+
+
+def test_concurrent_increments_are_exact():
+    obs.reset_all()
+    n_threads, n_incs = 8, 2500
+
+    def worker(k):
+        for i in range(n_incs):
+            trace.count("stress.total")  # the shim path (the old race)
+            obs.count("stress.labeled", labels={"t": str(k)})
+            with obs.span("stress.span"):
+                pass
+
+    threads = [threading.Thread(target=worker, args=(k,)) for k in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    want = n_threads * n_incs
+    assert trace.counters["stress.total"] == want
+    assert trace.counters["stress.labeled"] == want
+    per_label = [
+        obs.registry.counter("stress.labeled", t=str(k)).value
+        for k in range(n_threads)
+    ]
+    assert per_label == [n_incs] * n_threads
+    assert trace.timings["stress.span"][1] == want
+    assert obs.registry.histogram("stress.span").n == want
+
+
+# -- Prometheus exposition ---------------------------------------------------
+
+
+def test_prometheus_render_round_trip():
+    reg = MetricsRegistry()
+    reg.counter("sync.retry").inc(3)
+    reg.counter("sync.reset", source="peer").inc()
+    reg.gauge("journal.bytes", path="/tmp/x").set(1234.5)
+    # hostile label values: spaces, '=', quotes, backslash, newline
+    reg.counter("rpc.errors", type='Bad "quote"=x\\y\nz').inc(2)
+    h = reg.histogram("rpc.request", method="put")
+    for v in (0.001, 0.002, 0.004, 5.0):
+        h.observe(v)
+    text = reg.render_prometheus()
+    parsed = parse_prometheus(text)
+    assert parsed[("sync_retry_total", ())] == 3.0
+    assert parsed[("sync_reset_total", (("source", "peer"),))] == 1.0
+    assert parsed[("journal_bytes", (("path", "/tmp/x"),))] == 1234.5
+    assert parsed[
+        ("rpc_errors_total", (("type", 'Bad "quote"=x\\y\nz'),))
+    ] == 2.0
+    assert parsed[("rpc_request_count", (("method", "put"),))] == 4.0
+    assert math.isclose(
+        parsed[("rpc_request_sum", (("method", "put"),))], 5.007
+    )
+    # cumulative bucket series: the +Inf bucket equals the count, and
+    # cumulative counts are monotone over increasing le
+    buckets = sorted(
+        (math.inf if dict(k[1])["le"] == "+Inf" else float(dict(k[1])["le"]), v)
+        for k, v in parsed.items()
+        if k[0] == "rpc_request_bucket"
+    )
+    counts = [v for _, v in buckets]
+    assert counts == sorted(counts) and counts[-1] == 4.0
+    # TYPE lines are present and well-formed
+    assert "# TYPE sync_retry_total counter" in text
+    assert "# TYPE rpc_request histogram" in text
+    assert "# TYPE journal_bytes gauge" in text
+
+
+def test_prometheus_name_sanitization():
+    reg = MetricsRegistry()
+    reg.counter("load.salvaged-chunks").inc()
+    parsed = parse_prometheus(reg.render_prometheus())
+    assert parsed[("load_salvaged_chunks_total", ())] == 1.0
+
+
+# -- spans & Perfetto export -------------------------------------------------
+
+
+def test_span_nesting_and_export(tmp_path):
+    obs.reset_all()
+    with obs.span("outer", kind="test"):
+        with obs.span("middle"):
+            with obs.span("leaf", rows=7):
+                pass
+        with obs.span("middle2"):
+            pass
+    path = str(tmp_path / "trace.json")
+    n = obs.export_trace(path)
+    assert n == 4
+    doc = json.load(open(path))
+    events = doc["traceEvents"]
+    assert isinstance(events, list) and len(events) == 4
+    by_name = {e["name"]: e for e in events}
+    for e in events:  # chrome-trace schema
+        assert e["ph"] == "X"
+        for key in ("name", "cat", "ts", "dur", "pid", "tid", "args"):
+            assert key in e, (key, e)
+    outer, middle, leaf = by_name["outer"], by_name["middle"], by_name["leaf"]
+    assert "parent_id" not in outer["args"]
+    assert middle["args"]["parent_id"] == outer["args"]["span_id"]
+    assert leaf["args"]["parent_id"] == middle["args"]["span_id"]
+    assert by_name["middle2"]["args"]["parent_id"] == outer["args"]["span_id"]
+    # time containment (what makes Perfetto render the flame chart)
+    for child, parent in ((middle, outer), (leaf, middle)):
+        assert child["ts"] >= parent["ts"]
+        assert child["ts"] + child["dur"] <= parent["ts"] + parent["dur"] + 1e-3
+    assert outer["args"]["kind"] == "test" and leaf["args"]["rows"] == 7
+
+
+def test_span_ring_buffer_is_bounded():
+    rec = obs.SpanRecorder(capacity=16)
+    for i in range(100):
+        rec.record(obs.SpanRecord(f"s{i}", i, None, 0.0, 0.1, 1, {}, "ok"))
+    assert len(rec) == 16
+    assert rec.snapshot()[0].name == "s84"  # oldest evicted
+
+
+def test_span_error_status():
+    obs.reset_all()
+    with pytest.raises(ValueError):
+        with obs.span("boom"):
+            raise ValueError("x")
+    rec = obs.recorder.snapshot()[-1]
+    assert rec.name == "boom" and rec.status == "error"
+    assert trace.timings["boom"][1] == 1  # timing still accumulated
+
+
+def _mesh_device_apply(n_deltas=3):
+    """A base doc + a few committed deltas pushed through the persistent
+    DeviceDoc incremental path (CPU backend)."""
+    from automerge_tpu.ops import DeviceDoc, OpLog
+
+    base = AutoDoc(actor=ActorId(bytes([1]) * 16))
+    from automerge_tpu.types import ObjType
+
+    tobj = base.put_object("_root", "t", ObjType.TEXT)
+    base.splice_text(tobj, 0, 0, "hello world")
+    base.commit()
+    dev = DeviceDoc.resolve(OpLog.from_changes(
+        [a.stored for a in base.doc.history]
+    ))
+    for i in range(n_deltas):
+        base.splice_text(tobj, 0, 0, f"d{i} ")
+        base.commit()
+        dev.apply_changes([base.doc.history[-1].stored])
+    return dev
+
+
+def test_export_covers_device_merge_apply_and_sync_round(tmp_path):
+    """Acceptance: a full device-merge apply and a full sync round render
+    as nested spans in the exported Perfetto JSON."""
+    obs.reset_all()
+    _mesh_device_apply()
+
+    # one full sync round through resilient sessions
+    from automerge_tpu.sync.session import SyncSession
+
+    a, b = AutoDoc(), AutoDoc()
+    a.put("_root", "x", 1)
+    a.commit()
+    sa, sb = SyncSession(a, epoch=1), SyncSession(b, epoch=2)
+    for tick in range(32):
+        fa, fb = sa.poll(float(tick)), sb.poll(float(tick))
+        if fa is not None:
+            sb.receive(fa, float(tick))
+        if fb is not None:
+            sa.receive(fb, float(tick))
+        if sa.converged() and sb.converged():
+            break
+    assert a.get_heads() == b.get_heads()
+
+    path = str(tmp_path / "pipeline.json")
+    obs.export_trace(path)
+    events = json.load(open(path))["traceEvents"]
+    by_id = {e["args"]["span_id"]: e for e in events}
+    names = {e["name"] for e in events}
+    # the device-merge pipeline spans, nested under device.apply
+    assert {"device.apply", "device.extract"} <= names, names
+    applies = [e for e in events if e["name"] == "device.apply"]
+    nested_in_apply = {
+        e["name"]
+        for e in events
+        if e["args"].get("parent_id") in {a_["args"]["span_id"] for a_ in applies}
+    }
+    assert "device.extract" in nested_in_apply or "device.delta_resolve" in nested_in_apply
+    # the sync round spans: receive wraps apply
+    assert {"sync.generate", "sync.receive", "sync.apply"} <= names, names
+    sync_applies = [e for e in events if e["name"] == "sync.apply"]
+    assert sync_applies
+    for e in sync_applies:
+        parent = by_id[e["args"]["parent_id"]]
+        assert parent["name"] == "sync.receive"
+
+
+# -- structured event lines (k=v escaping) -----------------------------------
+
+
+def test_event_quotes_hostile_values():
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    h = Capture()
+    obs.logger.addHandler(h)
+    old = obs.logger.level
+    obs.logger.setLevel(logging.DEBUG)
+    try:
+        obs.event("sync.malformed", error='bad frame: got "x" a=1 b\\c',
+                  n=3, ok="plain")
+    finally:
+        obs.logger.removeHandler(h)
+        obs.logger.setLevel(old)
+    (line,) = records
+    name, _, body = line.partition(" ")
+    assert name == "sync.malformed"
+    fields = obs.parse_event_fields(body)
+    assert fields["error"] == 'bad frame: got "x" a=1 b\\c'
+    assert fields["n"] == "3" and fields["ok"] == "plain"
+    # literal backslash-n must round-trip as backslash+n, not newline
+    # (sequential-replace unescaping gets this wrong)
+    for hostile in ("path\\nfile", "C:\\new\\table", 'x\\"y', "a\nb\\n"):
+        enc = obs._fmt_field(hostile)
+        assert obs.parse_event_fields(f"v={enc}")["v"] == hostile, hostile
+    # unquoted simple values stay bare (grep-ably identical to before)
+    assert "ok=plain" in body and 'n=3' in body
+
+
+# -- back-compat shims -------------------------------------------------------
+
+
+def test_trace_shims_feed_legacy_views():
+    trace.reset_counters()
+    trace.reset_timers()
+    trace.count("compat.hits")
+    trace.count("compat.hits", n=4)
+    assert trace.counters["compat.hits"] == 5
+    with trace.time("compat.phase", rows=3):
+        pass
+    with trace.span("compat.phase"):
+        pass
+    summary = trace.timing_summary()
+    assert summary["compat.phase"]["n"] == 2
+    assert summary["compat.phase"]["s"] >= 0.0
+    trace.reset_timers()
+    assert trace.timing_summary() == {}
+    trace.reset_counters()
+    assert trace.counters == {}
+    # the shim shares the obs registry: labels visible in Prometheus
+    obs.count("compat.labeled", labels={"kind": "a"})
+    assert ("compat_labeled_total", (("kind", "a"),)) in parse_prometheus(
+        obs.render_prometheus()
+    )
+
+
+def test_trace_dicts_alias_obs_objects():
+    # bench.py stashes/clears/updates trace.timings in place; that only
+    # works if the module-level names alias the live obs dicts
+    assert trace.counters is obs.legacy_counters
+    assert trace.timings is obs.legacy_timings
+
+
+# -- RPC + CLI surfaces ------------------------------------------------------
+
+
+def test_rpc_metrics_method_round_trips():
+    from automerge_tpu.rpc import RpcServer
+
+    obs.reset_all()
+    srv = RpcServer()
+    doc = srv.handle({"id": 1, "method": "create", "params": {}})["result"]["doc"]
+    srv.handle({"id": 2, "method": "put",
+                "params": {"doc": doc, "obj": "_root", "prop": "k", "value": 1}})
+    srv.handle({"id": 3, "method": "nope"})          # unknown method
+    srv.handle({"id": 4, "method": "put", "params": {"doc": 999}})  # error
+    out = srv.handle({"id": 5, "method": "metrics", "params": {}})
+    body = out["result"]["body"]
+    assert out["result"]["format"] == "prometheus"
+    parsed = parse_prometheus(body)
+    assert parsed[("rpc_request_count", (("method", "create"),))] == 1.0
+    assert parsed[("rpc_request_count", (("method", "put"),))] == 2.0
+    assert parsed[
+        ("rpc_errors_total", (("method", "unknown"), ("type", "UnknownMethod")))
+    ] == 1.0
+    assert parsed[
+        ("rpc_errors_total", (("method", "put"), ("type", "ValueError")))
+    ] == 1.0
+    # json format carries the structured snapshot + legacy views
+    js = srv.handle({"id": 6, "method": "metrics",
+                     "params": {"format": "json"}})["result"]
+    assert any(e["name"] == "rpc.request" for e in js["metrics"])
+    assert isinstance(js["counters"], dict) and isinstance(js["timings"], dict)
+
+
+def test_rpc_serve_instruments_bytes(tmp_path):
+    import io
+
+    from automerge_tpu.rpc import RpcServer
+
+    obs.reset_all()
+    reqs = "\n".join([
+        json.dumps({"id": 1, "method": "create", "params": {}}),
+        "this is not json",
+        json.dumps({"id": 2, "method": "shutdown"}),
+    ]) + "\n"
+    out = io.StringIO()
+    RpcServer().serve(stdin=io.StringIO(reqs), stdout=out)
+    assert trace.counters["rpc.bytes_in"] > 0
+    assert trace.counters["rpc.bytes_out"] > 0
+    parsed = parse_prometheus(obs.render_prometheus())
+    assert parsed[
+        ("rpc_errors_total", (("method", "unknown"), ("type", "ParseError")))
+    ] == 1.0
+    assert parsed[("rpc_request_bytes_count", ())] == 3.0
+
+
+def test_cli_metrics_subcommand(tmp_path, capsys):
+    from automerge_tpu.cli import main
+
+    doc = AutoDoc(actor=ActorId(bytes([3]) * 16))
+    doc.put("_root", "k", 42)
+    doc.commit()
+    save = tmp_path / "doc.automerge"
+    save.write_bytes(doc.save())
+    prom = tmp_path / "metrics.prom"
+    tracef = tmp_path / "trace.json"
+    rc = main(["metrics", str(save), "-o", str(prom),
+               "--trace-out", str(tracef)])
+    assert rc == 0
+    parsed = parse_prometheus(prom.read_text())
+    assert ("load_count", ()) in parsed  # the instrumented load span
+    events = json.load(open(tracef))["traceEvents"]
+    assert any(e["name"] == "load" for e in events)
+    # json format on a durable directory
+    ddir = tmp_path / "dur"
+    dd = AutoDoc.open(str(ddir), fsync="never")
+    dd.put("_root", "x", 1)
+    dd.commit()
+    dd.close()
+    out_json = tmp_path / "m.json"
+    rc = main(["metrics", str(ddir), "--format", "json", "-o", str(out_json)])
+    assert rc == 0
+    snap = json.loads(out_json.read_text())
+    assert "journal.replayed_records" in snap["counters"]
+
+
+# -- overhead guard ----------------------------------------------------------
+
+
+def test_disabled_path_overhead_is_bounded():
+    """Always-on span/counter cost must stay micro-scale with tracing off
+    (the hot paths run these per delta/append). Generous bound: CI boxes
+    are noisy; the real budget is asserted relatively in scripts/ci/run_obs."""
+    assert not obs.enabled()
+    import timeit
+
+    def one_span():
+        with obs.span("ovh.span"):
+            pass
+
+    one_span()  # warm (family + child creation)
+    obs.count("ovh.count")
+    n = 2000
+    t = timeit.timeit(one_span, number=n) / n
+    assert t < 500e-6, f"span cost {t * 1e6:.1f}us"
+    t = timeit.timeit(lambda: obs.count("ovh.count"), number=n) / n
+    assert t < 200e-6, f"count cost {t * 1e6:.1f}us"
