@@ -1,5 +1,5 @@
 """Command-line interface: export / import / merge / examine / examine-sync
-/ change / journal-info / compact / metrics.
+/ change / journal-info / compact / metrics / serve.
 
 Mirrors the reference CLI's subcommands (reference:
 rust/automerge-cli/src/main.rs:81-161). Documents read and write the
@@ -361,6 +361,31 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """Run the concurrent JSON-RPC server (serve/server.py) over TCP or
+    a unix-domain socket — the same method surface as the stdio frontend
+    (``python -m automerge_tpu.rpc``) with per-document parallelism,
+    group-commit durability and backpressure. Delegates to rpc.main so
+    both entry points stay behaviourally identical."""
+    from .rpc import main as rpc_main
+
+    argv = []
+    if args.socket:
+        argv += ["--socket", args.socket]
+    if args.unix:
+        argv += ["--unix", args.unix]
+    if args.durable:
+        argv += ["--durable", args.durable]
+    if args.workers is not None:
+        argv += ["--workers", str(args.workers)]
+    if not args.socket and not args.unix:
+        print("serve: provide --socket HOST:PORT or --unix PATH "
+              "(plain stdio mode is `python -m automerge_tpu.rpc`)",
+              file=sys.stderr)
+        return 1
+    return rpc_main(argv)
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="automerge_tpu", description=__doc__)
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -413,6 +438,21 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--trace-out", default=None, metavar="PATH",
                     help="also export recorded spans as Perfetto/"
                          "Chrome-trace JSON to PATH")
+
+    sp = sub.add_parser(
+        "serve",
+        help="run the concurrent JSON-RPC server over TCP or unix socket",
+    )
+    sp.set_defaults(fn=cmd_serve)
+    sp.add_argument("--socket", metavar="HOST:PORT", default=None,
+                    help="TCP listen address (port 0 picks a free port)")
+    sp.add_argument("--unix", metavar="PATH", default=None,
+                    help="unix-domain socket path")
+    sp.add_argument("--durable", metavar="DIR", default=None,
+                    help="enable openDurable persistence under DIR")
+    sp.add_argument("--workers", type=int, default=None,
+                    help="worker pool size (default "
+                         "AUTOMERGE_TPU_SERVE_WORKERS or 8)")
 
     sp = add("change", cmd_change, help="apply an edit script to a document")
     sp.add_argument("input", nargs="?", help="input .automerge file (omit to start empty)")

@@ -186,7 +186,14 @@ class DeviceDoc:
         if not ready:
             return 0
         with obs.span("device.apply", changes=len(ready)):
-            info = self.log.append_changes(ready) if incremental else None
+            # an empty resident log (a device doc opened before any
+            # history existed) has no actor table to splice into: the
+            # rebuild path IS the initial build
+            info = (
+                self.log.append_changes(ready)
+                if incremental and self.log.n
+                else None
+            )
             if info is None:
                 obs.count("device.apply_rebuild")
                 self._rebuild(list(self.log.changes) + ready)
@@ -206,6 +213,10 @@ class DeviceDoc:
 
         if self._base is not self:
             raise ValueError("apply_batches on a historical view; use the base doc")
+        if len(batches) > 1:
+            # the serving layer's sync coalescing lands here: how many
+            # per-message applies each drain amortized is the signal
+            obs.count("device.coalesced_batches", n=len(batches))
         if jax.default_backend() == "cpu":
             return sum(self.apply_changes(b) for b in batches)
         total = 0
@@ -214,7 +225,7 @@ class DeviceDoc:
             ready = self._take_ready(chs)
             if not ready:
                 continue
-            info = self.log.append_changes(ready)
+            info = self.log.append_changes(ready) if self.log.n else None
             if info is None:
                 if inflight is not None:
                     self._collect_async(inflight)

@@ -38,6 +38,12 @@ Durability: ``python -m automerge_tpu.rpc --durable DIR`` enables
 disk before the response goes out; ``durableInfo`` / ``durableCompact``
 expose the journal state.
 
+Concurrency: ``--socket HOST:PORT`` / ``--unix PATH`` serve the same
+protocol concurrently (serve/server.py) — per-document single-writer
+shards, bounded queues with a ``Backpressure`` error, group-commit
+durable acks, coalesced sync receives. The stdio mode here stays a
+strictly serial single-client loop.
+
 Observability: every request is counted and timed into the labeled
 metrics registry (``rpc.request{method=...}`` latency histograms,
 ``rpc.bytes_in``/``rpc.bytes_out``, ``rpc.errors{method=,type=}``,
@@ -51,7 +57,9 @@ from __future__ import annotations
 
 import base64
 import json
+import os
 import sys
+import threading
 import time
 from typing import Dict, Optional
 
@@ -151,13 +159,24 @@ class RpcServer:
         # the crash-safe journal + snapshot layer (storage/durable.py)
         self.durable_dir = durable_dir
         self._durable_names: Dict[str, int] = {}  # name -> open handle
+        # handle-table guard: the socket serving layer (serve/) registers
+        # and frees handles from many threads; stdio mode pays one
+        # uncontended RLock acquisition per registration
+        self._lock = threading.RLock()
+        # session handle -> doc handle, so the serving layer can route
+        # session-only requests (poll/receive/stats) to the doc's shard
+        self._session_docs: Dict[int, int] = {}
+        # set by SocketRpcServer: durable docs opened through a concurrent
+        # server compact on a background thread instead of the ack path
+        self.serve_background_compact = False
 
     # -- handle plumbing ----------------------------------------------------
 
     def _reg(self, table, value) -> int:
-        h = self._next
-        self._next += 1
-        table[h] = value
+        with self._lock:
+            h = self._next
+            self._next += 1
+            table[h] = value
         return h
 
     def _doc(self, p) -> AutoDoc:
@@ -216,14 +235,17 @@ class RpcServer:
                 "maxRequestBytes": self.max_request_bytes}
 
     def free(self, p):
-        doc = self._docs.pop(p["doc"], None)
-        self._patched.discard(p["doc"])
-        if doc is not None and hasattr(doc, "journal"):  # durable wrapper
-            # drop the name mapping BEFORE closing: if close raises, the
-            # name must not stay pointed at a dead handle
-            self._durable_names = {
-                n: h for n, h in self._durable_names.items() if h != p["doc"]
-            }
+        with self._lock:
+            doc = self._docs.pop(p["doc"], None)
+            self._patched.discard(p["doc"])
+            if doc is not None and hasattr(doc, "journal"):  # durable wrapper
+                # drop the name mapping BEFORE closing: if close raises,
+                # the name must not stay pointed at a dead handle
+                self._durable_names = {
+                    n: h for n, h in self._durable_names.items()
+                    if h != p["doc"]
+                }
+        if doc is not None and hasattr(doc, "journal"):
             doc.close()
         return None
 
@@ -241,14 +263,21 @@ class RpcServer:
     def openDurable(self, p):
         """Open (or create) the named durable document under the server's
         --durable directory; reopening an already-open name returns the
-        same handle (two live journals on one file would corrupt it)."""
+        same handle (two live journals on one file would corrupt it).
+        ``device: true`` additionally recovers a resident DeviceDoc whose
+        incremental path absorbs sync-received changes."""
         name = p.get("name")
         path = self._durable_path(name)
-        h = self._durable_names.get(name)
-        if h is not None and h in self._docs:
+        # the name-cache read and the live-handle check must be one
+        # atomic snapshot: a concurrent free() pops both under this lock,
+        # so we either see the live doc or neither — never a handle whose
+        # journal a racing free is mid-close on
+        with self._lock:
+            h = self._durable_names.get(name)
+            live = self._docs.get(h) if h is not None else None
+        if live is not None:
             # a cached handle must not silently override the caller's
             # requested durability: error on a policy mismatch
-            live = self._docs[h]
             want = p.get("fsync")  # omitted = don't-care, like textEncoding
             if want is not None and want != live.journal.fsync_policy:
                 raise ValueError(
@@ -272,9 +301,15 @@ class RpcServer:
             path,
             fsync=p.get("fsync", "always"),
             text_encoding=p.get("textEncoding"),
+            device=bool(p.get("device", False)),
+            background_compact=self.serve_background_compact,
+            compact_cost_ratio=float(
+                os.environ.get("AUTOMERGE_TPU_COMPACT_COST_RATIO", "0") or 0
+            ),
         )
         h = self._reg(self._docs, dd)
-        self._durable_names[name] = h
+        with self._lock:
+            self._durable_names[name] = h
         return {"doc": h}
 
     def _durable_doc(self, p):
@@ -302,14 +337,19 @@ class RpcServer:
         """Flush and close every open durable document (their close()
         commits pending autocommit edits and releases the journal locks);
         serve() calls this on every exit path."""
-        self._durable_names.clear()
-        for h, doc in list(self._docs.items()):
-            if hasattr(doc, "journal"):
-                try:
-                    doc.close()
-                except Exception:
-                    pass  # shutdown must not die half-way through the list
+        with self._lock:
+            self._durable_names.clear()
+            durable = [
+                (h, doc) for h, doc in self._docs.items()
+                if hasattr(doc, "journal")
+            ]
+            for h, _ in durable:
                 self._docs.pop(h, None)
+        for _, doc in durable:
+            try:
+                doc.close()
+            except Exception:
+                pass  # shutdown must not die half-way through the list
 
     def fork(self, p):
         doc = self._doc(p)
@@ -483,9 +523,18 @@ class RpcServer:
     def receiveSyncMessage(self, p):
         from .sync.protocol import Message
 
-        self._doc(p).receive_sync_message(
-            self._syncs[p["sync"]], Message.decode(_unb64(p["data"]))
-        )
+        doc = self._doc(p)
+        msg = Message.decode(_unb64(p["data"]))
+        doc.receive_sync_message(self._syncs[p["sync"]], msg)
+        # a durable doc opened with device=true carries a resident
+        # DeviceDoc: feed it incrementally so device reads stay current
+        # (the serving layer coalesces runs of these into apply_batches)
+        dev = getattr(doc, "device_doc", None)
+        if dev is not None and msg.changes:
+            try:
+                dev.apply_changes(msg.changes)
+            except Exception as e:  # noqa: BLE001 — isolate the sidecar
+                obs.count("sync.device_feed_error", error=str(e)[:200])
         return None
 
     # resilient sync sessions (retry/backoff/reset over lossy transports;
@@ -503,23 +552,31 @@ class RpcServer:
         )
 
     def syncSessionNew(self, p):
+        doc = self._doc(p)
         sess = SyncSession(
-            self._doc(p),
+            doc,
             config=self._session_config(p),
             epoch=int(p.get("epoch", 1)),
+            device_doc=getattr(doc, "device_doc", None),
         )
-        return {"session": self._reg(self._sessions, sess)}
+        h = self._reg(self._sessions, sess)
+        self._session_docs[h] = p["doc"]
+        return {"session": h}
 
     def syncSessionRestore(self, p):
         """Rebuild a session from persisted bytes after a restart; pass an
         epoch different from the pre-restart one."""
+        doc = self._doc(p)
         sess = SyncSession.restore(
-            self._doc(p),
+            doc,
             _unb64(p["data"]),
             epoch=int(p["epoch"]),
             config=self._session_config(p),
         )
-        return {"session": self._reg(self._sessions, sess)}
+        sess.device_doc = getattr(doc, "device_doc", None)
+        h = self._reg(self._sessions, sess)
+        self._session_docs[h] = p["doc"]
+        return {"session": h}
 
     def _session(self, p) -> SyncSession:
         sess = self._sessions.get(p.get("session"))
@@ -545,7 +602,9 @@ class RpcServer:
         return _b64(self._session(p).encode())
 
     def syncSessionFree(self, p):
-        self._sessions.pop(p.get("session"), None)
+        with self._lock:
+            self._sessions.pop(p.get("session"), None)
+            self._session_docs.pop(p.get("session"), None)
         return None
 
     # -- observability ------------------------------------------------------
@@ -634,13 +693,14 @@ class RpcServer:
                 "error": {"type": "EncodeError", "message": str(e)},
             })
 
-    def _handle_line(self, line: str) -> tuple[Optional[dict], bool]:
-        """One request line -> (response dict or None, stop flag).
-        Total error isolation: any malformed frame becomes an ``error``
-        response; nothing a client sends can raise out of here."""
+    def _parse_line(self, line: str) -> tuple[Optional[dict], Optional[dict]]:
+        """One request line -> (request dict, early error response); at
+        most one is non-None (both None for a blank line). The byte-limit
+        and JSON-shape checks shared by the stdio loop and the socket
+        transport (serve/server.py)."""
         line = line.strip()
         if not line:
-            return None, False
+            return None, None
         # measure encoded BYTES, not characters: a non-ASCII payload can be
         # 4x its character count (the ascii fast path avoids re-encoding)
         nbytes = (
@@ -652,23 +712,34 @@ class RpcServer:
         if nbytes > self.max_request_bytes:
             obs.count("rpc.errors", labels={"method": "unknown",
                                             "type": "RequestTooLarge"})
-            return {"id": None, "error": {
+            return None, {"id": None, "error": {
                 "type": "RequestTooLarge",
                 "message": f"request of {nbytes} bytes exceeds limit "
-                           f"of {self.max_request_bytes}"}}, False
+                           f"of {self.max_request_bytes}"}}
         try:
             req = json.loads(line)
         except json.JSONDecodeError as e:
             obs.count("rpc.errors", labels={"method": "unknown",
                                             "type": "ParseError"})
-            return {"id": None,
-                    "error": {"type": "ParseError", "message": str(e)}}, False
+            return None, {"id": None,
+                          "error": {"type": "ParseError", "message": str(e)}}
         if not isinstance(req, dict):
             obs.count("rpc.errors", labels={"method": "unknown",
                                             "type": "ParseError"})
-            return {"id": None, "error": {
+            return None, {"id": None, "error": {
                 "type": "ParseError",
-                "message": "request must be a JSON object"}}, False
+                "message": "request must be a JSON object"}}
+        return req, None
+
+    def _handle_line(self, line: str) -> tuple[Optional[dict], bool]:
+        """One request line -> (response dict or None, stop flag).
+        Total error isolation: any malformed frame becomes an ``error``
+        response; nothing a client sends can raise out of here."""
+        req, early = self._parse_line(line)
+        if early is not None:
+            return early, False
+        if req is None:
+            return None, False
         if req.get("method") == "shutdown":
             return {"id": req.get("id"), "result": None}, True
         try:
@@ -705,8 +776,15 @@ class RpcServer:
             while True:
                 try:
                     line = readline()
-                except Exception:
-                    return  # broken pipe / undecodable stream: clean shutdown
+                except Exception as e:
+                    # broken pipe / undecodable stream: clean shutdown —
+                    # but a VISIBLE one; a silently dropped client is
+                    # indistinguishable from a healthy idle one in metrics
+                    obs.count("rpc.errors", labels={"method": "transport",
+                                                    "type": "transport"})
+                    obs.event("rpc.transport_death", stage="read",
+                              error=str(e))
+                    return
                 if not line:  # EOF (including mid-request cut-offs)
                     return
                 resp, stop = self._handle_line(line)
@@ -716,8 +794,14 @@ class RpcServer:
                     try:
                         stdout.write(payload)
                         stdout.flush()
-                    except Exception:
-                        return  # client went away mid-response: shutdown
+                    except Exception as e:
+                        # client went away mid-response: shutdown, counted
+                        obs.count("rpc.errors",
+                                  labels={"method": "transport",
+                                          "type": "transport"})
+                        obs.event("rpc.transport_death", stage="write",
+                                  error=str(e))
+                        return
                 if stop:
                     return
         finally:
@@ -732,18 +816,60 @@ def main(argv=None) -> int:
 
     ap = argparse.ArgumentParser(
         prog="automerge_tpu.rpc",
-        description="line-delimited JSON-RPC frontend over stdio",
+        description="line-delimited JSON-RPC frontend over stdio or sockets",
     )
     ap.add_argument(
         "--durable", metavar="DIR", default=None,
         help="persist named documents (openDurable) as crash-safe "
              "journal+snapshot directories under DIR",
     )
+    ap.add_argument(
+        "--socket", metavar="HOST:PORT", default=None,
+        help="serve concurrently over TCP instead of stdio (port 0 picks "
+             "a free port; the bound address prints to stderr)",
+    )
+    ap.add_argument(
+        "--unix", metavar="PATH", default=None,
+        help="serve concurrently over a unix-domain socket at PATH",
+    )
+    ap.add_argument(
+        "--workers", type=int, default=None,
+        help="worker pool size for socket mode "
+             "(default AUTOMERGE_TPU_SERVE_WORKERS or 8)",
+    )
     args = ap.parse_args(argv)
     if args.durable:
-        import os
-
         os.makedirs(args.durable, exist_ok=True)
+    if args.socket or args.unix:
+        import signal
+
+        from .serve import SocketRpcServer
+
+        # a DEDICATED server process trades single-thread switch latency
+        # for cross-thread fairness: the default 5ms GIL switch interval
+        # lets one busy conn thread starve the worker pool for whole
+        # request lifetimes (observed: >2x tail-latency inflation)
+        sys.setswitchinterval(float(
+            os.environ.get("AUTOMERGE_TPU_SERVE_SWITCH_INTERVAL", "0.001")
+        ))
+
+        if args.socket:
+            host, _, port = args.socket.rpartition(":")
+            srv = SocketRpcServer(
+                host=host or "127.0.0.1", port=int(port),
+                workers=args.workers, durable_dir=args.durable,
+            )
+        else:
+            srv = SocketRpcServer(
+                unix_path=args.unix, workers=args.workers,
+                durable_dir=args.durable,
+            )
+        srv.start()
+        print(f"serving on {srv.address}", file=sys.stderr, flush=True)
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            signal.signal(sig, lambda *_: srv._shutdown.set())
+        srv.serve_forever()
+        return 0
     RpcServer(durable_dir=args.durable).serve()
     return 0
 

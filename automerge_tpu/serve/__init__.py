@@ -1,0 +1,11 @@
+"""Concurrent serving layer: socket transport + per-document shards.
+
+``SocketRpcServer`` (serve/server.py) serves the stdio JSON-RPC protocol
+over TCP or unix-domain sockets through a per-document single-writer
+shard pool (serve/shards.py), with group-commit durability and
+sync-receive coalescing. ``python -m automerge_tpu.rpc --socket`` /
+``--unix`` is the command-line entry.
+"""
+
+from .server import SocketRpcServer  # noqa: F401
+from .shards import QueueFull, ShardPool  # noqa: F401

@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import contextlib
 import posixpath
+import threading
 from typing import Dict, List, Optional
 
 from .. import obs
@@ -77,7 +78,9 @@ class DurableDocument:
     )
 
     def __init__(self, host, core, path, journal, *, fs,
-                 compact_max_records: int, compact_max_bytes: int):
+                 compact_max_records: int, compact_max_bytes: int,
+                 background_compact: bool = False,
+                 compact_cost_ratio: float = 0.0):
         self._host = host  # the wrapped Document or AutoDoc
         self._core = core  # the underlying core Document
         self.path = path
@@ -85,6 +88,23 @@ class DurableDocument:
         self._journal = journal
         self.compact_max_records = compact_max_records
         self.compact_max_bytes = compact_max_bytes
+        # the per-document mutex the serving layer executes requests
+        # under; the background compactor takes the same lock, so a
+        # snapshot never races a mutating request
+        self.lock = threading.RLock()
+        # cost-based compaction gate: while the journal is smaller than
+        # ``compact_cost_ratio`` x the last snapshot, skip compaction even
+        # past the record threshold — re-snapshotting a large document
+        # for a dribble of fresh records costs more than it saves
+        # (replay stays bounded by ratio x snapshot size). 0 disables.
+        self.compact_cost_ratio = compact_cost_ratio
+        self._last_snapshot_bytes = 0
+        # background mode (serving layer): threshold crossings schedule
+        # compaction on a daemon thread instead of stalling the ack path
+        self._background = background_compact
+        self._compact_wake = threading.Event()
+        self._compact_stop = False
+        self._compact_thread: Optional[threading.Thread] = None
         self._meta: Dict[str, bytes] = {}
         self._compacting = False
         self._closed = False
@@ -114,6 +134,8 @@ class DurableDocument:
         fsync_interval: int = 16,
         compact_max_records: int = 1024,
         compact_max_bytes: int = 4 << 20,
+        background_compact: bool = False,
+        compact_cost_ratio: float = 0.0,
         device: bool = False,
         fs=None,
     ) -> "DurableDocument":
@@ -152,6 +174,8 @@ class DurableDocument:
                     host, core, path, journal, records, fs=fs, device=device,
                     compact_max_records=compact_max_records,
                     compact_max_bytes=compact_max_bytes,
+                    background_compact=background_compact,
+                    compact_cost_ratio=compact_cost_ratio,
                 )
             except Exception:
                 journal.close()  # release the flock; don't wedge the dir
@@ -159,18 +183,23 @@ class DurableDocument:
 
     @classmethod
     def _recover(cls, host, core, path, journal, records, *, fs, device,
-                 compact_max_records, compact_max_bytes) -> "DurableDocument":
+                 compact_max_records, compact_max_bytes,
+                 background_compact=False,
+                 compact_cost_ratio=0.0) -> "DurableDocument":
         """Snapshot load + journal replay, under the already-held lock."""
         snap_path = posixpath.join(path, SNAPSHOT_NAME)
+        snap_bytes = 0
         if fs.exists(snap_path):
-            core.load_incremental(
-                fs.read_bytes(snap_path), on_partial="salvage"
-            )
+            snap = fs.read_bytes(snap_path)
+            snap_bytes = len(snap)
+            core.load_incremental(snap, on_partial="salvage")
         dev = None
-        if device and core.history:
+        if device:
             from ..ops.device_doc import DeviceDoc
             from ..ops.oplog import OpLog
 
+            # an empty history still gets a resident DeviceDoc: a fresh
+            # device-mode doc starts tracking from its first sync feed
             with obs.span("device.recover", phase="snapshot"):
                 dev = DeviceDoc.resolve(
                     OpLog.from_changes([a.stored for a in core.history])
@@ -207,9 +236,12 @@ class DurableDocument:
             host, core, path, journal, fs=fs,
             compact_max_records=compact_max_records,
             compact_max_bytes=compact_max_bytes,
+            background_compact=background_compact,
+            compact_cost_ratio=compact_cost_ratio,
         )
         dd._meta = meta
         dd.device_doc = dev
+        dd._last_snapshot_bytes = snap_bytes
         core.change_listeners.append(dd._on_change)
         return dd
 
@@ -219,8 +251,11 @@ class DurableDocument:
         # only reached for names this wrapper does not define itself
         attr = getattr(object.__getattribute__(self, "_host"), name)
         if name in DurableDocument._ACK_METHODS and callable(attr):
+            # the doc lock excludes the background compactor's snapshot
+            # from racing a commit/merge/sync apply; uncontended RLock
+            # cost on the single-threaded path is negligible
             def _acked(*a, _attr=attr, **kw):
-                with self.ack_scope():
+                with self.lock, self.ack_scope():
                     return _attr(*a, **kw)
 
             # bound host methods are stable for this instance's lifetime:
@@ -244,8 +279,11 @@ class DurableDocument:
             self._ack_depth -= 1
             # a double fault in append() can poison the journal closed
             # while the original I/O error is still unwinding — syncing
-            # then would only mask it with 'journal is closed'
-            if not self._journal.closed:
+            # then would only mask it with 'journal is closed'.
+            # Nested scopes defer to the OUTERMOST exit: the serving
+            # layer wraps a whole drained batch of wrapped ack calls in
+            # one scope, and that group pays one fsync (group commit)
+            if self._ack_depth == 0 and not self._journal.closed:
                 self._journal.policy_sync()
                 self.maybe_compact()
 
@@ -318,6 +356,13 @@ class DurableDocument:
     def close(self) -> None:
         if self._closed:
             return
+        # retire the background compactor first: a compaction racing the
+        # final commit/close would truncate a journal close() is flushing
+        if self._compact_thread is not None:
+            self._compact_stop = True
+            self._compact_wake.set()
+            self._compact_thread.join(timeout=30)
+            self._compact_thread = None
         # an AutoDoc host may hold a pending autocommit transaction; every
         # other exit surface (save / sync) auto-commits it, so close must
         # too — silently dropping acked-looking edits would betray the
@@ -341,15 +386,60 @@ class DurableDocument:
     # -- compaction ----------------------------------------------------------
 
     def maybe_compact(self) -> bool:
-        """Compact iff the journal crossed a threshold. Called after every
-        ack-point method; cheap when below threshold."""
+        """Compact iff the journal crossed a threshold (and, when a cost
+        ratio is set, the journal is worth the snapshot's cost). Called
+        after every ack-point method; cheap when below threshold. In
+        background mode the actual compaction runs on a daemon thread
+        under this document's lock, so it never stalls the ack path."""
         j = self._journal
         if (
             j.record_count <= self.compact_max_records
             and j.size_bytes <= self.compact_max_bytes
         ):
             return False
+        if (
+            self.compact_cost_ratio > 0.0
+            and j.size_bytes < self.compact_cost_ratio * self._last_snapshot_bytes
+        ):
+            obs.count("compact.deferred_by_cost")
+            return False
+        if self._background:
+            self._schedule_compact()
+            return False
         return self.compact()
+
+    def _schedule_compact(self) -> None:
+        if self._compact_thread is None:
+            self._compact_thread = threading.Thread(
+                target=self._compact_loop,
+                name=f"compact:{self.path}",
+                daemon=True,
+            )
+            self._compact_thread.start()
+        self._compact_wake.set()
+
+    def _compact_loop(self) -> None:
+        while True:
+            self._compact_wake.wait()
+            self._compact_wake.clear()
+            if self._compact_stop:
+                return
+            try:
+                # timed acquire, re-checking the stop flag: close() may be
+                # invoked by a thread that already HOLDS the doc lock (the
+                # serving worker executing a `free`), and its join() would
+                # otherwise wait out the full timeout against us blocking
+                # on that very lock
+                while not self.lock.acquire(timeout=0.05):
+                    if self._compact_stop:
+                        return
+                try:
+                    if not self._closed:
+                        self.compact()
+                finally:
+                    self.lock.release()
+            except Exception as e:  # noqa: BLE001 — background must not die
+                obs.count("compact.background_error", error=str(e)[:200])
 
     def compact(self) -> bool:
         """Snapshot-then-truncate: write the full save to a temp file,
@@ -357,42 +447,51 @@ class DurableDocument:
         directory entry, then truncate the journal (metadata records are
         re-appended so they survive). Every step durable before the next
         — the orderings the crash suite proves are exactly these."""
-        if self._compacting or self._closed or self._journal.closed:
-            # a poisoned-closed journal cannot be truncated: only a reopen
-            # recovers (the snapshot-repair path needs a live journal)
-            return False
-        live = self._core._live_transaction()
-        if live is not None and live.pending_ops():
-            return False  # mid-manual-transaction: defer to the next ack
-        self._compacting = True
-        try:
-            with obs.span("compact.total"):
-                data = self._host.save()
-                snap = posixpath.join(self.path, SNAPSHOT_NAME)
-                tmp = snap + ".tmp"
-                with obs.span("compact.snapshot", bytes=len(data)):
-                    f = self._fs.open(tmp, "wb")
-                    try:
-                        f.write(data)
-                        self._fs.fsync(f)
-                    finally:
-                        f.close()
-                    self._fs.replace(tmp, snap)
-                    self._fs.sync_dir(self.path)
-                with obs.span("compact.truncate"):
-                    self._journal.truncate()
-                    for name, blob in self._meta.items():
-                        self._journal.append(
-                            REC_META, encode_meta(name, blob), auto_sync=False
-                        )
-                    self._journal.sync()
-            obs.count("compact.runs")
-            # the snapshot carries the FULL in-memory history, so disk is
-            # caught up even if a journal append had failed earlier
-            self._broken = False
-            return True
-        finally:
-            self._compacting = False
+        with self.lock:
+            if self._compacting or self._closed or self._journal.closed:
+                # a poisoned-closed journal cannot be truncated: only a
+                # reopen recovers (the snapshot-repair path needs a live
+                # journal)
+                return False
+            live = self._core._live_transaction()
+            if live is not None and live.pending_ops():
+                return False  # mid-manual-transaction: defer to the next ack
+            self._compacting = True
+            try:
+                with obs.span("compact.total"):
+                    # snapshot the CORE: the journal holds exactly the
+                    # committed history, so that is what the snapshot
+                    # must cover — and a background compaction must not
+                    # side-effect-commit a half-built autocommit tx out
+                    # from under a mutating thread (host.save() would)
+                    data = self._core.save()
+                    snap = posixpath.join(self.path, SNAPSHOT_NAME)
+                    tmp = snap + ".tmp"
+                    with obs.span("compact.snapshot", bytes=len(data)):
+                        f = self._fs.open(tmp, "wb")
+                        try:
+                            f.write(data)
+                            self._fs.fsync(f)
+                        finally:
+                            f.close()
+                        self._fs.replace(tmp, snap)
+                        self._fs.sync_dir(self.path)
+                    with obs.span("compact.truncate"):
+                        self._journal.truncate()
+                        for name, blob in self._meta.items():
+                            self._journal.append(
+                                REC_META, encode_meta(name, blob),
+                                auto_sync=False,
+                            )
+                        self._journal.sync()
+                obs.count("compact.runs")
+                self._last_snapshot_bytes = len(data)
+                # the snapshot carries the FULL in-memory history, so disk
+                # is caught up even if a journal append had failed earlier
+                self._broken = False
+                return True
+            finally:
+                self._compacting = False
 
     # -- sync-session persistence (shared_heads survive restarts) ------------
 

@@ -33,6 +33,16 @@ Durability is governed by the fsync policy:
 * ``"never"``   — no automatic fsync (crash loses the OS write-back
   window; the journal is still torn-tail-consistent)
 
+The journal is thread-safe: appends serialize under an internal lock and
+``sync()`` is a leader-elected fsync **combiner** (group commit).
+Concurrent callers that arrive while an fsync is in flight wait for the
+NEXT one; exactly one leader issues it and every record appended before
+the leader sampled the sequence counter is covered — so N threads
+committing concurrently pay far fewer than N fsyncs. Each physical fsync
+records how many appends it covered in the ``group_commit.batch_size``
+histogram, and a caller whose records were made durable by another
+thread's fsync counts ``journal.fsync_combined``.
+
 All file operations go through an injectable filesystem object (``fs``)
 so the crash-injection harness (storage/crashsim.py) can simulate
 kill-at-every-write-boundary, torn writes, and rename reordering; the
@@ -42,6 +52,7 @@ default ``OS_FS`` is the real OS.
 from __future__ import annotations
 
 import os
+import threading
 from typing import List, NamedTuple, Optional, Tuple
 
 from .. import obs
@@ -252,7 +263,14 @@ class Journal:
         self._f = f
         self._size = size
         self._count = count
-        self._unsynced = 0
+        # group-commit state: appends bump _append_seq; _synced_seq is the
+        # durable prefix. Both only move under _cond's lock, which also
+        # serializes the file writes themselves (interleaved buffered
+        # writes from two threads would corrupt the record framing).
+        self._cond = threading.Condition()
+        self._append_seq = 0
+        self._synced_seq = 0
+        self._fsync_leader = False
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -354,8 +372,16 @@ class Journal:
             if self._unsynced:
                 self.sync()
         finally:
-            self._f.close()
-            self._f = None
+            with self._cond:
+                if self._f is not None:
+                    self._f.close()
+                    self._f = None
+                    self._cond.notify_all()
+
+    @property
+    def _unsynced(self) -> int:
+        """Appends not yet covered by an fsync."""
+        return self._append_seq - self._synced_seq
 
     # -- appends -------------------------------------------------------------
 
@@ -375,26 +401,29 @@ class Journal:
         to invoke ``policy_sync()`` before acking (the durable layer uses
         this to pay ONE fsync per public call instead of one per change
         in a merge/sync batch)."""
-        if self._f is None:
-            raise JournalError("journal is closed")
         rec = encode_record(rec_type, payload)
         with obs.span("journal.append", bytes=len(rec)):
-            try:
-                self._f.write(rec)
-            except Exception:
-                # a partial write (ENOSPC/EIO mid-record) would leave torn
-                # bytes MID-file: later successful appends would land after
-                # the tear and be dropped at recovery. Cut back to the last
-                # known-good size; if even that fails, poison the journal.
+            with self._cond:
+                if self._f is None:
+                    raise JournalError("journal is closed")
                 try:
-                    self._f.truncate(self._size)
+                    self._f.write(rec)
                 except Exception:
-                    self._f.close()
-                    self._f = None  # closed journal: every append raises
-                raise
-        self._size += len(rec)
-        self._count += 1
-        self._unsynced += 1
+                    # a partial write (ENOSPC/EIO mid-record) would leave
+                    # torn bytes MID-file: later successful appends would
+                    # land after the tear and be dropped at recovery. Cut
+                    # back to the last known-good size; if even that
+                    # fails, poison the journal.
+                    try:
+                        self._f.truncate(self._size)
+                    except Exception:
+                        self._f.close()
+                        self._f = None  # closed journal: appends raise
+                        self._cond.notify_all()  # wake fsync waiters
+                    raise
+                self._size += len(rec)
+                self._count += 1
+                self._append_seq += 1
         if auto_sync:
             self.policy_sync()
 
@@ -418,23 +447,65 @@ class Journal:
         self.append(REC_META, encode_meta(name, blob))
 
     def sync(self) -> None:
-        """Force everything appended so far onto stable storage."""
-        if self._f is None:
-            raise JournalError("journal is closed")
-        if self._unsynced == 0:
-            return
-        with obs.span("journal.fsync", labels={"policy": self.fsync_policy}):
-            self.fs.fsync(self._f)
-        self._unsynced = 0
+        """Force everything appended so far onto stable storage.
+
+        This is the group-commit combiner: the caller's records are
+        durable on return, but not necessarily via its own fsync. If an
+        fsync is already in flight the caller waits for it; when that
+        fsync (issued before our appends) does not cover us, exactly one
+        waiter becomes the next leader and its single fsync covers every
+        append made in the meantime — N concurrent committers collapse
+        into ~2 physical fsyncs instead of N."""
+        with self._cond:
+            if self._f is None:
+                raise JournalError("journal is closed")
+            target = self._append_seq
+            if self._synced_seq >= target:
+                return
+            while self._fsync_leader:
+                self._cond.wait()
+                if self._synced_seq >= target:
+                    # another thread's fsync covered our records
+                    obs.count("journal.fsync_combined")
+                    return
+                if self._f is None:
+                    raise JournalError("journal is closed")
+            self._fsync_leader = True
+            covering = self._append_seq
+            f = self._f
+        try:
+            with obs.span("journal.fsync",
+                          labels={"policy": self.fsync_policy}):
+                self.fs.fsync(f)
+        except Exception:
+            with self._cond:
+                self._fsync_leader = False
+                self._cond.notify_all()
+            raise
+        with self._cond:
+            batch = covering - self._synced_seq
+            self._synced_seq = covering
+            self._fsync_leader = False
+            self._cond.notify_all()
+        obs.observe("group_commit.batch_size", batch)
 
     def truncate(self) -> None:
         """Reset to an empty journal (post-compaction): the truncation is
         fsynced before return so stale records cannot resurrect."""
-        if self._f is None:
-            raise JournalError("journal is closed")
-        self._f.truncate(len(JOURNAL_MAGIC))
-        self._f.seek(len(JOURNAL_MAGIC))
-        self._unsynced = 1  # force the fsync below
-        self.sync()
-        self._size = len(JOURNAL_MAGIC)
-        self._count = 0
+        with self._cond:
+            if self._f is None:
+                raise JournalError("journal is closed")
+            # wait out any in-flight fsync: its covering seq refers to
+            # the pre-truncation file
+            while self._fsync_leader:
+                self._cond.wait()
+                if self._f is None:
+                    raise JournalError("journal is closed")
+            self._f.truncate(len(JOURNAL_MAGIC))
+            self._f.seek(len(JOURNAL_MAGIC))
+            with obs.span("journal.fsync",
+                          labels={"policy": self.fsync_policy}):
+                self.fs.fsync(self._f)
+            self._synced_seq = self._append_seq
+            self._size = len(JOURNAL_MAGIC)
+            self._count = 0

@@ -193,6 +193,10 @@ class SyncSession:
         # Persistence failure must never break the live session.
         self.persist = persist
         self._persisted_shared: Optional[tuple] = None
+        # non-None while receive_many() is draining a run of frames: the
+        # per-message device feed is deferred into this list and flushed
+        # as ONE DeviceDoc.apply_batches call at the end of the run
+        self._device_batches: Optional[list] = None
 
     # -- public surface -----------------------------------------------------
 
@@ -246,6 +250,35 @@ class SyncSession:
         Never raises on untrusted input."""
         with obs.span("sync.receive", bytes=len(data)):
             return self._receive(data, now)
+
+    def receive_many(self, frames, now: float = 0.0) -> list:
+        """Drain a run of pending wire frames in arrival order, coalescing
+        the resident-device feed: instead of one ``DeviceDoc.apply_changes``
+        per message, every message's changes collect into a single
+        ``apply_batches`` call at the end — on accelerator backends that
+        pipelines the kernel launches (h2d staging of batch k+1 overlaps
+        batch k's kernel), amortizing per-launch cost across the run.
+
+        Host-document semantics are identical to calling ``receive`` per
+        frame; returns the per-frame accepted flags."""
+        accepted = []
+        if self.device_doc is None or len(frames) <= 1:
+            for data in frames:
+                accepted.append(self.receive(data, now))
+            return accepted
+        self._device_batches = batches = []
+        try:
+            for data in frames:
+                accepted.append(self.receive(data, now))
+        finally:
+            self._device_batches = None
+        if batches:
+            obs.count("sync.coalesced_batches", n=len(batches))
+            try:
+                self.device_doc.apply_batches(batches)
+            except Exception as e:  # noqa: BLE001 — isolate the sidecar
+                obs.count("sync.device_feed_error", error=str(e)[:200])
+        return accepted
 
     def _receive(self, data: bytes, now: float) -> bool:
         try:
@@ -387,12 +420,16 @@ class SyncSession:
         if self._autodoc is not None:
             self._autodoc._notify_patches()
         if self.device_doc is not None and msg.changes:
-            # feed the resident device document incrementally; device-side
-            # trouble must never break the host sync session
-            try:
-                self.device_doc.apply_changes(msg.changes)
-            except Exception as e:  # noqa: BLE001 — isolate the sidecar
-                obs.count("sync.device_feed_error", error=str(e)[:200])
+            if self._device_batches is not None:
+                # inside receive_many: defer into one apply_batches call
+                self._device_batches.append(list(msg.changes))
+            else:
+                # feed the resident device document incrementally; device-
+                # side trouble must never break the host sync session
+                try:
+                    self.device_doc.apply_changes(msg.changes)
+                except Exception as e:  # noqa: BLE001 — isolate the sidecar
+                    obs.count("sync.device_feed_error", error=str(e)[:200])
         self.stats["received"] += 1
         self._awaiting = False
         self._retries = 0
