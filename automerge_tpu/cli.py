@@ -386,6 +386,24 @@ def cmd_serve(args) -> int:
     return rpc_main(argv)
 
 
+def cmd_cluster_router(args) -> int:
+    """Run the cluster router tier (cluster/router.py): consistent-hash
+    document placement over backend shard groups, heartbeat-driven
+    leader failover with promotion from the longest durable acked
+    prefix, and live shard migration. Delegates to the router's own
+    main so the module entry point stays behaviourally identical."""
+    from .cluster.router import main as router_main
+
+    argv = ["--listen", args.listen]
+    for g in args.group:
+        argv += ["--group", g]
+    if args.heartbeat is not None:
+        argv += ["--heartbeat", str(args.heartbeat)]
+    if args.miss_limit is not None:
+        argv += ["--miss-limit", str(args.miss_limit)]
+    return router_main(argv)
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="automerge_tpu", description=__doc__)
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -453,6 +471,23 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--workers", type=int, default=None,
                     help="worker pool size (default "
                          "AUTOMERGE_TPU_SERVE_WORKERS or 8)")
+
+    sp = sub.add_parser(
+        "cluster-router",
+        help="run the cluster router: consistent-hash placement, "
+             "leader failover, live shard migration",
+    )
+    sp.set_defaults(fn=cmd_cluster_router)
+    sp.add_argument("--listen", metavar="HOST:PORT", default="127.0.0.1:0",
+                    help="client-facing listen address")
+    sp.add_argument("--group", action="append", required=True,
+                    metavar="ADDR,ADDR,...",
+                    help="one shard group: comma-separated node "
+                         "addresses, leader first (repeatable)")
+    sp.add_argument("--heartbeat", type=float, default=None,
+                    help="leader liveness poll interval, seconds")
+    sp.add_argument("--miss-limit", type=int, default=None,
+                    help="consecutive missed heartbeats before failover")
 
     sp = add("change", cmd_change, help="apply an edit script to a document")
     sp.add_argument("input", nargs="?", help="input .automerge file (omit to start empty)")

@@ -1,0 +1,40 @@
+"""Cluster tier: multi-node sharded serving with journal-shipping
+replication and leader failover.
+
+* hashring.py     — consistent-hash placement of documents onto groups
+* replication.py  — leader-side hub shipping acked journal records,
+                    follower catch-up (snapshot + journal tail), the
+                    quorum ack gate
+* node.py         — a backend node: socket server + leader/follower
+                    role + the cluster RPC surface
+* router.py       — the client-facing proxy: placement, handle
+                    virtualization, heartbeat failover, live migration
+"""
+
+from .hashring import HashRing
+from .node import ClusterNode, ClusterRpcServer, REPL_SHARD_KEY
+from .replication import (
+    ReplicationError,
+    ReplicationHub,
+    ReplicationTimeout,
+    decode_batch,
+    decode_cursor,
+    encode_batch,
+    encode_cursor,
+)
+from .router import ClusterRouter
+
+__all__ = [
+    "ClusterNode",
+    "ClusterRouter",
+    "ClusterRpcServer",
+    "HashRing",
+    "REPL_SHARD_KEY",
+    "ReplicationError",
+    "ReplicationHub",
+    "ReplicationTimeout",
+    "decode_batch",
+    "decode_cursor",
+    "encode_batch",
+    "encode_cursor",
+]

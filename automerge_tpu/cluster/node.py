@@ -1,0 +1,405 @@
+"""A cluster backend node: the concurrent socket server plus a role.
+
+``ClusterNode`` wraps ``SocketRpcServer`` with a replication role:
+
+* **leader** — serves the full client method surface, runs a
+  ``ReplicationHub`` that ships every acked journal append to its
+  followers, and (with ``ack_replicas``) withholds client acks until
+  enough followers hold the write durably;
+* **follower** — rejects client mutations with a ``NotLeader`` error
+  (carrying the leader address as a hint), applies the replication
+  stream serially through one shard key (so its state is always a
+  prefix of the leader's log), and can be promoted in place.
+
+The RPC surface grows cluster methods (``clusterStatus``,
+``clusterPromote``, ``clusterReplicateTo``, ``replApply``,
+``replSnapshot``, ``replPing``, ``migrateOut`` / ``migrateTail`` /
+``migrateIn`` / ``migrateRelease``) — same line framing, same error
+envelope, dispatched through the same allowlist discipline as every
+other method.
+
+Promotion (``clusterPromote``): flip role, mint a fresh
+``ReplicationHub`` (new stream id, so surviving followers notice the
+incarnation change and snapshot-resync), warm-open every durable
+directory, and count ``cluster.promotions``. Client sync sessions resume
+through ``syncSessionAttach`` — the replicated ``sync/<peer>`` journal
+meta restores each session with a bumped epoch, so the PR 1 epoch/reset
+handshake renegotiates in one round instead of a full resync.
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+import threading
+import time
+from typing import Optional, Sequence
+
+from .. import obs
+from ..rpc import RpcServer
+from ..serve.server import SocketRpcServer
+from .replication import (
+    ReplicationHub,
+    decode_batch,
+    decode_cursor,
+    encode_batch,
+)
+
+# the whole replication stream serializes through ONE shard key: each
+# follower's durable state stays a strict prefix of the leader's log,
+# which keeps follower states totally ordered for promotion
+REPL_SHARD_KEY = "__replication__"
+
+_REPL_METHODS = frozenset({"replApply", "replSnapshot", "migrateIn"})
+
+# what a follower will answer; everything else is NotLeader
+_FOLLOWER_OK = frozenset({
+    "clusterStatus", "clusterPromote", "clusterReplicateTo",
+    "replApply", "replSnapshot", "replPing", "replHarvest",
+    "metrics", "configure",
+})
+
+
+class NotLeader(Exception):
+    pass
+
+
+class ClusterRpcServer(RpcServer):
+    """RpcServer + the cluster method surface and follower gating."""
+
+    METHODS = RpcServer.METHODS | frozenset({
+        "clusterStatus", "clusterPromote", "clusterReplicateTo",
+        "replApply", "replSnapshot", "replPing", "replHarvest",
+        "migrateOut", "migrateTail", "migrateIn", "migrateRelease",
+    })
+
+    def __init__(self, *a, node_id: str = "node", **kw):
+        super().__init__(*a, **kw)
+        self.node_id = node_id
+        self.cluster_role = "leader"
+        self.leader_hint: Optional[str] = None  # follower's known leader
+        self.hub: Optional[ReplicationHub] = None
+        self.last_leader_contact = 0.0
+        self._role_lock = threading.RLock()
+
+    # -- gating --------------------------------------------------------------
+
+    def handle(self, req: dict) -> dict:
+        method = req.get("method", "")
+        if (
+            self.cluster_role == "follower"
+            and isinstance(method, str)
+            and method in self.METHODS
+            and method not in _FOLLOWER_OK
+        ):
+            obs.count("rpc.errors",
+                      labels={"method": method, "type": "NotLeader"})
+            return {"id": req.get("id"), "error": {
+                "type": "NotLeader",
+                "message": f"node {self.node_id} is a follower"
+                + (f" of {self.leader_hint}" if self.leader_hint else ""),
+                "leader": self.leader_hint,
+            }}
+        return super().handle(req)
+
+    # -- replicated document access ------------------------------------------
+
+    def _repl_doc(self, name):
+        """Open-or-get the named durable doc for the replication /
+        migration paths (bypasses the follower gate by construction:
+        these handlers are already past it)."""
+        h = self.openDurable({"name": name})["doc"]
+        return self._docs[h]
+
+    # -- cluster status ------------------------------------------------------
+
+    def clusterStatus(self, p):
+        docs = {}
+        with self._lock:
+            named = dict(self._durable_names)
+        for name, h in sorted(named.items()):
+            doc = self._docs.get(h)
+            if doc is None or not hasattr(doc, "journal"):
+                continue
+            acked, appended = doc.acked_prefix()
+            cur = doc.replication_cursor
+            info = {
+                "acked": acked,
+                "appended": appended,
+                "cursor": None,
+            }
+            if cur is not None:
+                stream, lsn = decode_cursor(cur)
+                info["cursor"] = {"stream": stream, "lsn": lsn}
+            if self.hub is not None:
+                info["lsn"] = self.hub.lsn(name)
+            docs[name] = info
+        out = {
+            "nodeId": self.node_id,
+            "role": self.cluster_role,
+            "docs": docs,
+        }
+        if self.hub is not None:
+            out["stream"] = self.hub.stream_id
+            out["followers"] = self.hub.followers()
+        if self.leader_hint:
+            out["leader"] = self.leader_hint
+        return out
+
+    # -- replication receive path (follower) ---------------------------------
+
+    def replApply(self, p):
+        """Apply one shipped record batch. Cursor arithmetic guards
+        contiguity: our persisted cursor must name the same stream at
+        exactly ``prev`` or the leader falls back to a snapshot."""
+        name = p["name"]
+        doc = self._repl_doc(name)
+        cur = doc.replication_cursor
+        have_stream, have_lsn = (None, 0) if cur is None else decode_cursor(cur)
+        if have_stream != p["stream"] or have_lsn != int(p["prev"]):
+            raise ReplCursorMismatch(
+                f"{name}: have {have_stream}@{have_lsn}, "
+                f"leader sent prev={p['prev']} on {p['stream']}"
+            )
+        records = decode_batch(base64.b64decode(p["data"]))
+        applied = doc.apply_replicated(
+            records, base64.b64decode(p["cursor"]))
+        obs.count("cluster.records_applied", n=len(records))
+        return {"lsn": int(p["lsn"]), "applied": applied}
+
+    def replSnapshot(self, p):
+        """Catch-up: full leader save + pinned cursor, applied through
+        the listener path (known changes deduplicate on the history
+        index, so converging snapshots never conflict)."""
+        name = p["name"]
+        doc = self._repl_doc(name)
+        doc.apply_replicated_snapshot(
+            base64.b64decode(p["snapshot"]), base64.b64decode(p["cursor"]))
+        obs.count("cluster.snapshots_applied")
+        return {"lsn": int(p["lsn"])}
+
+    def replPing(self, p):
+        self.last_leader_contact = time.monotonic()
+        return {"nodeId": self.node_id, "role": self.cluster_role}
+
+    def replHarvest(self, p):
+        """Hand out this node's full state for one document — the
+        post-promotion reconciliation path: the router unions every
+        reachable follower's state into the promoted leader (changes
+        deduplicate by hash, so a CRDT merge is always safe), which
+        keeps promotion lossless even when per-doc cursors diverge
+        across followers and the longest-sum choice alone would not."""
+        doc = self._repl_doc(p["name"])
+        with doc.lock:
+            data = doc._core.save()
+        return {"snapshot": base64.b64encode(data).decode("ascii")}
+
+    # -- role transitions ----------------------------------------------------
+
+    def _become_leader(self, ack_replicas: int) -> int:
+        """Flip to leader: fresh hub incarnation + warm-open. Returns
+        the number of durable directories opened."""
+        self.cluster_role = "leader"
+        self.leader_hint = None
+        self.hub = ReplicationHub(self.node_id, ack_replicas=ack_replicas)
+        self.on_durable_open = self._on_durable_open
+        n = self._warm_open()
+        # docs opened before the hub existed (or by a prior role) must
+        # attach too — attach() is idempotent per name
+        with self._lock:
+            named = list(self._durable_names.items())
+        for name, h in named:
+            doc = self._docs.get(h)
+            if doc is not None and hasattr(doc, "journal"):
+                self.hub.attach(name, doc)
+        return n
+
+    def clusterPromote(self, p):
+        """Follower -> leader: mint a fresh hub incarnation, warm-open
+        every durable directory, start serving client mutations. The
+        caller (the router's failover monitor) picked this node as the
+        longest durable acked prefix."""
+        with self._role_lock:
+            if self.cluster_role == "leader" and self.hub is not None:
+                return {"promoted": False, "role": "leader",
+                        "stream": self.hub.stream_id}
+            n = self._become_leader(
+                int(p.get("ackReplicas", self.cluster_ack_replicas)))
+        obs.count("cluster.promotions")
+        return {"promoted": True, "role": "leader",
+                "stream": self.hub.stream_id, "docs": n}
+
+    def clusterReplicateTo(self, p):
+        """Leader: add a follower link (the post-promotion rewire the
+        failover monitor drives, and the startup ``--replicate-to``)."""
+        with self._role_lock:
+            if self.hub is None:
+                raise NotLeader("cannot replicate from a follower")
+            self.hub.add_follower(p["addr"])
+        return {"followers": sorted(self.hub.followers())}
+
+    cluster_ack_replicas = 0  # default; ClusterNode sets from config
+
+    def _on_durable_open(self, name, dd):
+        if self.hub is not None:
+            self.hub.attach(name, dd)
+
+    def _warm_open(self) -> int:
+        """Open (and attach) every durable directory under the serving
+        dir — promotion and leader start must replicate docs that exist
+        on disk but have no live client handle yet."""
+        n = 0
+        if not self.durable_dir or not os.path.isdir(self.durable_dir):
+            return n
+        for entry in sorted(os.listdir(self.durable_dir)):
+            path = os.path.join(self.durable_dir, entry)
+            if not os.path.isdir(path):
+                continue
+            try:
+                self.openDurable({"name": entry})
+                n += 1
+            except Exception as e:  # noqa: BLE001 — one bad dir, not all
+                obs.count("cluster.warm_open_error", error=str(e)[:200])
+        return n
+
+    # -- live shard migration ------------------------------------------------
+
+    def migrateOut(self, p):
+        """Phase 1 of the handoff: a full snapshot pinned to an LSN,
+        taken while the document keeps serving. The journal meta rides
+        along (minus replication bookkeeping) so attached sync sessions
+        resume on the target instead of renegotiating from nothing."""
+        if self.hub is None:
+            raise NotLeader("migration source must be a leader")
+        name = p["name"]
+        doc = self._repl_doc(name)  # ensure open + attached
+        data, lsn = self.hub.snapshot(name)
+        from ..storage.durable import REPL_META_PREFIX
+
+        meta = {
+            k: base64.b64encode(v).decode("ascii")
+            for k, v in doc.meta.items()
+            if not k.startswith(REPL_META_PREFIX)
+        }
+        return {
+            "snapshot": base64.b64encode(data).decode("ascii"),
+            "lsn": lsn,
+            "stream": self.hub.stream_id,
+            "meta": meta,
+        }
+
+    def migrateTail(self, p):
+        """Phase 2 (routing paused): the journal tail since the
+        snapshot's LSN. Raises when the tail was trimmed — the router
+        then repeats migrateOut under the pause."""
+        if self.hub is None:
+            raise NotLeader("migration source must be a leader")
+        records, last = self.hub.tail_after(p["name"], int(p["since"]))
+        return {
+            "data": base64.b64encode(encode_batch(records)).decode("ascii"),
+            "lsn": last,
+        }
+
+    def migrateIn(self, p):
+        """Target side: snapshot + tail through the replicated-apply
+        path (plus carried journal meta), then own the document as a
+        normal leader doc (no cursor — it follows nobody). Also the
+        post-promotion union sink: a replHarvest snapshot fed here
+        merges any state the promoted leader was missing."""
+        name = p["name"]
+        doc = self._repl_doc(name)
+        doc.apply_replicated_snapshot(base64.b64decode(p["snapshot"]), None)
+        records = decode_batch(base64.b64decode(p.get("data") or ""))
+        if records:
+            doc.apply_replicated(records, None)
+        meta = p.get("meta") or {}
+        if meta:
+            with doc.lock, doc.ack_scope():
+                for k, blob in meta.items():
+                    doc.set_meta(k, base64.b64decode(blob))
+        obs.count("cluster.migrations_in")
+        return {"heads": [base64.b64encode(h).decode("ascii")
+                          for h in doc.get_heads()]}
+
+    def migrateRelease(self, p):
+        """Source side: drop the migrated document (close the journal,
+        release the flock) after the router flipped routing."""
+        name = p["name"]
+        with self._lock:
+            h = self._durable_names.get(name)
+        if h is None:
+            return {"released": False}
+        if self.hub is not None:
+            self.hub.detach(name)
+        self.free({"doc": h})
+        obs.count("cluster.migrations_out")
+        return {"released": True}
+
+
+class ReplCursorMismatch(Exception):
+    """Follower journal cursor does not extend the shipped batch."""
+
+
+class ClusterNode(SocketRpcServer):
+    """A backend node process: socket server + role + replication."""
+
+    def __init__(
+        self,
+        *,
+        node_id: str,
+        host: Optional[str] = None,
+        port: int = 0,
+        unix_path: Optional[str] = None,
+        durable_dir: str,
+        role: str = "leader",
+        leader_addr: Optional[str] = None,
+        replicate_to: Sequence[str] = (),
+        ack_replicas: Optional[int] = None,
+        workers: Optional[int] = None,
+    ):
+        if role not in ("leader", "follower"):
+            raise ValueError(f"unknown cluster role {role!r}")
+        rpc = ClusterRpcServer(durable_dir=durable_dir, node_id=node_id)
+        super().__init__(
+            rpc, host=host, port=port, unix_path=unix_path, workers=workers,
+            durable_dir=durable_dir,
+        )
+        if ack_replicas is None:
+            try:
+                ack_replicas = int(os.environ.get(
+                    "AUTOMERGE_TPU_CLUSTER_ACK_REPLICAS", "0"))
+            except ValueError:
+                ack_replicas = 0
+        rpc.cluster_ack_replicas = ack_replicas
+        rpc.cluster_role = role
+        rpc.leader_hint = leader_addr
+        if role == "leader":
+            # starting as leader is not a promotion — no counter
+            rpc._become_leader(ack_replicas)
+            for addr in replicate_to:
+                rpc.clusterReplicateTo({"addr": addr})
+        else:
+            rpc._warm_open()
+
+    # replication ingest serializes through one shard key (prefix-ordered
+    # follower state); migration source methods take the migrated doc's
+    # OWN shard key, so they execute after every write this node already
+    # read for it — the tail a migrateTail ships really is the tail
+    def _affinity(self, req: dict):
+        method = req.get("method")
+        if method in _REPL_METHODS:
+            return REPL_SHARD_KEY
+        if method in ("migrateOut", "migrateTail", "migrateRelease"):
+            name = (req.get("params") or {}).get("name")
+            if isinstance(name, str):
+                with self.rpc._lock:
+                    h = self.rpc._durable_names.get(name)
+                if h is not None:
+                    return h
+        return super()._affinity(req)
+
+    def _stop_inner(self) -> None:
+        hub = self.rpc.hub
+        if hub is not None:
+            hub.close()
+        super()._stop_inner()

@@ -1,0 +1,586 @@
+"""Journal-shipping replication: leader streams acked journal records.
+
+The PR 3 change journal is already a checksummed, truncation-safe,
+torn-tail-recoverable change log — this module treats it as what it is:
+a replication stream. The leader's ``ReplicationHub`` hooks every
+durable document's journal (``on_record`` / ``on_synced``) and ships the
+locally-durable record prefix to followers **verbatim** — the bytes on
+the wire are ``journal.encode_record`` output, parsed on the far side by
+the same CRC scan that recovers a journal file (``scan_record_seq``).
+There is no second serialization format.
+
+Topology and flow (leader dials follower, both speak the RPC line
+framing of serve/server.py):
+
+* every attached document gets a per-hub **LSN** sequence (one per
+  appended record) and a bounded in-memory retention buffer of already
+  synced records;
+* a ``_FollowerLink`` per follower ships, over one pooled connection,
+  ``replApply`` requests carrying contiguous record batches (prev/lsn
+  cursor arithmetic, so a gap is detected by the follower, answered with
+  ``ReplCursorMismatch``, and repaired by a snapshot);
+* a new or lagging follower (cursor from another leader incarnation, or
+  behind the retention buffer) catches up exactly the way compaction
+  recovers: a full **snapshot** (``core.save()``) pinned to an LSN, then
+  the journal tail from there;
+* the follower applies through the durable listener path
+  (``DurableDocument.apply_replicated``), so every replicated change is
+  journaled on the follower's own disk before the ack returns, and the
+  **replication cursor** rides the same fsync as journal meta;
+* ``replPing`` heartbeats flow on idle links so followers can observe
+  leader liveness, and the router's failover monitor uses
+  ``clusterStatus`` cursors to promote from the longest durable acked
+  prefix.
+
+Durability gate: with ``ack_replicas >= 1`` the hub installs a
+``replication_gate`` on each attached document — the outermost
+``ack_scope`` exit (the moment a batch would ack to clients) blocks
+until at least that many followers have *durably* applied the covering
+LSN. A client-visible ack therefore implies the write is on
+``1 + ack_replicas`` disks, which is what makes kill -9 of the leader
+lose zero acked writes.
+
+Observability: ``cluster.replication_lag{follower,doc}`` gauges,
+``cluster.records_shipped`` / ``cluster.snapshots_shipped`` counters,
+``cluster.follower_up{follower}`` gauges, ``cluster.ship_batch`` span.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import socket
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from .. import obs
+from ..storage.journal import encode_record, scan_record_seq
+from ..utils.leb128 import decode_uleb, encode_uleb
+
+
+class ReplicationError(Exception):
+    pass
+
+
+class ReplicationTimeout(ReplicationError):
+    """The ack gate could not confirm enough follower copies in time —
+    the covering batch must surface as errors, never as acks."""
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+# -- wire codecs (journal record encoding, verbatim) --------------------------
+
+
+def encode_batch(records: List[Tuple[int, bytes]]) -> bytes:
+    """Concatenated journal records — byte-identical to what the leader's
+    journal file holds for the same appends."""
+    out = bytearray()
+    for rec_type, payload in records:
+        out += encode_record(rec_type, payload)
+    return bytes(out)
+
+
+def decode_batch(data: bytes) -> List[Tuple[int, bytes]]:
+    """Inverse of ``encode_batch`` via the journal's own CRC scan."""
+    return [(r.rec_type, r.payload) for r in scan_record_seq(data)]
+
+
+def encode_cursor(stream: str, lsn: int) -> bytes:
+    """Follower cursor blob: ULEB(lsn) | stream id (utf-8). ``stream``
+    names one leader incarnation — a cursor from another stream forces
+    snapshot catch-up instead of silently splicing two histories."""
+    out = bytearray()
+    encode_uleb(lsn, out)
+    out += stream.encode("utf-8")
+    return bytes(out)
+
+
+def decode_cursor(blob: bytes) -> Tuple[str, int]:
+    lsn, pos = decode_uleb(blob, 0)
+    return bytes(blob[pos:]).decode("utf-8"), lsn
+
+
+# -- leader side --------------------------------------------------------------
+
+
+class _DocStream:
+    """Per-document replication state on the leader."""
+
+    __slots__ = (
+        "name", "dd", "lsn", "synced_lsn", "pending", "buffer",
+        "buffer_bytes", "base_lsn",
+    )
+
+    def __init__(self, name: str, dd):
+        self.name = name
+        self.dd = dd
+        self.lsn = 0  # appended records (this hub incarnation)
+        self.synced_lsn = 0  # locally durable prefix — what may ship
+        # appended but not yet covered by an fsync: (lsn, append_seq,
+        # rec_type, payload)
+        self.pending: deque = deque()
+        # locally durable, retained for follower tail-shipping:
+        # (lsn, rec_type, payload)
+        self.buffer: deque = deque()
+        self.buffer_bytes = 0
+        self.base_lsn = 0  # everything <= this has been trimmed
+
+
+class ReplicationHub:
+    """Leader-side replication state machine. One per leader node."""
+
+    def __init__(
+        self,
+        node_id: str,
+        *,
+        ack_replicas: int = 0,
+        heartbeat: Optional[float] = None,
+        retain_bytes: int = 16 << 20,
+        ack_timeout: Optional[float] = None,
+        batch_bytes: int = 4 << 20,
+    ):
+        self.node_id = node_id
+        # one leader INCARNATION: a restarted or newly promoted leader
+        # must not be mistaken for the stream a stale cursor names
+        self.stream_id = f"{node_id}/{uuid.uuid4().hex[:12]}"
+        self.ack_replicas = int(ack_replicas)
+        self.heartbeat = (
+            heartbeat if heartbeat is not None
+            else _env_float("AUTOMERGE_TPU_CLUSTER_HEARTBEAT", 1.0)
+        )
+        self.ack_timeout = (
+            ack_timeout if ack_timeout is not None
+            else _env_float("AUTOMERGE_TPU_CLUSTER_ACK_TIMEOUT", 30.0)
+        )
+        self.retain_bytes = retain_bytes
+        self.batch_bytes = batch_bytes
+        self._lock = threading.Lock()
+        self._acked = threading.Condition(self._lock)
+        self._streams: Dict[str, _DocStream] = {}
+        self._links: Dict[str, _FollowerLink] = {}
+        self._closed = False
+
+    # -- document attachment -------------------------------------------------
+
+    def attach(self, name: str, dd) -> None:
+        """Start replicating ``dd``'s journal under ``name``. Installs
+        the journal hooks and (with ``ack_replicas``) the ack gate."""
+        with self._lock:
+            if name in self._streams or self._closed:
+                return
+            st = _DocStream(name, dd)
+            self._streams[name] = st
+        j = dd.journal
+        j.on_record = lambda rt, pl, seq, _n=name: self._on_record(
+            _n, rt, pl, seq)
+        j.on_synced = lambda seq, _n=name: self._on_synced(_n, seq)
+        if self.ack_replicas > 0:
+            dd.replication_gate = lambda _n=name: self.wait_acked(_n)
+        with self._lock:
+            for link in self._links.values():
+                link.note_doc(name)
+
+    def detach(self, name: str) -> None:
+        with self._lock:
+            st = self._streams.pop(name, None)
+        if st is not None:
+            st.dd.journal.on_record = None
+            st.dd.journal.on_synced = None
+            st.dd.replication_gate = None
+
+    def doc_names(self) -> List[str]:
+        with self._lock:
+            return list(self._streams)
+
+    def lsn(self, name: str) -> int:
+        with self._lock:
+            st = self._streams.get(name)
+            return st.lsn if st is not None else 0
+
+    # -- journal hooks (leader write path) -----------------------------------
+
+    def _on_record(self, name: str, rec_type: int, payload: bytes,
+                   seq: int) -> None:
+        with self._lock:
+            st = self._streams.get(name)
+            if st is None:
+                return
+            st.lsn += 1
+            st.pending.append((st.lsn, seq, rec_type, payload))
+
+    def _drain_pending_locked(self, st: _DocStream) -> bool:
+        """Promote pending records covered by the journal's durable
+        prefix into the ship buffer (hub lock held). Reading
+        ``acked_seq`` directly makes the promotion self-synchronizing:
+        the group-commit combiner fires ``on_synced`` OUTSIDE the
+        journal condition, so a combined-fsync waiter can reach the ack
+        gate before the hook ran — draining against the journal's own
+        counter closes that window."""
+        covering = st.dd.journal.acked_seq
+        moved = False
+        while st.pending and st.pending[0][1] <= covering:
+            lsn, _seq, rec_type, payload = st.pending.popleft()
+            st.buffer.append((lsn, rec_type, payload))
+            st.buffer_bytes += len(payload) + 16
+            st.synced_lsn = lsn
+            moved = True
+        while st.buffer and st.buffer_bytes > self.retain_bytes:
+            lsn, _rt, pl = st.buffer.popleft()
+            st.buffer_bytes -= len(pl) + 16
+            st.base_lsn = lsn
+        return moved
+
+    def _on_synced(self, name: str, covering: int) -> None:
+        """Records up to journal append seq ``covering`` are durable on
+        the leader: promote them into the ship buffer and wake links."""
+        with self._lock:
+            st = self._streams.get(name)
+            if st is None:
+                return
+            if not self._drain_pending_locked(st):
+                return
+            links = list(self._links.values())
+        for link in links:
+            link.wake()
+
+    # -- the ack gate --------------------------------------------------------
+
+    def wait_acked(self, name: str) -> None:
+        """Block until >= ack_replicas followers hold this document's
+        current locally-durable LSN on their own disks. Raises
+        ``ReplicationTimeout`` after ``ack_timeout`` — an un-replicated
+        ack is no ack."""
+        deadline = time.monotonic() + self.ack_timeout
+        with self._acked:
+            st = self._streams.get(name)
+            if st is None:
+                return
+            # the caller's records are journal-durable by now, but the
+            # combiner's on_synced hook may not have run yet: drain
+            # against the journal's acked counter so the target covers
+            # THIS caller's writes, never a stale prefix
+            moved = self._drain_pending_locked(st)
+            target = st.synced_lsn
+            links = list(self._links.values()) if moved else []
+        for link in links:
+            link.wake()
+        with self._acked:
+            if target == 0:
+                return  # nothing durable to replicate yet
+            while True:
+                n = sum(
+                    1 for link in self._links.values()
+                    if link.durable_lsn.get(name, 0) >= target
+                )
+                if n >= self.ack_replicas:
+                    return
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._closed:
+                    raise ReplicationTimeout(
+                        f"only {n}/{self.ack_replicas} followers confirmed "
+                        f"{name}@{target} within {self.ack_timeout}s"
+                    )
+                self._acked.wait(timeout=min(remaining, 0.5))
+
+    def _note_follower_ack(self, name: str, lsn: int) -> None:
+        with self._acked:
+            self._acked.notify_all()
+        st = self._streams.get(name)
+        if st is not None:
+            obs.gauge_set(
+                "cluster.replication_lag", max(0, st.synced_lsn - lsn),
+                labels={"doc": name},
+            )
+
+    # -- snapshots (catch-up) ------------------------------------------------
+
+    def snapshot(self, name: str) -> Tuple[bytes, int]:
+        """A full save pinned to an LSN, taken under the document lock so
+        save bytes and LSN describe the same instant. Mirrors the
+        compaction dance: snapshot first, tail records after."""
+        with self._lock:
+            st = self._streams.get(name)
+        if st is None:
+            raise ReplicationError(f"no replicated document {name!r}")
+        # timed acquire: the ack gate can hold this lock on the stdio
+        # path while waiting for us — back off and let the caller requeue
+        if not st.dd.lock.acquire(timeout=self.ack_timeout):
+            raise ReplicationError(f"snapshot of {name!r}: doc lock busy")
+        try:
+            data = st.dd._core.save()
+            with self._lock:
+                lsn = st.lsn
+        finally:
+            st.dd.lock.release()
+        obs.count("cluster.snapshots_shipped")
+        return data, lsn
+
+    def tail_after(self, name: str, lsn: int) -> Tuple[List[Tuple[int, bytes]], int]:
+        """Retained records with LSN > ``lsn`` (bounded by batch_bytes),
+        or raise when the tail has been trimmed past that point."""
+        with self._lock:
+            st = self._streams.get(name)
+            if st is None:
+                raise ReplicationError(f"no replicated document {name!r}")
+            if lsn < st.base_lsn:
+                raise ReplicationError(
+                    f"{name!r}: records after {lsn} trimmed "
+                    f"(base is {st.base_lsn}); snapshot required"
+                )
+            out, total, last = [], 0, lsn
+            for rec_lsn, rec_type, payload in st.buffer:
+                if rec_lsn <= lsn:
+                    continue
+                if out and total + len(payload) > self.batch_bytes:
+                    break
+                out.append((rec_type, payload))
+                total += len(payload)
+                last = rec_lsn
+            return out, last
+
+    # -- follower management -------------------------------------------------
+
+    def add_follower(self, addr: str) -> None:
+        with self._lock:
+            if self._closed or addr in self._links:
+                return
+            link = _FollowerLink(self, addr)
+            self._links[addr] = link
+        link.start()
+
+    def remove_follower(self, addr: str) -> None:
+        with self._lock:
+            link = self._links.pop(addr, None)
+        if link is not None:
+            link.stop()
+        with self._acked:
+            self._acked.notify_all()
+
+    def followers(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {
+                addr: dict(link.durable_lsn)
+                for addr, link in self._links.items()
+            }
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            links = list(self._links.values())
+            self._links.clear()
+            streams = list(self._streams.values())
+            self._streams.clear()
+        for st in streams:
+            st.dd.journal.on_record = None
+            st.dd.journal.on_synced = None
+            st.dd.replication_gate = None
+        for link in links:
+            link.stop()
+        with self._acked:
+            self._acked.notify_all()
+
+
+class _FollowerLink:
+    """One follower: a dialing connection plus a ship worker thread.
+
+    The worker ships every attached document's durable tail in LSN order
+    over a single connection — the follower applies the stream serially
+    (one replication shard key), so each follower's state is always a
+    prefix of the leader's replication log and follower states are
+    mutually comparable (what promotion-by-longest-prefix relies on)."""
+
+    def __init__(self, hub: ReplicationHub, addr: str):
+        self.hub = hub
+        self.addr = addr
+        self.durable_lsn: Dict[str, int] = {}  # follower's durable cursor
+        self._sent_lsn: Dict[str, int] = {}
+        self._needs_snapshot: Dict[str, bool] = {}
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._sock: Optional[socket.socket] = None
+        self._rid = 0
+        self._thread = threading.Thread(
+            target=self._run, name=f"repl:{addr}", daemon=True
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def wake(self) -> None:
+        self._wake.set()
+
+    def note_doc(self, name: str) -> None:
+        self._wake.set()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        sock = self._sock
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self._thread.join(timeout=10)
+
+    # -- request plumbing (line framing, serial request/response) ------------
+
+    def _connect(self):
+        host, _, port = self.addr.rpartition(":")
+        sock = socket.create_connection((host, int(port)), timeout=10)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = sock
+        return sock.makefile("r")
+
+    def _request(self, f, method: str, params: dict) -> dict:
+        self._rid += 1
+        line = json.dumps(
+            {"id": self._rid, "method": method, "params": params}
+        ) + "\n"
+        self._sock.sendall(line.encode("utf-8"))
+        raw = f.readline()
+        if not raw:
+            raise ReplicationError("follower connection closed")
+        resp = json.loads(raw)
+        if "error" in resp:
+            err = resp["error"]
+            raise ReplicationError(
+                f"{err.get('type')}: {err.get('message')}"
+            )
+        return resp.get("result") or {}
+
+    # -- the ship loop -------------------------------------------------------
+
+    def _run(self) -> None:
+        backoff = 0.05
+        while not self._stop.is_set():
+            try:
+                f = self._connect()
+                obs.gauge_set("cluster.follower_up", 1,
+                              labels={"follower": self.addr})
+                self._handshake(f)
+                backoff = 0.05
+                self._ship_loop(f)
+            except Exception as e:  # noqa: BLE001 — links must self-heal
+                if self._stop.is_set():
+                    return
+                obs.count("cluster.link_error", error=str(e)[:200])
+                obs.gauge_set("cluster.follower_up", 0,
+                              labels={"follower": self.addr})
+                sock, self._sock = self._sock, None
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                # a dead follower must not freeze the gate accounting at
+                # its last acked values — it no longer counts
+                self.durable_lsn.clear()
+                self._sent_lsn.clear()
+                self._stop.wait(backoff)
+                backoff = min(backoff * 2, 2.0)
+
+    def _handshake(self, f) -> None:
+        """Learn the follower's persisted cursors; decide tail vs
+        snapshot per document."""
+        status = self._request(f, "clusterStatus", {})
+        cursors = {
+            name: info.get("cursor")
+            for name, info in (status.get("docs") or {}).items()
+        }
+        for name in self.hub.doc_names():
+            cur = cursors.get(name)
+            if (
+                cur
+                and cur.get("stream") == self.hub.stream_id
+            ):
+                self._sent_lsn[name] = int(cur["lsn"])
+                self.durable_lsn[name] = int(cur["lsn"])
+                self._needs_snapshot[name] = False
+            else:
+                self._needs_snapshot[name] = True
+        self.hub._note_follower_ack("", 0)
+
+    def _ship_loop(self, f) -> None:
+        last_sent = time.monotonic()
+        while not self._stop.is_set():
+            progressed = False
+            for name in self.hub.doc_names():
+                if self._needs_snapshot.get(name, True):
+                    self._ship_snapshot(f, name)
+                    progressed = True
+                while self._ship_tail(f, name):
+                    progressed = True
+            if progressed:
+                last_sent = time.monotonic()
+                continue
+            if not self._wake.wait(timeout=self.hub.heartbeat):
+                if time.monotonic() - last_sent >= self.hub.heartbeat:
+                    self._request(f, "replPing",
+                                  {"stream": self.hub.stream_id})
+                    last_sent = time.monotonic()
+            self._wake.clear()
+
+    def _ship_snapshot(self, f, name: str) -> None:
+        data, lsn = self.hub.snapshot(name)
+        cursor = encode_cursor(self.hub.stream_id, lsn)
+        self._request(f, "replSnapshot", {
+            "name": name,
+            "stream": self.hub.stream_id,
+            "lsn": lsn,
+            "snapshot": base64.b64encode(data).decode("ascii"),
+            "cursor": base64.b64encode(cursor).decode("ascii"),
+        })
+        self._needs_snapshot[name] = False
+        self._sent_lsn[name] = lsn
+        self.durable_lsn[name] = lsn
+        self.hub._note_follower_ack(name, lsn)
+
+    def _ship_tail(self, f, name: str) -> bool:
+        """Ship one contiguous batch after the follower's cursor; True
+        when records went out (call again — there may be more)."""
+        since = self._sent_lsn.get(name, 0)
+        try:
+            records, last = self.hub.tail_after(name, since)
+        except ReplicationError:
+            self._needs_snapshot[name] = True
+            self._ship_snapshot(f, name)
+            return True
+        if not records:
+            return False
+        cursor = encode_cursor(self.hub.stream_id, last)
+        with obs.span("cluster.ship_batch", records=len(records)):
+            try:
+                self._request(f, "replApply", {
+                    "name": name,
+                    "stream": self.hub.stream_id,
+                    "prev": since,
+                    "lsn": last,
+                    "data": base64.b64encode(
+                        encode_batch(records)).decode("ascii"),
+                    "cursor": base64.b64encode(cursor).decode("ascii"),
+                })
+            except ReplicationError as e:
+                if "ReplCursorMismatch" in str(e):
+                    # the follower's journal disagrees with our
+                    # bookkeeping (its restart raced an ack): resync
+                    # through a snapshot instead of guessing
+                    self._needs_snapshot[name] = True
+                    self._ship_snapshot(f, name)
+                    return True
+                raise
+        obs.count("cluster.records_shipped", n=len(records))
+        self._sent_lsn[name] = last
+        self.durable_lsn[name] = last
+        self.hub._note_follower_ack(name, last)
+        return True

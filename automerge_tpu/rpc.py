@@ -166,9 +166,23 @@ class RpcServer:
         # session handle -> doc handle, so the serving layer can route
         # session-only requests (poll/receive/stats) to the doc's shard
         self._session_docs: Dict[int, int] = {}
+        # (doc handle, peer) -> session handle for syncSessionAttach
+        # idempotency within one server incarnation
+        self._attached_sessions: Dict = {}
         # set by SocketRpcServer: durable docs opened through a concurrent
         # server compact on a background thread instead of the ack path
         self.serve_background_compact = False
+        # cluster hook (cluster/node.py): called with (name, durable_doc)
+        # after every FRESH openDurable, so a leader's replication hub
+        # starts shipping the document's journal the moment it exists
+        self.on_durable_open = None
+        # serializes the name-cache check against the filesystem open,
+        # PER NAME: a cluster node's replication path opens docs OUTSIDE
+        # the serving layer's openDurable queue, and two concurrent
+        # opens of one name would race each other onto the same journal
+        # flock — but a slow open (multi-second journal replay) of one
+        # document must not head-of-line-block opens of every other
+        self._open_locks: Dict[str, threading.Lock] = {}
 
     # -- handle plumbing ----------------------------------------------------
 
@@ -238,6 +252,18 @@ class RpcServer:
         with self._lock:
             doc = self._docs.pop(p["doc"], None)
             self._patched.discard(p["doc"])
+            # sessions attached to this doc die with it: they hold the
+            # (soon-closed) durable wrapper, and a long-lived server that
+            # re-attaches per restart/failover must not leak them
+            stale = [h for (d, _peer), h in self._attached_sessions.items()
+                     if d == p["doc"]]
+            for h in stale:
+                self._sessions.pop(h, None)
+                self._session_docs.pop(h, None)
+            self._attached_sessions = {
+                k: h for k, h in self._attached_sessions.items()
+                if k[0] != p["doc"]
+            }
             if doc is not None and hasattr(doc, "journal"):  # durable wrapper
                 # drop the name mapping BEFORE closing: if close raises,
                 # the name must not stay pointed at a dead handle
@@ -268,6 +294,12 @@ class RpcServer:
         incremental path absorbs sync-received changes."""
         name = p.get("name")
         path = self._durable_path(name)
+        with self._lock:
+            lk = self._open_locks.setdefault(name, threading.Lock())
+        with lk:
+            return self._open_durable_locked(name, path, p)
+
+    def _open_durable_locked(self, name, path, p):
         # the name-cache read and the live-handle check must be one
         # atomic snapshot: a concurrent free() pops both under this lock,
         # so we either see the live doc or neither — never a handle whose
@@ -310,6 +342,8 @@ class RpcServer:
         h = self._reg(self._docs, dd)
         with self._lock:
             self._durable_names[name] = h
+        if self.on_durable_open is not None:
+            self.on_durable_open(name, dd)
         return {"doc": h}
 
     def _durable_doc(self, p):
@@ -578,6 +612,31 @@ class RpcServer:
         self._session_docs[h] = p["doc"]
         return {"session": h}
 
+    def syncSessionAttach(self, p):
+        """Durable named session: restore (or create) the sync session
+        for ``peer`` from the document's journal meta, with the epoch
+        bumped — after a server restart or a failover promotion the
+        surviving client session sees the new epoch and renegotiates
+        through the epoch/reset handshake instead of a full resync.
+        Re-attaching a peer that is already live returns the existing
+        handle (the epoch only bumps across process incarnations)."""
+        doc = self._durable_doc(p)
+        peer = p.get("peer")
+        if not isinstance(peer, str) or not peer:
+            raise ValueError("syncSessionAttach requires a peer name")
+        with self._lock:
+            h = self._attached_sessions.get((p["doc"], peer))
+            if h is not None and h in self._sessions:
+                sess = self._sessions[h]
+                return {"session": h, "epoch": sess.epoch}
+        sess = doc.restore_sync_session(
+            peer, config=self._session_config(p))
+        h = self._reg(self._sessions, sess)
+        with self._lock:
+            self._session_docs[h] = p["doc"]
+            self._attached_sessions[(p["doc"], peer)] = h
+        return {"session": h, "epoch": sess.epoch}
+
     def _session(self, p) -> SyncSession:
         sess = self._sessions.get(p.get("session"))
         if sess is None:
@@ -605,6 +664,10 @@ class RpcServer:
         with self._lock:
             self._sessions.pop(p.get("session"), None)
             self._session_docs.pop(p.get("session"), None)
+            self._attached_sessions = {
+                k: h for k, h in self._attached_sessions.items()
+                if h != p.get("session")
+            }
         return None
 
     # -- observability ------------------------------------------------------
@@ -644,7 +707,7 @@ class RpcServer:
         "configure",
         "syncSessionNew", "syncSessionRestore", "syncSessionPoll",
         "syncSessionReceive", "syncSessionStats", "syncSessionEncode",
-        "syncSessionFree",
+        "syncSessionFree", "syncSessionAttach",
         "openDurable", "durableCompact", "durableInfo",
         "metrics",
     })
@@ -837,6 +900,27 @@ def main(argv=None) -> int:
         help="worker pool size for socket mode "
              "(default AUTOMERGE_TPU_SERVE_WORKERS or 8)",
     )
+    ap.add_argument(
+        "--node-id", default=None, metavar="ID",
+        help="run as a cluster node (cluster/node.py) with this id; "
+             "requires --socket and --durable",
+    )
+    ap.add_argument(
+        "--replicate-to", action="append", default=[], metavar="HOST:PORT",
+        help="cluster leader: ship acked journal records to this "
+             "follower node (repeatable)",
+    )
+    ap.add_argument(
+        "--follow", default=None, metavar="HOST:PORT",
+        help="cluster follower: reject client mutations (NotLeader, "
+             "naming this leader) and accept the replication stream",
+    )
+    ap.add_argument(
+        "--ack-replicas", type=int, default=None,
+        help="cluster leader: client acks wait until this many "
+             "followers hold the write durably (default "
+             "AUTOMERGE_TPU_CLUSTER_ACK_REPLICAS or 0)",
+    )
     args = ap.parse_args(argv)
     if args.durable:
         os.makedirs(args.durable, exist_ok=True)
@@ -853,7 +937,26 @@ def main(argv=None) -> int:
             os.environ.get("AUTOMERGE_TPU_SERVE_SWITCH_INTERVAL", "0.001")
         ))
 
-        if args.socket:
+        cluster = bool(args.node_id or args.replicate_to or args.follow)
+        if cluster:
+            from .cluster import ClusterNode
+
+            if not (args.socket and args.durable):
+                print("cluster node mode requires --socket and --durable",
+                      file=sys.stderr)
+                return 2
+            host, _, port = args.socket.rpartition(":")
+            srv = ClusterNode(
+                node_id=args.node_id or f"node-{os.getpid()}",
+                host=host or "127.0.0.1", port=int(port),
+                durable_dir=args.durable,
+                role="follower" if args.follow else "leader",
+                leader_addr=args.follow,
+                replicate_to=args.replicate_to,
+                ack_replicas=args.ack_replicas,
+                workers=args.workers,
+            )
+        elif args.socket:
             host, _, port = args.socket.rpartition(":")
             srv = SocketRpcServer(
                 host=host or "127.0.0.1", port=int(port),

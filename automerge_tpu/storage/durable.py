@@ -65,6 +65,14 @@ JOURNAL_NAME = "journal.waj"
 
 _SYNC_META_PREFIX = "sync/"
 
+# follower-side replication state rides in the journal as latest-wins
+# meta (cluster/replication.py): the cursor names the leader stream and
+# the last applied LSN, re-appended with every replicated batch inside
+# the batch's own ack scope — so cursor and changes share one fsync and
+# the cursor can never claim records the journal does not hold
+REPL_META_PREFIX = "repl/"
+REPL_CURSOR_KEY = REPL_META_PREFIX + "cursor"
+
 
 class DurableDocument:
     """A document whose changes survive the process. See module docstring."""
@@ -119,6 +127,11 @@ class DurableDocument:
         # for an N-change merge/sync batch)
         self._ack_depth = 0
         self.device_doc = None  # set by open(device=True)
+        # cluster replication gate (cluster/replication.py): when set,
+        # the OUTERMOST ack-scope exit blocks until enough followers
+        # hold the batch durably — a raised gate converts the batch to
+        # errors instead of acking un-replicated writes
+        self.replication_gate = None
 
     # -- construction --------------------------------------------------------
 
@@ -255,8 +268,14 @@ class DurableDocument:
             # from racing a commit/merge/sync apply; uncontended RLock
             # cost on the single-threaded path is negligible
             def _acked(*a, _attr=attr, **kw):
-                with self.lock, self.ack_scope():
-                    return _attr(*a, **kw)
+                # ack scope OUTSIDE the lock (the same shape the serving
+                # layer's batch drain uses): the boundary fsync and the
+                # replication ack gate then run lock-free, so a follower
+                # snapshot catch-up needing this lock can proceed while
+                # a gated commit waits for it
+                with self.ack_scope():
+                    with self.lock:
+                        return _attr(*a, **kw)
 
             # bound host methods are stable for this instance's lifetime:
             # memoize the wrapper so hot-path calls (commit per edit) skip
@@ -285,6 +304,14 @@ class DurableDocument:
             # one scope, and that group pays one fsync (group commit)
             if self._ack_depth == 0 and not self._journal.closed:
                 self._journal.policy_sync()
+                if self.replication_gate is not None:
+                    # quorum before ack: the ack_replicas contract ("on
+                    # K+1 disks when acked") overrides a lazier fsync
+                    # policy — force local durability so the gate's
+                    # target covers this batch, then wait for the
+                    # follower copies the contract promises
+                    self._journal.sync()
+                    self.replication_gate()
                 self.maybe_compact()
 
     def __enter__(self):
@@ -492,6 +519,71 @@ class DurableDocument:
                 return True
             finally:
                 self._compacting = False
+
+    # -- replication (cluster/replication.py rides these) --------------------
+
+    @property
+    def replication_cursor(self) -> Optional[bytes]:
+        """The persisted follower cursor blob (None when this document
+        has never followed a leader, or was promoted and compacted)."""
+        return self._meta.get(REPL_CURSOR_KEY)
+
+    def acked_prefix(self) -> tuple:
+        """(acked, appended) journal seqs: every append <= acked is
+        durable on this node's disk — the prefix replication ships and
+        promotion compares."""
+        j = self._journal
+        return j.acked_seq, j.append_seq
+
+    def apply_replicated(self, records, cursor: Optional[bytes]) -> int:
+        """Apply a batch of shipped journal records through the normal
+        listener path: changes enter history (journaled locally before
+        ack, deduplicated by hash exactly like a re-delivered sync
+        frame), replicated meta overwrites latest-wins (so a peer's
+        ``sync/<peer>`` shared_heads survive failover), and the cursor
+        meta joins the SAME ack scope — one fsync covers the whole batch
+        and the cursor is durable iff the records are."""
+        from .change import parse_change
+
+        changes = []
+        metas = []
+        for rec_type, payload in records:
+            if rec_type == REC_CHANGE:
+                try:
+                    change, _ = parse_change(payload)
+                except Exception:
+                    # CRC-framed but unparseable — mirror recovery: count
+                    # and keep the stream moving (the leader journaled it,
+                    # so a reject here is a codec bug, not data loss)
+                    obs.count("journal.rejected_records")
+                    continue
+                changes.append(change)
+            elif rec_type == REC_META:
+                name, blob = decode_meta(payload)
+                if name.startswith(REPL_META_PREFIX):
+                    continue  # never adopt another node's own cursor
+                metas.append((name, blob))
+        with self.lock, self.ack_scope():
+            if changes:
+                # through the wrapper: the change listener journals each
+                # applied change, duplicates drop on the history index
+                self.apply_changes(changes)
+            for name, blob in metas:
+                self.set_meta(name, blob)
+            if cursor is not None:
+                self.set_meta(REPL_CURSOR_KEY, cursor)
+        return len(changes)
+
+    def apply_replicated_snapshot(self, data: bytes,
+                                  cursor: Optional[bytes]) -> None:
+        """Catch-up path for a new or lagging follower: load a full
+        leader snapshot (known changes deduplicate on the history index,
+        so re-snapshotting after failover converges instead of erroring)
+        and persist the new cursor under the same ack scope."""
+        with self.lock, self.ack_scope():
+            self.load_incremental(data, on_partial="error")
+            if cursor is not None:
+                self.set_meta(REPL_CURSOR_KEY, cursor)
 
     # -- sync-session persistence (shared_heads survive restarts) ------------
 

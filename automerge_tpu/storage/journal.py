@@ -233,6 +233,22 @@ def scan_records(data: bytes) -> Tuple[List[JournalRecord], TailReport]:
     return records, TailReport(valid, n, len(records), reason)
 
 
+def scan_record_seq(data: bytes) -> List[JournalRecord]:
+    """Parse a bare record sequence (no journal header) — the exact bytes
+    the replication layer puts on the wire (cluster/replication.py ships
+    journal records verbatim, so a replicated batch and a journal file
+    verify through the same scan). Unlike ``scan_records`` a torn or
+    corrupt record here is an error: TCP delivered these bytes intact, so
+    damage means a framing bug, not a crash mid-write."""
+    records, tail = scan_records(JOURNAL_MAGIC + data)
+    if tail.torn:
+        raise JournalError(
+            f"replicated record batch damaged at byte "
+            f"{tail.valid_bytes - len(JOURNAL_MAGIC)}: {tail.reason}"
+        )
+    return records
+
+
 def salvage_header_scan(data: bytes) -> List[JournalRecord]:
     """Records recoverable from a file whose 4-byte header is damaged:
     they are individually CRC-framed, so they re-verify under a synthetic
@@ -271,6 +287,16 @@ class Journal:
         self._append_seq = 0
         self._synced_seq = 0
         self._fsync_leader = False
+        # replication hooks (cluster/replication.py): on_record fires for
+        # every successful append (under the journal lock, so callbacks
+        # observe appends in exact file order), on_synced after each fsync
+        # with the covering append seq (the records now durable locally).
+        # A failing hook is counted, never raised — replication is a
+        # sidecar of the local durability path, and a follower that
+        # misses a record recovers through the cursor-mismatch snapshot
+        # catch-up.
+        self.on_record = None  # callable(rec_type, payload, append_seq)
+        self.on_synced = None  # callable(covering_append_seq)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -383,6 +409,18 @@ class Journal:
         """Appends not yet covered by an fsync."""
         return self._append_seq - self._synced_seq
 
+    @property
+    def append_seq(self) -> int:
+        """Monotone per-open append counter (does not reset on truncate)."""
+        return self._append_seq
+
+    @property
+    def acked_seq(self) -> int:
+        """The durable acked prefix: every append with seq <= this has
+        been covered by an fsync (the replication layer ships exactly
+        this prefix, and promotion compares followers by it)."""
+        return self._synced_seq
+
     # -- appends -------------------------------------------------------------
 
     @property
@@ -424,6 +462,11 @@ class Journal:
                 self._size += len(rec)
                 self._count += 1
                 self._append_seq += 1
+                if self.on_record is not None:
+                    try:
+                        self.on_record(rec_type, payload, self._append_seq)
+                    except Exception as e:  # noqa: BLE001 — sidecar only
+                        obs.count("journal.hook_error", error=str(e)[:200])
         if auto_sync:
             self.policy_sync()
 
@@ -488,6 +531,11 @@ class Journal:
             self._fsync_leader = False
             self._cond.notify_all()
         obs.observe("group_commit.batch_size", batch)
+        if self.on_synced is not None:
+            try:
+                self.on_synced(covering)
+            except Exception as e:  # noqa: BLE001 — sidecar only
+                obs.count("journal.hook_error", error=str(e)[:200])
 
     def truncate(self) -> None:
         """Reset to an empty journal (post-compaction): the truncation is

@@ -33,8 +33,39 @@ RUST_PIN_REPLAY = 500_000.0   # local transaction replay (edit-trace bench)
 RUST_PIN_APPLY = 250_000.0    # remote apply_changes (per-op seek/insert)
 
 
+# every knob resolved through env_int / env_flag lands here, so the
+# output JSON carries the exact configuration that produced it — the
+# BENCH_r0*.json trajectory stays self-describing across PRs
+RESOLVED_CONFIG = {}
+
+BENCH_SCHEMA_VERSION = 2
+
+
 def env_int(name, default):
-    return int(os.environ.get(name, default))
+    v = int(os.environ.get(name, default))
+    RESOLVED_CONFIG[name] = v
+    return v
+
+
+def env_flag(name, default=""):
+    v = os.environ.get(name, default)
+    RESOLVED_CONFIG[name] = v
+    return v
+
+
+def git_commit():
+    """The repo HEAD this bench ran against (None outside a checkout)."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10,
+        )
+        return out.stdout.strip() or None if out.returncode == 0 else None
+    except Exception:
+        return None
 
 
 def main():
@@ -55,7 +86,7 @@ def main():
     from automerge_tpu.sync import SyncState
     from automerge_tpu.types import ActorId
 
-    verbose = os.environ.get("BENCH_VERBOSE")
+    verbose = env_flag("BENCH_VERBOSE")
     reps = env_int("BENCH_REPS", 3)  # best-of-N, one knob for every config
     results = {}
 
@@ -92,7 +123,7 @@ def main():
         "batch_ops_per_sec": round(n_b / t_batch, 1),
         "batch_vs_baseline": round(n_b / t_batch / RUST_PIN_REPLAY, 4),
     }
-    if os.environ.get("BENCH_PHASES"):
+    if env_flag("BENCH_PHASES"):
         # the reference edit-trace binary's phase report
         # (rust/edit-trace/src/main.rs:23-55): save / load / fork_at / text
         t0 = time.perf_counter()
@@ -208,7 +239,7 @@ def main():
     # to "no kernel numbers", never kill the whole report (the host-engine
     # headline is the primary metric)
     try:
-        if os.environ.get("BENCH_KERNEL", "1") != "0":
+        if env_flag("BENCH_KERNEL", "1") != "0":
             import jax
             import jax.numpy as jnp
 
@@ -324,7 +355,7 @@ def main():
     device_e2e = {}
     try:
         if (
-            os.environ.get("BENCH_DEVICE_E2E", "1") != "0"
+            env_flag("BENCH_DEVICE_E2E", "1") != "0"
             and kernel
             and "kernel_error" not in kernel
         ):
@@ -340,7 +371,7 @@ def main():
                 else:
                     os.environ["AUTOMERGE_TPU_HOST_MERGE_MAX"] = prev
             t_de2e = t_dex + t_dmg
-            pcie_bw = float(os.environ.get("BENCH_PCIE_BW", 16e9))
+            pcie_bw = float(env_flag("BENCH_PCIE_BW", 16e9))
             # readback: the READ_FETCH outputs (visible u8 + winner/conflicts/
             # elem_index i32 per row, plus two i32 per object)
             bytes_out = n * (1 + 4 + 4 + 4) + 2 * 4 * (log.n_objs + 2)
@@ -688,7 +719,7 @@ def main():
 
     dur = {}
     n_dur = env_int("BENCH_DURABLE_COMMITS", 2000)
-    dur_fsync = os.environ.get("BENCH_DURABLE_FSYNC", "interval")
+    dur_fsync = env_flag("BENCH_DURABLE_FSYNC", "interval")
     tmpd = tempfile.mkdtemp(prefix="amtpu_bench_durable_")
     try:
         dd = AutoDoc.open(
@@ -754,7 +785,7 @@ def main():
     # p50/p95/p99 are log-bucket-derived like every other config.
     serve_cfg = {}
     try:
-        if os.environ.get("BENCH_SERVE", "1") != "0":
+        if env_flag("BENCH_SERVE", "1") != "0":
             import base64
             import re
             import shutil
@@ -979,11 +1010,172 @@ def main():
     results["serve"] = serve_cfg
     note(f"serve: {results['serve']}")
 
+    # ---- config: cluster (replicated serving + leader failover) ------------
+    # Three node subprocesses (leader + 2 followers, quorum acks) behind
+    # an in-process router. The workload commits through the router while
+    # the leader is kill -9'd BENCH_CLUSTER_FAILOVERS times; each cycle
+    # measures the client-observed failover latency (first failed ack ->
+    # first successful ack on the promoted leader) and the killed node
+    # rejoins as a follower before the next cycle. Reported: replicated
+    # commit throughput under quorum acks plus failover-latency
+    # p50/p95/p99 from the same log-bucketed histograms as every other
+    # config.
+    cluster_cfg = {}
+    try:
+        if env_flag("BENCH_CLUSTER", "1") != "0":
+            import re
+            import shutil
+            import socket as socketmod
+            import subprocess
+            import tempfile
+            import threading
+
+            from automerge_tpu.cluster import ClusterRouter
+
+            n_failovers = env_int("BENCH_CLUSTER_FAILOVERS", 3)
+            n_warm = env_int("BENCH_CLUSTER_OPS", 30)
+            hb = float(env_flag("BENCH_CLUSTER_HEARTBEAT", "0.25"))
+            tmp_cluster = tempfile.mkdtemp(prefix="amtpu_bench_cluster_")
+            sub_env = dict(
+                os.environ, JAX_PLATFORMS="cpu",
+                AUTOMERGE_TPU_CLUSTER_HEARTBEAT=str(hb),
+            )
+            procs = {}
+
+            def spawn_node(i, extra):
+                d = os.path.join(tmp_cluster, f"n{i}")
+                os.makedirs(d, exist_ok=True)
+                p = subprocess.Popen(
+                    [sys.executable, "-m", "automerge_tpu.rpc",
+                     "--socket", "127.0.0.1:0", "--durable", d,
+                     "--node-id", f"n{i}"] + extra,
+                    stderr=subprocess.PIPE, text=True, env=sub_env,
+                )
+                addr = "127.0.0.1:" + re.search(
+                    r"(\d+)\)", p.stderr.readline()).group(1)
+                threading.Thread(
+                    target=lambda: [None for _ in p.stderr],
+                    daemon=True).start()
+                procs[addr] = p
+                return addr
+
+            a1 = spawn_node(1, ["--follow", "pending", "--ack-replicas", "1"])
+            a2 = spawn_node(2, ["--follow", "pending", "--ack-replicas", "1"])
+            a0 = spawn_node(0, ["--replicate-to", a1, "--replicate-to", a2,
+                                "--ack-replicas", "1"])
+            router = ClusterRouter([[a0, a1, a2]], heartbeat=hb,
+                                   miss_limit=2)
+            router.start()
+
+            def rpc_call(sock, f, rid, method, **params):
+                sock.sendall((json.dumps(
+                    {"id": rid, "method": method, "params": params}
+                ) + "\n").encode())
+                return json.loads(f.readline())
+
+            try:
+                sock = socketmod.create_connection(router.address)
+                sock.setsockopt(socketmod.IPPROTO_TCP,
+                                socketmod.TCP_NODELAY, 1)
+                f = sock.makefile("r")
+                rid = [0]
+
+                def call(method, **params):
+                    rid[0] += 1
+                    return rpc_call(sock, f, rid[0], method, **params)
+
+                d = call("openDurable", name="bench")["result"]["doc"]
+                # throughput under quorum acks, failure-free
+                t0 = time.perf_counter()
+                for i in range(n_warm):
+                    call("put", doc=d, obj="_root", prop=f"w{i}", value=i)
+                    r = call("commit", doc=d)
+                    assert "error" not in r, r
+                t_quorum = time.perf_counter() - t0
+
+                fo_lats = []
+                k = 0
+                for cycle in range(n_failovers):
+                    leader = next(
+                        g["leader"] for g in call(
+                            "clusterInfo")["result"]["groups"])
+                    procs[leader].kill()  # SIGKILL: the real thing
+                    procs[leader].wait()
+                    t_fail = None
+                    deadline = time.perf_counter() + 60
+                    while True:
+                        assert time.perf_counter() < deadline, "failover hung"
+                        r1 = call("put", doc=d, obj="_root",
+                                  prop=f"f{k}", value=k)
+                        r2 = (call("commit", doc=d)
+                              if "error" not in r1 else r1)
+                        if "error" in r1 or "error" in r2:
+                            if t_fail is None:
+                                t_fail = time.perf_counter()
+                            time.sleep(0.02)
+                            continue
+                        if t_fail is not None:
+                            fo_lats.append(time.perf_counter() - t_fail)
+                        k += 1
+                        break
+                    # a fresh node rejoins the group as a follower so
+                    # every cycle keeps a full quorum pool
+                    new_leader = next(
+                        g["leader"] for g in call(
+                            "clusterInfo")["result"]["groups"])
+                    rejoin = spawn_node(
+                        10 + cycle, ["--follow", new_leader,
+                                     "--ack-replicas", "1"])
+                    r = call("clusterJoin", group=0, addr=rejoin)
+                    assert "error" not in r, r
+                # every acked key must be readable (zero acked-write loss)
+                for i in range(n_warm):
+                    got = call("get", doc=d, obj="_root", prop=f"w{i}")
+                    assert got.get("result") == i, (i, got)
+                for i in range(k):
+                    got = call("get", doc=d, obj="_root", prop=f"f{i}")
+                    assert got.get("result") == i, (i, got)
+                sock.close()
+            finally:
+                router.stop()
+                for p_ in procs.values():
+                    if p_.poll() is None:
+                        p_.kill()
+                        p_.wait(timeout=10)
+                shutil.rmtree(tmp_cluster, ignore_errors=True)
+
+            cluster_cfg = {
+                "nodes": 3,
+                "ack_replicas": 1,
+                "failovers": n_failovers,
+                "quorum_commits_per_sec": round(n_warm / t_quorum, 1),
+                "failover_latencies_s": [round(x, 3) for x in fo_lats],
+                **{
+                    k.replace("latency", "failover_latency"): v
+                    for k, v in _latency_percentiles(
+                        "bench.cluster.failover_latency", fo_lats
+                    ).items()
+                },
+            }
+    except Exception as e:  # noqa: BLE001 — degrade, record, continue
+        import traceback
+
+        tb = traceback.format_exc()
+        cluster_cfg = {"cluster_error": repr(e)[:500]}
+        print(f"cluster config failed:\n{tb}", file=sys.stderr, flush=True)
+    results["cluster"] = cluster_cfg
+    note(f"cluster: {results['cluster']}")
+
     out = {
         "metric": "edit_trace_fanin_merge_ops_per_sec",
         "value": results["fanin"]["ops_per_sec"],
         "unit": "ops/s",
         "vs_baseline": results["fanin"]["vs_baseline"],
+        # provenance: which code produced these numbers, under exactly
+        # which resolved knobs — the JSON is self-describing across PRs
+        "git_commit": git_commit(),
+        "schema_version": BENCH_SCHEMA_VERSION,
+        "config": dict(sorted(RESOLVED_CONFIG.items())),
         "configs": results,
         # cumulative device-phase attribution across the whole run
         # (trace.time spans: device.extract / h2d / kernel / readback /

@@ -1,0 +1,371 @@
+"""Cluster tier: hash ring, journal-shipping replication, failover,
+live migration.
+
+Three layers: pure units (ring placement, wire codecs, journal hooks),
+in-process leader/follower node pairs over real sockets (replication
+convergence, quorum acks, cursor persistence, promotion), and the
+router tier end to end (proxying, failover with zero acked-write loss,
+migration between shard groups).
+"""
+
+import json
+import os
+import socket
+import tempfile
+import threading
+import time
+
+import pytest
+
+from automerge_tpu.api import AutoDoc
+from automerge_tpu.cluster import (
+    ClusterNode,
+    ClusterRouter,
+    HashRing,
+    decode_batch,
+    decode_cursor,
+    encode_batch,
+    encode_cursor,
+)
+from automerge_tpu.storage.journal import (
+    Journal,
+    JournalError,
+    REC_CHANGE,
+    REC_META,
+)
+
+
+# -- helpers ------------------------------------------------------------------
+
+
+class Client:
+    """Minimal pipelining JSON-RPC socket client."""
+
+    def __init__(self, address):
+        self.sock = socket.create_connection(address)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.f = self.sock.makefile("r")
+        self.rid = 0
+
+    def call(self, method, allow_error=False, **params):
+        self.rid += 1
+        self.sock.sendall((json.dumps(
+            {"id": self.rid, "method": method, "params": params}
+        ) + "\n").encode())
+        resp = json.loads(self.f.readline())
+        if not allow_error:
+            assert "error" not in resp, resp
+        return resp if "error" in resp else resp.get("result")
+
+    def close(self):
+        self.sock.close()
+
+
+def addr_of(node):
+    return "%s:%d" % node.address
+
+
+def start_node(tmp, name, **kw):
+    d = os.path.join(str(tmp), name)
+    node = ClusterNode(
+        node_id=name, host="127.0.0.1", port=0, durable_dir=d, **kw
+    )
+    node.start()
+    return node
+
+
+def wait_until(pred, timeout=10.0, interval=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# -- units --------------------------------------------------------------------
+
+
+def test_hashring_stable_balanced_minimal_movement():
+    ring = HashRing(["a", "b", "c"], vnodes=64)
+    keys = [f"doc{i}" for i in range(3000)]
+    before = {k: ring.member_for(k) for k in keys}
+    # stability: a rebuilt ring places identically
+    again = HashRing(["c", "a", "b"], vnodes=64)
+    assert all(again.member_for(k) == v for k, v in before.items())
+    # rough balance: no member below a third of the fair share
+    counts = {}
+    for v in before.values():
+        counts[v] = counts.get(v, 0) + 1
+    assert min(counts.values()) > len(keys) / 3 / 3
+    # removing a member moves ONLY its keys
+    ring.remove("b")
+    for k in keys:
+        if before[k] != "b":
+            assert ring.member_for(k) == before[k]
+        else:
+            assert ring.member_for(k) in ("a", "c")
+
+
+def test_cursor_and_batch_codecs_roundtrip():
+    blob = encode_cursor("node-1/abc123", 991)
+    assert decode_cursor(blob) == ("node-1/abc123", 991)
+    records = [(REC_CHANGE, b"\x01" * 40), (REC_META, b"name-blob")]
+    assert decode_batch(encode_batch(records)) == records
+    # damage must raise, never truncate silently: TCP delivered it, so a
+    # bad byte is a bug, not a torn write
+    wire = bytearray(encode_batch(records))
+    wire[10] ^= 0xFF
+    with pytest.raises(JournalError):
+        decode_batch(bytes(wire))
+    assert decode_batch(b"") == []
+
+
+def test_journal_hooks_fire_with_seqs(tmp_path):
+    events = []
+    j, _, _ = Journal.open(str(tmp_path / "j.waj"), fsync="always")
+    j.on_record = lambda rt, pl, seq: events.append(("rec", rt, seq))
+    j.on_synced = lambda seq: events.append(("sync", seq))
+    j.append_change(b"abc")
+    j.append_change(b"def")
+    assert ("rec", REC_CHANGE, 1) in events and ("rec", REC_CHANGE, 2) in events
+    assert ("sync", 1) in events and ("sync", 2) in events
+    assert j.acked_seq == 2 and j.append_seq == 2
+    j.close()
+
+
+# -- leader/follower replication ---------------------------------------------
+
+
+def test_replication_quorum_converges_and_promotes(tmp_path):
+    fol = start_node(tmp_path, "f1", role="follower")
+    led = start_node(tmp_path, "l1", role="leader",
+                     replicate_to=[addr_of(fol)], ack_replicas=1)
+    try:
+        c = Client(led.address)
+        d = c.call("openDurable", name="docA")["doc"]
+        for i in range(12):
+            c.call("put", doc=d, obj="_root", prop=f"k{i}", value=i)
+            c.call("commit", doc=d)
+        save_l = c.call("save", doc=d)
+
+        fc = Client(fol.address)
+        # follower rejects client mutations
+        r = fc.call("create", allow_error=True)
+        assert r["error"]["type"] == "NotLeader", r
+        # the quorum ack means the follower ALREADY holds everything
+        st = fc.call("clusterStatus")
+        assert st["role"] == "follower"
+        cur = st["docs"]["docA"]["cursor"]
+        assert cur is not None and cur["lsn"] >= 12
+        assert cur["stream"] == led.rpc.hub.stream_id
+        # promotion: byte-identical state, serves mutations
+        pr = fc.call("clusterPromote")
+        assert pr["promoted"] is True
+        hf = fc.call("openDurable", name="docA")["doc"]
+        assert fc.call("save", doc=hf) == save_l
+        fc.call("put", doc=hf, obj="_root", prop="after", value=1)
+        fc.call("commit", doc=hf)
+        c.close()
+        fc.close()
+    finally:
+        led.stop()
+        fol.stop()
+
+
+def test_replication_cursor_survives_follower_restart(tmp_path):
+    fol = start_node(tmp_path, "f1", role="follower")
+    fol_addr = addr_of(fol)
+    led = start_node(tmp_path, "l1", role="leader",
+                     replicate_to=[fol_addr], ack_replicas=1)
+    try:
+        snapshots = []
+        orig_snapshot = led.rpc.hub.snapshot
+        led.rpc.hub.snapshot = lambda name: (
+            snapshots.append(name) or orig_snapshot(name))
+
+        c = Client(led.address)
+        d = c.call("openDurable", name="docA")["doc"]
+        for i in range(6):
+            c.call("put", doc=d, obj="_root", prop=f"k{i}", value=i)
+            c.call("commit", doc=d)
+        first_snapshots = len(snapshots)  # the initial catch-up
+        fol.stop()
+
+        fol2 = start_node(tmp_path, "f1", role="follower")
+        try:
+            led.rpc.hub.remove_follower(fol_addr)
+            c.call("clusterReplicateTo", addr=addr_of(fol2))
+            for i in range(6, 12):
+                c.call("put", doc=d, obj="_root", prop=f"k{i}", value=i)
+                c.call("commit", doc=d)
+            fc = Client(fol2.address)
+            st = fc.call("clusterStatus")
+            cur = st["docs"]["docA"]["cursor"]
+            assert cur["lsn"] >= 12
+            # the restart resumed from the persisted cursor: the journal
+            # tail shipped, no second snapshot
+            assert len(snapshots) == first_snapshots, snapshots
+            fc.close()
+            c.close()
+        finally:
+            fol2.stop()
+    finally:
+        led.stop()
+
+
+def test_ack_gate_times_out_without_followers(tmp_path, monkeypatch):
+    monkeypatch.setenv("AUTOMERGE_TPU_CLUSTER_ACK_TIMEOUT", "0.3")
+    led = start_node(tmp_path, "l1", role="leader", ack_replicas=1)
+    try:
+        c = Client(led.address)
+        d = c.call("openDurable", name="docA")["doc"]
+        c.call("put", doc=d, obj="_root", prop="k", value=1)
+        r = c.call("commit", doc=d, allow_error=True)
+        # no follower can confirm the write: the ack MUST NOT happen
+        assert "error" in r, r
+        assert "ReplicationTimeout" in r["error"]["type"], r
+        c.close()
+    finally:
+        led.stop()
+
+
+# -- the router tier ----------------------------------------------------------
+
+
+def test_router_proxies_and_virtualizes_handles(tmp_path):
+    n0 = start_node(tmp_path, "n0", role="leader")
+    router = ClusterRouter([[addr_of(n0)]], heartbeat=5.0)
+    router.start()
+    try:
+        c = Client(router.address)
+        d = c.call("openDurable", name="docA")["doc"]
+        for i in range(10):
+            c.call("put", doc=d, obj="_root", prop=f"k{i}", value=i)
+        c.call("commit", doc=d)
+        assert c.call("length", doc=d, obj="_root") == 10
+        assert c.call("get", doc=d, obj="_root", prop="k7") == 7
+        # reopening the same name returns the SAME virtual handle
+        assert c.call("openDurable", name="docA")["doc"] == d
+        # plain (anchor-routed) docs work too
+        p = c.call("create")["doc"]
+        assert p != d
+        c.call("put", doc=p, obj="_root", prop="x", value=1)
+        c.call("commit", doc=p)
+        info = c.call("clusterInfo")
+        assert info["groups"][0]["up"] is True
+        c.close()
+    finally:
+        router.stop()
+        n0.stop()
+
+
+def _kill_node_sockets(node):
+    """Simulate abrupt node death for in-process tests: stop listening
+    and cut every connection without any flush (the real kill -9 sweep
+    lives in scripts/ci/run_cluster)."""
+    node._shutdown.set()
+    if node._listener is not None:
+        node._listener.close()
+    with node._conns_lock:
+        conns = list(node._conns.values())
+    for conn in conns:
+        conn.close()
+
+
+def test_router_failover_zero_acked_loss(tmp_path):
+    fol1 = start_node(tmp_path, "n1", role="follower")
+    fol2 = start_node(tmp_path, "n2", role="follower")
+    led = start_node(
+        tmp_path, "n0", role="leader",
+        replicate_to=[addr_of(fol1), addr_of(fol2)], ack_replicas=1,
+    )
+    led_addr = addr_of(led)
+    router = ClusterRouter(
+        [[led_addr, addr_of(fol1), addr_of(fol2)]],
+        heartbeat=0.1, miss_limit=3,
+    )
+    router.start()
+    try:
+        c = Client(router.address)
+        d = c.call("openDurable", name="docA")["doc"]
+        sess = c.call("syncSessionAttach", doc=d, peer="client-x")
+        acked = []
+        for i in range(10):
+            c.call("put", doc=d, obj="_root", prop=f"k{i}", value=i)
+            c.call("commit", doc=d)
+            acked.append(i)
+
+        _kill_node_sockets(led)
+        # keep writing through the failover: Unavailable is retriable
+        i, deadline = 10, time.monotonic() + 30
+        while i < 16:
+            assert time.monotonic() < deadline, "failover never completed"
+            r1 = c.call("put", doc=d, obj="_root", prop=f"k{i}", value=i,
+                        allow_error=True)
+            if "error" in (r1 or {}):
+                time.sleep(0.05)
+                continue
+            r2 = c.call("commit", doc=d, allow_error=True)
+            if "error" in (r2 or {}):
+                time.sleep(0.05)
+                continue
+            acked.append(i)
+            i += 1
+
+        info = c.call("clusterInfo")
+        assert info["groups"][0]["gen"] >= 1
+        assert info["groups"][0]["leader"] != led_addr
+        # zero acked-write loss: every acked key is readable
+        for i in acked:
+            assert c.call("get", doc=d, obj="_root", prop=f"k{i}") == i
+        # the attached session re-materializes on the new leader with a
+        # bumped epoch (the client side would epoch-handshake, not
+        # full-resync)
+        sess2 = c.call("syncSessionAttach", doc=d, peer="client-x")
+        assert sess2["epoch"] >= 2
+        c.close()
+    finally:
+        router.stop()
+        for n in (led, fol1, fol2):
+            n.stop()
+
+
+def test_router_live_migration_between_groups(tmp_path):
+    n0 = start_node(tmp_path, "g0", role="leader")
+    n1 = start_node(tmp_path, "g1", role="leader")
+    router = ClusterRouter([[addr_of(n0)], [addr_of(n1)]], heartbeat=5.0)
+    router.start()
+    try:
+        c = Client(router.address)
+        d = c.call("openDurable", name="migdoc")["doc"]
+        sess = c.call("syncSessionAttach", doc=d, peer="mig-peer")
+        for i in range(20):
+            c.call("put", doc=d, obj="_root", prop=f"k{i}", value=i)
+        c.call("commit", doc=d)
+        home = HashRing([0, 1]).member_for("migdoc")
+        target = 1 - home
+        res = c.call("clusterMigrate", name="migdoc", to=target)
+        assert res["migrated"] is True
+        # reads and writes keep flowing through the same virtual handle
+        for i in range(20):
+            assert c.call("get", doc=d, obj="_root", prop=f"k{i}") == i
+        c.call("put", doc=d, obj="_root", prop="after", value="moved")
+        c.call("commit", doc=d)
+        assert c.call("get", doc=d, obj="_root", prop="after") == "moved"
+        # the attached session moved WITH the doc: the same virtual
+        # handle re-attaches on the destination (epoch bumped), instead
+        # of routing to the source's freed copy
+        stats = c.call("syncSessionStats", session=sess["session"])
+        assert stats["epoch"] > sess["epoch"]
+        assert c.call("clusterInfo")["overrides"] == {"migdoc": target}
+        # the source released its journal flock
+        src_dir = os.path.join(
+            str(tmp_path), ["g0", "g1"][home], "migdoc")
+        dd = AutoDoc.open(src_dir)
+        dd.close()
+        c.close()
+    finally:
+        router.stop()
+        n0.stop()
+        n1.stop()
