@@ -181,6 +181,17 @@ def percentiles(name: str, qs=(0.5, 0.95, 0.99), labels: Optional[dict] = None) 
     return {q: h.percentile(q) for q in qs}
 
 
+def counter_values(name: str, label: str) -> dict:
+    """{label value: count} across the named counter's label sets (e.g.
+    ``counter_values("device.kernel_launches", "path")`` — the per-path
+    dispatch totals the bench JSON and the multichip harness export)."""
+    return {
+        e["labels"].get(label, ""): e["value"]
+        for e in snapshot()
+        if e["type"] == "counter" and e["name"] == name
+    }
+
+
 # -- hierarchical spans ------------------------------------------------------
 
 

@@ -5,7 +5,10 @@ host-only paths (the bulk rebuild's use of ``ops.extract``) must not pay
 for it.
 """
 
-__all__ = ["DeviceDoc", "OpLog", "merge_columns", "merge_kernel"]
+__all__ = [
+    "CrossDocBatcher", "DeviceDoc", "OpLog", "apply_cross_doc",
+    "merge_columns", "merge_kernel",
+]
 
 
 def __getattr__(name):
@@ -13,6 +16,10 @@ def __getattr__(name):
         from .device_doc import DeviceDoc
 
         return DeviceDoc
+    if name in ("CrossDocBatcher", "apply_cross_doc"):
+        from . import batched
+
+        return getattr(batched, name)
     if name == "OpLog":
         from .oplog import OpLog
 

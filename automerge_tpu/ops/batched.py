@@ -1,0 +1,390 @@
+"""Cross-document batched device merge: one kernel launch per drain cycle.
+
+A server draining N hot documents used to pay N separate kernel
+dispatches — one dirty-set re-resolution per ``DeviceDoc`` — even though
+the serve layer already hands the drain over as multi-document work
+(serve/shards.py) and each dispatch is launch-overhead-bound at serve
+sizes. This module multiplies those dispatches away: the coalesced
+deltas of many small documents are packed into ONE ragged super-batch
+(per-doc subset columns concatenated with row/object-id offsets, padded
+to a shared capacity bucket so jit caches stay warm) and succ
+resolution, visibility, winner recompute and dirty-set re-resolution run
+as a single kernel launch, results scattered back per document.
+
+Soundness: every group id in the resolution kernel (sequence runs keyed
+by run-head row, map groups keyed by (object, prop)) is derived from row
+and object ids, so offsetting each document's subset rows and dense
+object ids into disjoint ranges keeps all key groups disjoint across
+documents — the packed kernel resolves each document exactly as its own
+subset launch would, bit for bit (asserted by tests/test_batched_merge).
+Rows stay ascending within each document, preserving the "max row = max
+Lamport" winner rule.
+
+Two entry points:
+
+* ``apply_cross_doc(work)`` — synchronous: stage every document's
+  drained batches (``DeviceDoc.stage_batches``), resolve them in shared
+  launches. The bench / CI driver.
+* ``CrossDocBatcher`` — the serving-layer collector: workers draining
+  different documents submit concurrently; the first submitter of a
+  generation becomes the flush leader, waits a tiny window
+  (``AUTOMERGE_TPU_BATCH_WINDOW_MS``) for co-arriving documents, then
+  packs and launches once for everyone (the group-commit pattern the
+  journal fsync combiner already uses). Submitters hold their document
+  lock while waiting, so per-doc single-writer discipline is preserved:
+  nothing else can touch a document between its host-side stage and the
+  scatter of its kernel results.
+
+Fallback: a document whose subset rows exceed
+``AUTOMERGE_TPU_BATCH_FALLBACK_RATIO`` (default 0.5, strict) of the
+combined batch is peeled off and resolved through the existing per-doc
+path — padding 99 small documents up to a whale's capacity bucket (and
+making them wait out its kernel) costs more than the launch it saves.
+Documents whose dirty fraction trips the per-doc full-re-resolution
+cost model never reach the packer (``stage_batches`` resolves them
+per-doc immediately, same as ``apply_changes`` would).
+
+Every packed launch counts ``device.kernel_launches{path=batched}``;
+the per-doc and sharded dispatch sites carry the same counter with
+their own ``path`` label, so "launches per drain cycle" is directly
+observable (and asserted by the ``serve_batched`` bench config).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import obs
+
+# the READ_FETCH surface a DeviceDoc subset scatter consumes
+_FETCH = (
+    "visible", "winner", "conflicts", "elem_index",
+    "obj_vis_len", "obj_text_width",
+)
+_PACK_COLS = (
+    "action", "insert", "prop", "elem_ref", "obj_dense", "value_tag",
+    "value_i32", "width", "covered", "pred_src", "pred_tgt",
+)
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class BatchStage:
+    """One document's staged host append awaiting kernel resolution:
+    the dirty-object subset (``rows`` are log row indices, ``dirty`` the
+    dense dirty-object ids) plus the document itself for the scatter."""
+
+    __slots__ = ("doc", "rows", "dirty", "error")
+
+    def __init__(self, doc, rows: np.ndarray, dirty: np.ndarray):
+        self.doc = doc
+        self.rows = rows
+        self.dirty = dirty
+        self.error: Optional[BaseException] = None
+
+    @property
+    def n_rows(self) -> int:
+        return len(self.rows)
+
+
+def plan_stages(
+    stages: Sequence[BatchStage], fallback_ratio: Optional[float] = None
+) -> Tuple[List[BatchStage], List[BatchStage]]:
+    """Split staged documents into (packed batch, per-doc fallbacks).
+
+    A document is peeled (largest first, totals recomputed after each
+    peel) while its subset rows STRICTLY exceed ``fallback_ratio`` of
+    the remaining batch total — the whale rule. Ratio >= 1 never peels
+    (a doc cannot exceed the total it is part of); ratio 0 peels
+    everything down to the smallest document.
+    """
+    if fallback_ratio is None:
+        fallback_ratio = _env_float("AUTOMERGE_TPU_BATCH_FALLBACK_RATIO", 0.5)
+    batch = sorted(stages, key=lambda s: s.n_rows)
+    whales: List[BatchStage] = []
+    total = sum(s.n_rows for s in batch)
+    while len(batch) > 1 and batch[-1].n_rows > fallback_ratio * total:
+        w = batch.pop()
+        total -= w.n_rows
+        whales.append(w)
+    return batch, whales
+
+
+def _pack(stages: Sequence[BatchStage]):
+    """Concatenate per-doc subset columns into one super-batch.
+
+    Row references (``elem_ref``/``pred_src``/``pred_tgt``) shift by the
+    document's row offset, dense object ids by its object offset;
+    negative sentinels (HEAD / map / missing) pass through untouched.
+    Returns (cols, metas, n_rows, n_objs) with metas =
+    [(stage, row_off, n_rows, obj_off, n_objs)].
+    """
+    parts = {k: [] for k in _PACK_COLS}
+    metas = []
+    row_off = 0
+    obj_off = 0
+    for st in stages:
+        sub = st.doc._subset_cols(st.rows, st.dirty)
+        er = sub["elem_ref"]
+        sub["elem_ref"] = np.where(er >= 0, er + row_off, er).astype(np.int32)
+        sub["obj_dense"] = (sub["obj_dense"] + obj_off).astype(np.int32)
+        sub["pred_src"] = (sub["pred_src"] + row_off).astype(np.int32)
+        pt = sub["pred_tgt"]
+        sub["pred_tgt"] = np.where(pt >= 0, pt + row_off, pt).astype(np.int32)
+        for k in parts:
+            parts[k].append(np.asarray(sub[k]))
+        S, D = len(st.rows), len(st.dirty)
+        metas.append((st, row_off, S, obj_off, D))
+        row_off += S
+        obj_off += D
+    cols = {k: np.concatenate(v) for k, v in parts.items()}
+    return cols, metas, row_off, obj_off
+
+
+def _launch_packed(cols, n_objs: int, n_props: int):
+    """One kernel launch over the padded super-batch; element order is
+    ranked host-side overlapped with the kernel, exactly like the
+    per-doc dispatch (DeviceDoc._dispatch_async)."""
+    import jax.numpy as jnp
+
+    from .merge import (
+        merge_kernel_core, scatter_geometry_ok, scatter_kernel_core,
+    )
+    from .oplog import host_linearize, pad_columns
+
+    cols = pad_columns(cols, n_objs)
+    P = len(cols["action"])
+    obs.count("device.kernel_launches", labels={"path": "batched"})
+    with obs.span("device.h2d", rows=P):
+        cols_dev = {k: jnp.asarray(v) for k, v in cols.items()}
+    fn = (
+        scatter_kernel_core(n_objs, n_props)
+        if scatter_geometry_ok(P, n_objs, n_props)
+        else merge_kernel_core
+    )
+    with obs.span("device.kernel", rows=P):
+        out = fn(cols_dev)  # async dispatch
+    ei = host_linearize(cols)
+    with obs.span("device.readback", rows=P):
+        res = {
+            k: np.asarray(out[k])
+            for k in ("visible", "winner", "conflicts",
+                      "obj_vis_len", "obj_text_width")
+        }
+    res["elem_index"] = ei
+    return res
+
+
+def _scatter(metas, res) -> None:
+    """Slice the packed results back per document and scatter them into
+    each DeviceDoc's resolution arrays (winner values return to
+    subset-local numbering — the contract of ``_scatter_subset``)."""
+    for st, r0, S, o0, D in metas:
+        w = res["winner"][r0 : r0 + S]
+        res_sub = {
+            "visible": res["visible"][r0 : r0 + S],
+            "winner": np.where(w >= 0, w - r0, -1).astype(np.int32),
+            "conflicts": res["conflicts"][r0 : r0 + S],
+            "elem_index": res["elem_index"][r0 : r0 + S],
+            "obj_vis_len": res["obj_vis_len"][o0 : o0 + D],
+            "obj_text_width": res["obj_text_width"][o0 : o0 + D],
+        }
+        st.doc._scatter_subset(st.rows, st.dirty, res_sub)
+
+
+def resolve_stages(
+    stages: Sequence[BatchStage], fallback_ratio: Optional[float] = None
+) -> dict:
+    """Resolve staged documents: whales per-doc, the rest in ONE packed
+    launch. Returns {"batched": n_docs, "fallback": n_docs}."""
+    batch, whales = plan_stages(stages, fallback_ratio)
+    for w in whales:
+        obs.count("device.batched_fallback")
+        w.doc._reresolve(w.dirty)
+    if batch:
+        with obs.span("device.batched", docs=len(batch)):
+            obs.observe("device.batch_docs", len(batch))
+            cols, metas, n_rows, n_objs = _pack(batch)
+            n_props = max(
+                (len(st.doc.log.props) for st in batch), default=1
+            )
+            res = _launch_packed(cols, n_objs, max(n_props, 1))
+            _scatter(metas, res)
+    return {"batched": len(batch), "fallback": len(whales)}
+
+
+def apply_cross_doc(
+    work,
+    *,
+    fallback_ratio: Optional[float] = None,
+    max_docs_per_launch: Optional[int] = None,
+) -> dict:
+    """Synchronous multi-document apply: ``work`` is an iterable of
+    ``(device_doc, batches)`` pairs (``batches`` = a sequence of change
+    batches, as ``apply_batches`` takes). Stages every document
+    host-side, then resolves the stages in shared packed launches of at
+    most ``max_docs_per_launch`` documents (None = all in one).
+
+    Returns {"applied": total changes, "batched": docs resolved in
+    packed launches, "fallback": docs resolved per-doc}.
+    """
+    # the same DeviceDoc may appear several times in ``work``; its
+    # batches must merge into ONE stage_batches call — a later append
+    # splices the log and would silently invalidate an earlier stage's
+    # row/object indices (apply_batches remaps its in-flight handle for
+    # exactly this; the stage path merges up front instead)
+    merged: dict = {}
+    order: List[int] = []
+    for dev, batches in work:
+        k = id(dev)
+        if k in merged:
+            merged[k][1].extend(batches)
+        else:
+            merged[k] = (dev, list(batches))
+            order.append(k)
+    applied = 0
+    stages: List[BatchStage] = []
+    for k in order:
+        dev, batches = merged[k]
+        n, st = dev.stage_batches(batches)
+        applied += n
+        if st is not None:
+            stages.append(st)
+    out = {"applied": applied, "batched": 0, "fallback": 0}
+    step = max_docs_per_launch or len(stages) or 1
+    for lo in range(0, len(stages), step):
+        r = resolve_stages(stages[lo : lo + step], fallback_ratio)
+        out["batched"] += r["batched"]
+        out["fallback"] += r["fallback"]
+    return out
+
+
+# -- the serving-layer collector ---------------------------------------------
+
+
+class _Generation:
+    __slots__ = ("stages", "done")
+
+    def __init__(self):
+        self.stages: List[BatchStage] = []
+        self.done = threading.Event()
+
+
+class CrossDocBatcher:
+    """Group-commit collector for concurrent per-document workers.
+
+    ``apply(dev, batches)`` stages the document's drained device feed
+    (the caller MUST hold that document's execution lock) and blocks
+    until a shared launch has resolved it. The first stager of a
+    generation is the leader: it waits up to ``window_ms`` for
+    co-arriving documents (waking early at ``max_docs``), closes the
+    generation, and runs ``resolve_stages`` for everyone.
+
+    ``mode``: "1" always batches, "0" never (callers fall back to
+    ``apply_batches``), "auto" batches only on accelerator backends —
+    on CPU the per-doc host delta-resolution path is faster than any
+    kernel, packed or not.
+    """
+
+    def __init__(
+        self,
+        *,
+        window_ms: Optional[float] = None,
+        max_docs: Optional[int] = None,
+        fallback_ratio: Optional[float] = None,
+        mode: Optional[str] = None,
+    ):
+        self.window = (
+            window_ms
+            if window_ms is not None
+            else _env_float("AUTOMERGE_TPU_BATCH_WINDOW_MS", 2.0)
+        ) / 1000.0
+        self.max_docs = int(
+            max_docs
+            if max_docs is not None
+            else _env_float("AUTOMERGE_TPU_BATCH_DOCS", 32)
+        )
+        self.fallback_ratio = fallback_ratio
+        self.mode = (
+            mode
+            if mode is not None
+            else os.environ.get("AUTOMERGE_TPU_SERVE_BATCHED", "auto")
+        )
+        self._cv = threading.Condition(threading.Lock())
+        self._gen = _Generation()
+        self._active: Optional[bool] = None
+
+    def active(self) -> bool:
+        """Whether device feeds should route through this batcher."""
+        if self._active is None:
+            if self.mode == "0":
+                self._active = False
+            elif self.mode == "auto":
+                plat = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip()
+                if plat:
+                    self._active = plat != "cpu"
+                else:
+                    import jax
+
+                    self._active = jax.default_backend() != "cpu"
+            else:
+                self._active = True
+        return self._active
+
+    def apply(self, dev, batches) -> int:
+        """Stage ``dev``'s drained batches and resolve them in the next
+        shared launch; blocks until resolved. Returns changes applied."""
+        if not self.active():
+            return dev.apply_batches(batches)
+        applied, stage = dev.stage_batches(batches)
+        if stage is None:
+            return applied
+        with self._cv:
+            gen = self._gen
+            gen.stages.append(stage)
+            leader = len(gen.stages) == 1
+            if not leader and len(gen.stages) >= self.max_docs:
+                self._cv.notify_all()  # wake the leader early
+        if leader:
+            deadline = time.monotonic() + self.window
+            with self._cv:
+                while len(gen.stages) < self.max_docs:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        break
+                    self._cv.wait(left)
+                if self._gen is gen:  # close the generation we lead
+                    self._gen = _Generation()
+            self._flush(gen)
+        else:
+            gen.done.wait()
+        if stage.error is not None:
+            raise stage.error
+        return applied
+
+    def _flush(self, gen: _Generation) -> None:
+        try:
+            resolve_stages(gen.stages, self.fallback_ratio)
+        except BaseException as e:  # noqa: BLE001 — degrade per doc
+            obs.count("device.batched_error")
+            for st in gen.stages:
+                try:
+                    st.doc._reresolve(st.dirty)
+                except BaseException as e2:  # noqa: BLE001
+                    st.error = e2
+            # the leader's own caller still sees the original failure if
+            # even its per-doc fallback could not recover
+            if gen.stages and gen.stages[0].error is None:
+                obs.event("device.batched_recovered", error=str(e)[:200])
+        finally:
+            gen.done.set()

@@ -43,6 +43,10 @@ from .oplog import (
 
 _MAKE_OBJ = {0: ObjType.MAP, 2: ObjType.LIST, 4: ObjType.TEXT, 6: ObjType.TABLE}
 
+# one jax Mesh per device count, shared across every DeviceDoc with mesh
+# residency enabled (see enable_mesh)
+_MESH_CACHE: Dict[int, object] = {}
+
 
 def order_elem_rows(log: "OpLog", elem_index: np.ndarray,
                     obj_rows: np.ndarray) -> np.ndarray:
@@ -70,6 +74,13 @@ class DeviceDoc:
         covered: Optional[np.ndarray] = None,
         base: Optional["DeviceDoc"] = None,
     ):
+        # whale-doc mesh residency (opt-in, see enable_mesh); views share
+        # the base's mesh state; AUTOMERGE_TPU_MESH_DEVICES is probed
+        # LAZILY on the first full re-resolution (never at construction:
+        # a many-doc server must not enumerate devices per open)
+        self._mesh = None if base is None else base._mesh
+        self._mesh_min_rows = 0 if base is None else base._mesh_min_rows
+        self._mesh_env_tried = False if base is None else base._mesh_env_tried
         self.log = log
         self.res = res
         n = log.n
@@ -153,6 +164,7 @@ class DeviceDoc:
 
     @classmethod
     def resolve(cls, log: OpLog) -> "DeviceDoc":
+        obs.count("device.kernel_launches", labels={"path": "per_doc"})
         return cls(
             log,
             merge_columns(
@@ -260,6 +272,48 @@ class DeviceDoc:
             self._collect_async(inflight)
         return total
 
+    def stage_batches(self, batches: Sequence[Sequence]):
+        """Host-side half of the cross-document batched apply
+        (ops/batched.py): dedup + causal-order + OpLog splice exactly as
+        ``apply_batches`` would over the same batches, but the dirty-set
+        kernel resolution is NOT dispatched — it is returned as a
+        ``BatchStage`` for the caller to pack into one shared multi-doc
+        launch.
+
+        Returns ``(applied, stage_or_None)``. ``None`` means resolution
+        already completed inside this call: the delta was empty/pure
+        bookkeeping, the log had to rebuild (empty/partial resident
+        history), or the dirty fraction tripped the per-doc full
+        re-resolution cost model — the same per-doc fallbacks
+        ``apply_changes`` takes, run eagerly so a returned stage is
+        always pack-eligible.
+        """
+        from .batched import BatchStage
+
+        if self._base is not self:
+            raise ValueError("stage_batches on a historical view; use the base doc")
+        ready = self._take_ready([ch for b in batches for ch in b])
+        if not ready:
+            return 0, None
+        with obs.span("device.apply", changes=len(ready)):
+            info = self.log.append_changes(ready) if self.log.n else None
+            if info is None:
+                obs.count("device.apply_rebuild")
+                self._rebuild(list(self.log.changes) + ready)
+                return len(ready), None
+            self._apply_append(info, ready)
+            if not info.n_new:
+                return len(ready), None
+            dirty = np.asarray(info.dirty_objs, np.int64)
+            rows = self._subset_rows(dirty)
+            if (
+                len(rows) / self.log.n > self._dirty_fraction_limit()
+                or len(dirty) >= self.log.n_objs
+            ):
+                self._reresolve(dirty)
+                return len(ready), None
+        return len(ready), BatchStage(self, rows, dirty)
+
     def pending_changes(self) -> int:
         """Changes buffered awaiting missing dependencies."""
         return len(self._pending)
@@ -293,13 +347,16 @@ class DeviceDoc:
     def _rebuild(self, changes: list) -> None:
         """Full fallback: re-extract and re-resolve everything in place."""
         pend = self._pending
+        mesh_state = (self._mesh, self._mesh_min_rows, self._mesh_env_tried)
         log = OpLog.from_changes(changes)
+        obs.count("device.kernel_launches", labels={"path": "per_doc"})
         res = merge_columns(
             log.columns(), fetch=self.READ_FETCH, n_objs=log.n_objs,
             n_props=len(log.props),
         )
         self.__init__(log, res)
         self._pending = pend
+        self._mesh, self._mesh_min_rows, self._mesh_env_tried = mesh_state
 
     def _apply_append(self, info, ready: Sequence) -> None:
         """Splice this view's resolution arrays and host caches through an
@@ -724,10 +781,13 @@ class DeviceDoc:
             obs.count("device.reresolve_full")
             obs.event("device.reresolve", mode="full", rows=m,
                         dirty_rows=len(rows), frac=round(frac, 4))
-            res = merge_columns(
-                log.columns(), fetch=self.READ_FETCH, n_objs=log.n_objs,
-                n_props=len(log.props),
-            )
+            res = self._mesh_resolve()
+            if res is None:
+                obs.count("device.kernel_launches", labels={"path": "per_doc"})
+                res = merge_columns(
+                    log.columns(), fetch=self.READ_FETCH, n_objs=log.n_objs,
+                    n_props=len(log.props),
+                )
             n = log.n
             vis = np.asarray(res["visible"])[:n]
             win = np.asarray(res["winner"])[:n]
@@ -747,6 +807,7 @@ class DeviceDoc:
         obs.event("device.reresolve", mode="subset", rows=m,
                     dirty_rows=len(rows), frac=round(frac, 4))
         cols = self._subset_cols(rows, dirty)
+        obs.count("device.kernel_launches", labels={"path": "per_doc"})
         res_sub = merge_columns(
             cols, fetch=self.READ_FETCH, n_objs=len(dirty),
             n_props=len(log.props),
@@ -790,6 +851,7 @@ class DeviceDoc:
             if scatter_geometry_ok(P, D, n_props)
             else merge_kernel_core
         )
+        obs.count("device.kernel_launches", labels={"path": "per_doc"})
         with obs.span("device.kernel", rows=P):
             out = fn(cols_dev)  # async dispatch
         # element order overlaps the kernel — it needs only the columns
@@ -812,6 +874,150 @@ class DeviceDoc:
                 "obj_text_width": np.asarray(out["obj_text_width"]),
             }
         self._scatter_subset(handle["rows"], handle["dirty"], res_sub)
+
+    # -- whale-doc mesh residency (parallel/sharding.py) ---------------------
+    #
+    # Opt-in: full-log re-resolutions of a document too big for one chip
+    # route through the sharded merge (every phase split over a
+    # jax.sharding.Mesh). The resident columns are handed over PERMUTED
+    # into object-id-range-contiguous layout (the incrementally-maintained
+    # ``_rows_by_obj`` order), so each device's row slice holds whole
+    # object key groups and the per-group winner recompute stays
+    # chip-local; the stable sort keeps rows ascending (= Lamport
+    # ascending) within every object, preserving the winner rule, and all
+    # row references are remapped through the permutation both ways.
+
+    def enable_mesh(
+        self, n_devices: Optional[int] = None, min_rows: Optional[int] = None
+    ) -> bool:
+        """Turn on mesh residency. Returns False — and stays on the
+        single-device path — when ``jax.shard_map`` or a multi-device
+        mesh is unavailable (the graceful degrade bench.py uses).
+        ``min_rows`` (env AUTOMERGE_TPU_MESH_MIN_ROWS, default 4096)
+        keeps small re-resolutions on one chip."""
+        import os
+
+        import jax
+
+        if self._base is not self:
+            raise ValueError("enable_mesh on a historical view; use the base doc")
+        if not hasattr(jax, "shard_map"):
+            obs.count("device.mesh_unavailable", labels={"reason": "no_shard_map"})
+            return False
+        try:
+            devs = jax.devices()
+        except Exception:
+            obs.count("device.mesh_unavailable", labels={"reason": "no_backend"})
+            return False
+        want = n_devices or len(devs)
+        if want < 2 or len(devs) < want:
+            obs.count("device.mesh_unavailable", labels={"reason": "single_device"})
+            return False
+        from ..parallel.sharding import default_mesh
+
+        # one Mesh per device count, shared by every DeviceDoc (a Mesh is
+        # just a device grid — rebuilding it per document is pure waste)
+        mesh = _MESH_CACHE.get(want)
+        if mesh is None:
+            mesh = _MESH_CACHE[want] = default_mesh(want, devices=devs[:want])
+        self._mesh = mesh
+        self._mesh_min_rows = int(
+            min_rows
+            if min_rows is not None
+            else os.environ.get("AUTOMERGE_TPU_MESH_MIN_ROWS", "4096")
+        )
+        return True
+
+    def disable_mesh(self) -> None:
+        self._mesh = None
+
+    def _mesh_resolve(self) -> Optional[Dict[str, np.ndarray]]:
+        """One sharded full-log resolution over the mesh, or None when
+        mesh residency is off / below threshold / degraded."""
+        if self._mesh is None:
+            if self._mesh_env_tried:
+                return None
+            self._mesh_env_tried = True
+            import os
+
+            nd = os.environ.get("AUTOMERGE_TPU_MESH_DEVICES")
+            if not nd:
+                return None
+            try:
+                if not self.enable_mesh(int(nd)):
+                    return None
+            except Exception:
+                return None
+        if self.log.n < self._mesh_min_rows:
+            return None
+        try:
+            return self._mesh_resolve_inner()
+        except Exception as e:  # noqa: BLE001 — degrade to single device
+            obs.count("device.mesh_unavailable", labels={"reason": "error"})
+            obs.event("device.mesh_error", error=str(e)[:200])
+            return None
+
+    def _mesh_resolve_inner(self) -> Dict[str, np.ndarray]:
+        from ..parallel.sharding import sharded_merge_columns
+        from .oplog import pad_columns
+
+        log = self.log
+        m = log.n
+        with obs.span("device.mesh_resolve", rows=m):
+            # object-range permutation: new position i holds old row
+            # perm[i]; _rows_by_obj is obj-sorted and row-ascending
+            # within each object (stable), exactly what we need
+            perm = np.asarray(self._rows_by_obj, np.int64)
+            inv = np.empty(m, np.int64)
+            inv[perm] = np.arange(m, dtype=np.int64)
+            cols = log.columns()
+            pc = {
+                k: np.asarray(cols[k])[perm]
+                for k in ("action", "insert", "prop", "obj_dense",
+                          "value_tag", "value_i32", "width", "covered")
+            }
+            er = np.asarray(cols["elem_ref"])[perm]
+            pc["elem_ref"] = np.where(
+                er >= 0, inv[np.clip(er, 0, m - 1)], er
+            ).astype(np.int32)
+            ps = np.asarray(cols["pred_src"])
+            pt = np.asarray(cols["pred_tgt"])
+            pc["pred_src"] = (
+                inv[ps].astype(np.int32) if len(ps) else ps
+            )
+            pc["pred_tgt"] = (
+                np.where(pt >= 0, inv[np.clip(pt, 0, m - 1)], pt).astype(np.int32)
+                if len(pt)
+                else pt
+            )
+            pc = pad_columns(pc, log.n_objs)
+            n_dev = self._mesh.devices.size
+            if len(pc["action"]) % n_dev:
+                obs.count("device.mesh_unavailable",
+                          labels={"reason": "shape"})
+                return None
+            out = sharded_merge_columns(
+                pc, mesh=self._mesh, n_objs=log.n_objs,
+                n_props=len(log.props),
+            )
+            # un-permute the per-row outputs; winner VALUES are permuted
+            # row ids and map back through perm itself
+            res: Dict[str, np.ndarray] = {}
+            for k in ("visible", "conflicts", "elem_index"):
+                a = np.asarray(out[k])[:m]
+                o = np.empty(m, a.dtype)
+                o[perm] = a
+                res[k] = o
+            w = np.asarray(out["winner"])[:m]
+            w_o = np.where(w >= 0, perm[np.clip(w, 0, m - 1)], -1)
+            wo = np.empty(m, np.int32)
+            wo[perm] = w_o.astype(np.int32)
+            res["winner"] = wo
+            res["obj_vis_len"] = np.asarray(out["obj_vis_len"])[: log.n_objs + 2]
+            res["obj_text_width"] = np.asarray(
+                out["obj_text_width"]
+            )[: log.n_objs + 2]
+            return res
 
     # -- historical views ---------------------------------------------------
 
@@ -853,6 +1059,7 @@ class DeviceDoc:
         view = base._views.get(key)
         if view is None:
             covered = base.log.covered_mask(base._clock_vec(heads))
+            obs.count("device.kernel_launches", labels={"path": "per_doc"})
             res = merge_columns(
                 base.log.padded_columns(covered=covered),
                 fetch=self.VIEW_FETCH,

@@ -44,6 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import obs
 from ..ops.merge import (
     NONE32,
     _ceil_log2,
@@ -555,7 +556,8 @@ def sharded_merge_columns(
     R2 = 0
     cond_np = None
     try:
-        R2, cond_np = condense_host(cols_np, n_objs2, n)
+        with obs.span("parallel.condense", rows=Ptot):
+            R2, cond_np = condense_host(cols_np, n_objs2, n)
     except _native.NativeUnavailable:
         pass
 
@@ -565,22 +567,30 @@ def sharded_merge_columns(
             for k, v in cond_np.items()
         }
 
+    obs.count("device.kernel_launches", labels={"path": "sharded"})
     if transport == "packed":
         static_key, arrays = encode_transport(cols_np)
         fn = _make_sharded_fn(
             mesh, Ptot, n_objs2, np_eff,
             (static_key, len(cols_np["pred_src"])), R2,
         )
-        arrs = {
-            k: jax.device_put(v, NamedSharding(mesh, P()))
-            for k, v in arrays.items()
-        }
-        out = fn(arrs, put_cond()) if R2 else fn(arrs)
+        with obs.span("parallel.h2d", rows=Ptot):
+            arrs = {
+                k: jax.device_put(v, NamedSharding(mesh, P()))
+                for k, v in arrays.items()
+            }
+            cond = put_cond() if R2 else None
+        with obs.span("parallel.kernel", rows=Ptot, devices=n):
+            out = fn(arrs, cond) if R2 else fn(arrs)
     else:
-        cols = {
-            k: jax.device_put(v, NamedSharding(mesh, COLUMN_SPECS[k]))
-            for k, v in cols_np.items()
-        }
+        with obs.span("parallel.h2d", rows=Ptot):
+            cols = {
+                k: jax.device_put(v, NamedSharding(mesh, COLUMN_SPECS[k]))
+                for k, v in cols_np.items()
+            }
+            cond = put_cond() if R2 else None
         fn = _make_sharded_fn(mesh, Ptot, n_objs2, np_eff, None, R2)
-        out = fn(cols, put_cond()) if R2 else fn(cols)
-    return {k: np.asarray(v) for k, v in out.items()}
+        with obs.span("parallel.kernel", rows=Ptot, devices=n):
+            out = fn(cols, cond) if R2 else fn(cols)
+    with obs.span("parallel.readback", rows=Ptot):
+        return {k: np.asarray(v) for k, v in out.items()}

@@ -54,6 +54,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from .. import obs
+from ..ops.batched import CrossDocBatcher
 from ..rpc import RpcServer
 from .shards import QueueFull, ShardPool
 
@@ -155,6 +156,21 @@ class SocketRpcServer:
             or _env_int("AUTOMERGE_TPU_SERVE_QUEUE_DEPTH", 128),
             max_batch=max_batch or _env_int("AUTOMERGE_TPU_SERVE_BATCH", 16),
             name="rpc-worker",
+        )
+        # cross-document device-merge batcher: workers draining DIFFERENT
+        # documents in the same drain cycle share ONE kernel launch for
+        # their coalesced device feeds (AUTOMERGE_TPU_SERVE_BATCHED=
+        # 1|0|auto; auto batches only on accelerator backends — on CPU the
+        # per-doc host delta resolution is the fast path). The early-wake
+        # threshold is capped at the POOL SIZE: at most `workers` docs can
+        # ever be draining at once, so a full complement of submitters
+        # wakes the flush leader immediately instead of every drain
+        # sleeping out the whole batch window
+        n_workers = len(self.pool.workers)
+        self.batcher = CrossDocBatcher(
+            max_docs=min(
+                _env_int("AUTOMERGE_TPU_BATCH_DOCS", 32), n_workers
+            )
         )
 
     # -- lifecycle -----------------------------------------------------------
@@ -420,7 +436,16 @@ class SocketRpcServer:
                 while i < len(items):
                     conn, req = items[i]
                     j = self._coalesce_end(items, i)
-                    if j > i:
+                    # with the cross-doc batcher active, even a LENGTH-1
+                    # receive run takes the coalesced path: its device
+                    # feed then joins whatever other documents are
+                    # draining right now in one shared kernel launch
+                    # (a drain of 100 docs x 1 frame each is the case
+                    # the batcher exists for)
+                    if j > i or (
+                        req.get("method") in _COALESCE_METHODS
+                        and self.batcher.active()
+                    ):
                         self._run_coalesced(items[i : j + 1], out)
                     else:
                         with contextlib.ExitStack() as st:
@@ -492,7 +517,8 @@ class SocketRpcServer:
         stay per-message (protocol state machines need each), but the
         resident-device feed drains into one ``apply_batches`` call."""
         method = run[0][1].get("method")
-        obs.count("rpc.coalesced", n=len(run), labels={"method": method})
+        if len(run) > 1:  # length-1 runs only ride the cross-doc batcher
+            obs.count("rpc.coalesced", n=len(run), labels={"method": method})
         with contextlib.ExitStack() as st:
             for lk in self._doc_locks(run[0][1]):
                 st.enter_context(lk)
@@ -520,9 +546,17 @@ class SocketRpcServer:
         if not live:
             return
         sess = live[0][2]
+        dev = sess.device_doc
+        feed = (
+            (lambda batches: self._feed_device(dev, batches))
+            if dev is not None
+            else None
+        )
         with obs.span("rpc.request",
                       labels={"method": "syncSessionReceive"}):
-            accepted = sess.receive_many(frames, time.monotonic())
+            accepted = sess.receive_many(
+                frames, time.monotonic(), device_feed=feed
+            )
         for (conn, req, _), ok in zip(live, accepted):
             out.append((conn, {"id": req.get("id"),
                                "result": {"accepted": ok}}))
@@ -555,6 +589,16 @@ class SocketRpcServer:
         dev = getattr(doc, "device_doc", None)
         if dev is not None and changes_batches:
             try:
-                dev.apply_batches(changes_batches)
+                self._feed_device(dev, changes_batches)
             except Exception as e:  # noqa: BLE001 — isolate the sidecar
                 obs.count("sync.device_feed_error", error=str(e)[:200])
+
+    def _feed_device(self, dev, batches) -> None:
+        """Route a drained document's device feed through the cross-doc
+        batcher (one shared kernel launch with whatever other documents
+        are draining right now) or, when batching is off for this
+        backend, through the per-doc pipelined path."""
+        if self.batcher.active():
+            self.batcher.apply(dev, batches)
+        else:
+            dev.apply_batches(batches)

@@ -251,7 +251,7 @@ class SyncSession:
         with obs.span("sync.receive", bytes=len(data)):
             return self._receive(data, now)
 
-    def receive_many(self, frames, now: float = 0.0) -> list:
+    def receive_many(self, frames, now: float = 0.0, device_feed=None) -> list:
         """Drain a run of pending wire frames in arrival order, coalescing
         the resident-device feed: instead of one ``DeviceDoc.apply_changes``
         per message, every message's changes collect into a single
@@ -259,10 +259,19 @@ class SyncSession:
         pipelines the kernel launches (h2d staging of batch k+1 overlaps
         batch k's kernel), amortizing per-launch cost across the run.
 
+        ``device_feed`` (a callable taking the collected batches)
+        replaces the direct ``apply_batches`` call — the serving layer
+        passes its cross-document batcher here so concurrently-draining
+        sessions share ONE kernel launch (ops/batched.py).
+
         Host-document semantics are identical to calling ``receive`` per
         frame; returns the per-frame accepted flags."""
         accepted = []
-        if self.device_doc is None or len(frames) <= 1:
+        # a single frame keeps the plain per-message path — unless an
+        # external device_feed is attached (the cross-doc batcher): then
+        # even one frame's changes defer so they can join other docs'
+        # concurrently-draining feeds in a shared launch
+        if self.device_doc is None or (len(frames) <= 1 and device_feed is None):
             for data in frames:
                 accepted.append(self.receive(data, now))
             return accepted
@@ -275,7 +284,10 @@ class SyncSession:
         if batches:
             obs.count("sync.coalesced_batches", n=len(batches))
             try:
-                self.device_doc.apply_batches(batches)
+                if device_feed is not None:
+                    device_feed(batches)
+                else:
+                    self.device_doc.apply_batches(batches)
             except Exception as e:  # noqa: BLE001 — isolate the sidecar
                 obs.count("sync.device_feed_error", error=str(e)[:200])
         return accepted

@@ -1010,6 +1010,133 @@ def main():
     results["serve"] = serve_cfg
     note(f"serve: {results['serve']}")
 
+    # ---- config: serve_batched (cross-document batched device merge) -------
+    # N resident documents drain one coalesced delta each per cycle — the
+    # multi-document work a ShardPool drain hands the device layer. Two
+    # modes through the SAME stage/pack/launch machinery (ops/batched.py):
+    # per_doc = one packed launch per document (max_docs_per_launch=1, the
+    # old dispatch discipline), batched = every document in ONE launch per
+    # drain cycle. Kernel launches are counted via the
+    # device.kernel_launches{path=batched} counter and asserted to drop
+    # from O(docs) to O(1) per cycle; both modes' final documents are
+    # checked identical. Each document carries an untouched "archive"
+    # ballast object so the drained deltas stay on the dirty-subset path
+    # (the serve-shaped workload: big resident history, edits concentrated
+    # in the live object).
+    sb_cfg = {}
+    try:
+        if env_flag("BENCH_SERVE_BATCHED", "1") != "0":
+            from automerge_tpu.ops.batched import apply_cross_doc
+
+            sb_docs = env_int("BENCH_SB_DOCS", 32)
+            sb_cycles = env_int("BENCH_SB_CYCLES", 8)
+            sb_ops = env_int("BENCH_SB_OPS", 40)
+            sb_ballast = env_int("BENCH_SB_BALLAST", 4000)
+
+            def sb_launches():
+                return obs.counter_values("device.kernel_launches", "path")
+
+            def sb_workload(tag):
+                """Per doc: (base changes, [delta per cycle]) — one
+                editing replica typing into the live object each cycle."""
+                wl = []
+                for i in range(sb_docs):
+                    base = AutoDoc(actor=ActorId(bytes([21]) * 16))
+                    live = base.put_object("_root", "live", ObjType.TEXT)
+                    base.splice_text(live, 0, 0, "live seed text ")
+                    arch = base.put_object("_root", "archive", ObjType.TEXT)
+                    base.splice_text(arch, 0, 0, "x" * sb_ballast)
+                    base.commit()
+                    chs = [a.stored for a in base.doc.history]
+                    ed = base.fork(actor=ActorId(
+                        bytes([31 + (tag & 1)]) + bytes([i % 250]) + bytes(14)))
+                    seen = {c.hash for c in chs}
+                    cycles = []
+                    for c in range(sb_cycles):
+                        ln = ed.length(live)
+                        for j in range(sb_ops):
+                            ed.splice_text(
+                                live, (i + c * sb_ops + j) % max(ln + j, 1),
+                                0, "ab"[j % 2],
+                            )
+                        ed.commit()
+                        delta = [
+                            a.stored for a in ed.doc.history
+                            if a.stored.hash not in seen
+                        ]
+                        seen.update(ch.hash for ch in delta)
+                        cycles.append(delta)
+                    wl.append((chs, cycles))
+                return wl
+
+            def sb_run(wl, max_per_launch):
+                devs = [
+                    DeviceDoc.resolve(OpLog.from_changes(chs))
+                    for chs, _ in wl
+                ]
+                l0 = sb_launches()
+                t0 = time.perf_counter()
+                for c in range(sb_cycles):
+                    apply_cross_doc(
+                        [(devs[i], [wl[i][1][c]]) for i in range(sb_docs)],
+                        max_docs_per_launch=max_per_launch,
+                    )
+                dt = time.perf_counter() - t0
+                l1 = sb_launches()
+                dl = {
+                    k: l1.get(k, 0) - l0.get(k, 0)
+                    for k in set(l0) | set(l1)
+                    if l1.get(k, 0) != l0.get(k, 0)
+                }
+                return devs, dt, dl
+
+            wl = sb_workload(0)
+            delta_ops = sum(
+                len(c.ops) for _, cycles in wl for b in cycles for c in b
+            )
+            # warm both mode shapes (jit compile per capacity bucket)
+            sb_run(sb_workload(1), 1)
+            sb_run(sb_workload(1), None)
+            t_per = t_bat = float("inf")
+            for _ in range(max(reps, 1)):
+                devs_p, dt_p, l_per = sb_run(wl, 1)
+                devs_b, dt_b, l_bat = sb_run(wl, None)
+                t_per = min(t_per, dt_p)
+                t_bat = min(t_bat, dt_b)
+            # both modes must materialize identical documents
+            for i in (0, sb_docs // 2, sb_docs - 1):
+                assert devs_p[i].hydrate() == devs_b[i].hydrate(), i
+            sb_cfg = {
+                "docs": sb_docs,
+                "cycles": sb_cycles,
+                "ops_per_delta": sb_ops,
+                "delta_ops_total": delta_ops,
+                "resident_ops": int(devs_b[0].log.n),
+                "per_doc_seconds": round(t_per, 4),
+                "per_doc_ops_per_sec": round(delta_ops / t_per, 1),
+                "per_doc_launches": l_per,
+                "batched_seconds": round(t_bat, 4),
+                "batched_ops_per_sec": round(delta_ops / t_bat, 1),
+                "batched_launches": l_bat,
+                "launches_per_drain_per_doc": round(
+                    l_per.get("batched", 0) / sb_cycles, 2
+                ),
+                "launches_per_drain_batched": round(
+                    l_bat.get("batched", 0) / sb_cycles, 2
+                ),
+                "uplift_vs_per_doc": round(t_per / t_bat, 2),
+            }
+            del devs_p, devs_b, wl
+    except Exception as e:  # noqa: BLE001 — degrade, record, continue
+        import traceback
+
+        tb = traceback.format_exc()
+        sb_cfg = {"serve_batched_error": repr(e)[:500]}
+        print(f"serve_batched config failed:\n{tb}", file=sys.stderr,
+              flush=True)
+    results["serve_batched"] = sb_cfg
+    note(f"serve_batched: {results['serve_batched']}")
+
     # ---- config: cluster (replicated serving + leader failover) ------------
     # Three node subprocesses (leader + 2 followers, quorum acks) behind
     # an in-process router. The workload commits through the router while
@@ -1181,6 +1308,12 @@ def main():
         # (trace.time spans: device.extract / h2d / kernel / readback /
         # materialize, merge.host)
         "trace_timings": T.timing_summary(),
+        # every kernel dispatch over the whole run, by dispatch path
+        # (per_doc / batched / sharded — the device.kernel_launches
+        # counter each dispatch site increments)
+        "kernel_launches": obs.counter_values(
+            "device.kernel_launches", "path"
+        ),
         # tail attribution: per-phase latency distributions from the span
         # histograms (log-bucketed; "what is p99 merge latency")
         "phase_percentiles": {
