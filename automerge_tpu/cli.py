@@ -1,5 +1,6 @@
 """Command-line interface: export / import / merge / examine / examine-sync
-/ change / journal-info / compact / metrics / serve.
+/ change / journal-info / compact / metrics / serve / cluster-router /
+cluster-metrics / flight-merge.
 
 Mirrors the reference CLI's subcommands (reference:
 rust/automerge-cli/src/main.rs:81-161). Documents read and write the
@@ -361,6 +362,81 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def cmd_cluster_metrics(args) -> int:
+    """Scrape a cluster router's ``clusterMetrics`` method: every node's
+    Prometheus exposition merged into one family set, each sample
+    labeled ``node="<addr>"`` (the router itself is ``node="router"``).
+    Unreachable nodes are reported on stderr, never fatal."""
+    import socket
+
+    host, _, port = args.router.rpartition(":")
+    try:
+        with socket.create_connection((host or "127.0.0.1", int(port)),
+                                      timeout=10) as sock:
+            sock.settimeout(30)
+            sock.sendall(b'{"id": 1, "method": "clusterMetrics"}\n')
+            raw = sock.makefile("r").readline()
+    except (OSError, ValueError) as e:
+        print(f"cluster-metrics: {args.router}: {e}", file=sys.stderr)
+        return 1
+    if not raw:
+        print("cluster-metrics: router closed the connection",
+              file=sys.stderr)
+        return 1
+    resp = json.loads(raw)
+    if "error" in resp:
+        print(f"cluster-metrics: {resp['error']}", file=sys.stderr)
+        return 1
+    result = resp["result"]
+    for bad in result.get("unreachable", ()):
+        print(f"cluster-metrics: unreachable {bad['node']}: {bad['error']}",
+              file=sys.stderr)
+    if args.format == "json":
+        _write(args.out, (json.dumps(result, indent=2) + "\n").encode())
+    else:
+        _write(args.out, result["body"].encode())
+    return 0
+
+
+def cmd_flight_merge(args) -> int:
+    """Stitch flight-recorder dumps (``flight-*.json``, written by
+    server processes on exit/failover) into one Perfetto/Chrome-trace
+    timeline: one pid per process, clocks aligned from RTT-midpoint
+    samples where available (wall clock otherwise), span parent/link ids
+    connecting one request's spans across every process it touched."""
+    import glob
+    import os
+
+    from .obs.flight import merge_flights
+
+    paths = []
+    for inp in args.input:
+        if os.path.isdir(inp):
+            paths.extend(sorted(glob.glob(os.path.join(inp, "flight-*.json"))))
+        else:
+            paths.append(inp)
+    if not paths:
+        print("flight-merge: no flight dumps found", file=sys.stderr)
+        return 1
+    try:
+        doc, info = merge_flights(paths)
+    except (OSError, ValueError, KeyError) as e:
+        print(f"flight-merge: {e}", file=sys.stderr)
+        return 1
+    _write(args.out, json.dumps(doc).encode())
+    print(
+        f"flight-merge: {info['spans']} spans from "
+        f"{len(info['processes'])} processes "
+        "(open at https://ui.perfetto.dev)",
+        file=sys.stderr,
+    )
+    for name, p in sorted(info["processes"].items()):
+        print(f"flight-merge:   pid {p['pid']}: {name} "
+              f"({p['spans']} spans, clock: {p['aligned']})",
+              file=sys.stderr)
+    return 0
+
+
 def cmd_serve(args) -> int:
     """Run the concurrent JSON-RPC server (serve/server.py) over TCP or
     a unix-domain socket — the same method surface as the stdio frontend
@@ -471,6 +547,21 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--workers", type=int, default=None,
                     help="worker pool size (default "
                          "AUTOMERGE_TPU_SERVE_WORKERS or 8)")
+
+    sp = add("cluster-metrics", cmd_cluster_metrics,
+             help="scrape a cluster router: every node's metrics merged "
+                  "into one family set with node labels")
+    sp.add_argument("router", metavar="HOST:PORT",
+                    help="router address to scrape")
+    sp.add_argument("--format", choices=("prometheus", "json"),
+                    default="prometheus")
+
+    sp = add("flight-merge", cmd_flight_merge,
+             help="merge flight-recorder dumps from several processes "
+                  "into one clock-aligned Perfetto timeline")
+    sp.add_argument("input", nargs="+",
+                    help="flight-*.json dump files (or directories "
+                         "holding them)")
 
     sp = sub.add_parser(
         "cluster-router",

@@ -138,6 +138,9 @@ class ClusterRpcServer(RpcServer):
             "nodeId": self.node_id,
             "role": self.cluster_role,
             "docs": docs,
+            # clock-sync sample for the router's heartbeat poll (same
+            # contract as replPing's "now")
+            "now": obs.now(),
         }
         if self.hub is not None:
             out["stream"] = self.hub.stream_id
@@ -162,8 +165,15 @@ class ClusterRpcServer(RpcServer):
                 f"leader sent prev={p['prev']} on {p['stream']}"
             )
         records = decode_batch(base64.b64decode(p["data"]))
-        applied = doc.apply_replicated(
-            records, base64.b64decode(p["cursor"]))
+        # the shipped batch covers many leader-side requests: link this
+        # follower's apply (and the journal fsync it nests) to each of
+        # their traces so flight-merge connects client -> leader ->
+        # follower on one timeline
+        with obs.span("repl.apply",
+                      links=obs.decode_wire_traces(p.get("traces")),
+                      records=len(records)):
+            applied = doc.apply_replicated(
+                records, base64.b64decode(p["cursor"]))
         obs.count("cluster.records_applied", n=len(records))
         return {"lsn": int(p["lsn"]), "applied": applied}
 
@@ -180,7 +190,11 @@ class ClusterRpcServer(RpcServer):
 
     def replPing(self, p):
         self.last_leader_contact = time.monotonic()
-        return {"nodeId": self.node_id, "role": self.cluster_role}
+        # "now" (this process's monotonic obs clock) turns every ping
+        # into a clock-sync sample: the pinger records the RTT midpoint
+        # and flight-merge aligns the two processes' span timelines
+        return {"nodeId": self.node_id, "role": self.cluster_role,
+                "now": obs.now()}
 
     def replHarvest(self, p):
         """Hand out this node's full state for one document — the
@@ -294,7 +308,7 @@ class ClusterRpcServer(RpcServer):
         then repeats migrateOut under the pause."""
         if self.hub is None:
             raise NotLeader("migration source must be a leader")
-        records, last = self.hub.tail_after(p["name"], int(p["since"]))
+        records, last, _traces = self.hub.tail_after(p["name"], int(p["since"]))
         return {
             "data": base64.b64encode(encode_batch(records)).decode("ascii"),
             "lsn": last,

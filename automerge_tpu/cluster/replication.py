@@ -211,12 +211,16 @@ class ReplicationHub:
 
     def _on_record(self, name: str, rec_type: int, payload: bytes,
                    seq: int) -> None:
+        # capture the appending request's trace context (None outside a
+        # propagated trace): the ship span and the follower's apply span
+        # link back to every request a shipped batch covers
+        ctx = obs.current_trace_context()
         with self._lock:
             st = self._streams.get(name)
             if st is None:
                 return
             st.lsn += 1
-            st.pending.append((st.lsn, seq, rec_type, payload))
+            st.pending.append((st.lsn, seq, rec_type, payload, ctx))
 
     def _drain_pending_locked(self, st: _DocStream) -> bool:
         """Promote pending records covered by the journal's durable
@@ -229,13 +233,13 @@ class ReplicationHub:
         covering = st.dd.journal.acked_seq
         moved = False
         while st.pending and st.pending[0][1] <= covering:
-            lsn, _seq, rec_type, payload = st.pending.popleft()
-            st.buffer.append((lsn, rec_type, payload))
+            lsn, _seq, rec_type, payload, ctx = st.pending.popleft()
+            st.buffer.append((lsn, rec_type, payload, ctx))
             st.buffer_bytes += len(payload) + 16
             st.synced_lsn = lsn
             moved = True
         while st.buffer and st.buffer_bytes > self.retain_bytes:
-            lsn, _rt, pl = st.buffer.popleft()
+            lsn, _rt, pl, _ctx = st.buffer.popleft()
             st.buffer_bytes -= len(pl) + 16
             st.base_lsn = lsn
         return moved
@@ -325,9 +329,12 @@ class ReplicationHub:
         obs.count("cluster.snapshots_shipped")
         return data, lsn
 
-    def tail_after(self, name: str, lsn: int) -> Tuple[List[Tuple[int, bytes]], int]:
-        """Retained records with LSN > ``lsn`` (bounded by batch_bytes),
-        or raise when the tail has been trimmed past that point."""
+    def tail_after(
+        self, name: str, lsn: int
+    ) -> Tuple[List[Tuple[int, bytes]], int, List[tuple]]:
+        """Retained records with LSN > ``lsn`` (bounded by batch_bytes)
+        plus the distinct trace contexts of the covered records, or raise
+        when the tail has been trimmed past that point."""
         with self._lock:
             st = self._streams.get(name)
             if st is None:
@@ -338,7 +345,8 @@ class ReplicationHub:
                     f"(base is {st.base_lsn}); snapshot required"
                 )
             out, total, last = [], 0, lsn
-            for rec_lsn, rec_type, payload in st.buffer:
+            traces: List[tuple] = []
+            for rec_lsn, rec_type, payload, ctx in st.buffer:
                 if rec_lsn <= lsn:
                     continue
                 if out and total + len(payload) > self.batch_bytes:
@@ -346,7 +354,9 @@ class ReplicationHub:
                 out.append((rec_type, payload))
                 total += len(payload)
                 last = rec_lsn
-            return out, last
+                if ctx is not None and ctx not in traces and len(traces) < 8:
+                    traces.append(ctx)
+            return out, last, traces
 
     # -- follower management -------------------------------------------------
 
@@ -442,11 +452,15 @@ class _FollowerLink:
         self._sock = sock
         return sock.makefile("r")
 
-    def _request(self, f, method: str, params: dict) -> dict:
+    def _request(self, f, method: str, params: dict, trace=None) -> dict:
         self._rid += 1
-        line = json.dumps(
-            {"id": self._rid, "method": method, "params": params}
-        ) + "\n"
+        req = {"id": self._rid, "method": method, "params": params}
+        if trace is not None:
+            # parent the follower's request handling into the (first)
+            # covered client trace; the full covered set rides in
+            # params["traces"] as span links
+            req["trace"] = {"t": trace[0], "s": trace[1]}
+        line = json.dumps(req) + "\n"
         self._sock.sendall(line.encode("utf-8"))
         raw = f.readline()
         if not raw:
@@ -526,8 +540,18 @@ class _FollowerLink:
                 continue
             if not self._wake.wait(timeout=self.hub.heartbeat):
                 if time.monotonic() - last_sent >= self.hub.heartbeat:
-                    self._request(f, "replPing",
-                                  {"stream": self.hub.stream_id})
+                    # the idle heartbeat doubles as a clock-sync probe:
+                    # the RTT midpoint around the follower's reported
+                    # monotonic "now" is what flight-merge uses to put
+                    # both processes' spans on one timeline
+                    t0 = obs.now()
+                    res = self._request(f, "replPing",
+                                        {"stream": self.hub.stream_id})
+                    t1 = obs.now()
+                    peer_now = res.get("now")
+                    if isinstance(peer_now, (int, float)):
+                        obs.flight.note_clock_sync(
+                            res.get("nodeId") or self.addr, t0, t1, peer_now)
                     last_sent = time.monotonic()
             self._wake.clear()
 
@@ -551,7 +575,7 @@ class _FollowerLink:
         when records went out (call again — there may be more)."""
         since = self._sent_lsn.get(name, 0)
         try:
-            records, last = self.hub.tail_after(name, since)
+            records, last, traces = self.hub.tail_after(name, since)
         except ReplicationError:
             self._needs_snapshot[name] = True
             self._ship_snapshot(f, name)
@@ -559,9 +583,10 @@ class _FollowerLink:
         if not records:
             return False
         cursor = encode_cursor(self.hub.stream_id, last)
-        with obs.span("cluster.ship_batch", records=len(records)):
+        with obs.span("cluster.ship_batch", links=traces,
+                      records=len(records)):
             try:
-                self._request(f, "replApply", {
+                params = {
                     "name": name,
                     "stream": self.hub.stream_id,
                     "prev": since,
@@ -569,7 +594,11 @@ class _FollowerLink:
                     "data": base64.b64encode(
                         encode_batch(records)).decode("ascii"),
                     "cursor": base64.b64encode(cursor).decode("ascii"),
-                })
+                }
+                if traces:
+                    params["traces"] = [[t, s] for t, s in traces]
+                self._request(f, "replApply", params,
+                              trace=traces[0] if traces else None)
             except ReplicationError as e:
                 if "ReplCursorMismatch" in str(e):
                     # the follower's journal disagrees with our

@@ -67,7 +67,8 @@ _FREES = {"free": "doc", "syncStateFree": "sync", "syncSessionFree": "session"}
 _HANDLE_PARAMS = ("doc", "other", "sync", "session")
 
 _ROUTER_METHODS = frozenset({
-    "metrics", "clusterInfo", "clusterMigrate", "clusterJoin", "shutdown"})
+    "metrics", "clusterMetrics", "clusterInfo", "clusterMigrate",
+    "clusterJoin", "shutdown"})
 
 
 class _VHandle:
@@ -121,7 +122,9 @@ class _DataConn:
                 raise OSError("node connection is dead")
             self.nid += 1
             nid = self.nid
-            self.pending[nid] = (conn, rid, ctx)
+            # the method rides along so the death sweep can label its
+            # Unavailable answers (cluster.unavailable{method})
+            self.pending[nid] = (conn, rid, ctx, req.get("method"))
         req["id"] = nid
         data = (json.dumps(req) + "\n").encode("utf-8")
         try:
@@ -346,8 +349,15 @@ class ClusterRouter:
                     self._route(cid, conn, req)
                 except _RouteError as e:
                     obs.count("router.errors", labels={"type": e.type})
-                    reply({"id": req.get("id"), "error": {
-                        "type": e.type, "message": str(e)}})
+                    if e.type == "Unavailable":
+                        # failover-window error volume, by method — the
+                        # measurable cost of an outage to clients
+                        obs.count("cluster.unavailable", labels={
+                            "method": str(req.get("method"))[:40]})
+                    err = {"type": e.type, "message": str(e)}
+                    if e.type == "Unavailable":
+                        err["retriable"] = True
+                    reply({"id": req.get("id"), "error": err})
                 except Exception as e:  # noqa: BLE001 — isolate clients
                     obs.count("router.errors",
                               labels={"type": type(e).__name__})
@@ -373,10 +383,26 @@ class ClusterRouter:
         if method in _ROUTER_METHODS:
             reply(self._local(method, req))
             return
+        # trace propagation: a client-supplied {"trace": {"t", "s"}}
+        # parents the router's span into the client's chain and is
+        # rewritten to name the ROUTER span as the node's parent — so
+        # the proxied hop appears between client and leader in the
+        # merged flight timeline. No trace field, no work.
+        tr = req.get("trace")
+        if isinstance(tr, dict):
+            with obs.trace_scope(tr.get("t"), tr.get("s")):
+                with obs.span("router.request",
+                              labels={"method": str(method)[:40]}) as sp:
+                    fwd = None
+                    tid = obs.current_trace.get()
+                    if tid is not None:
+                        fwd = {"t": tid, "s": sp.span_id}
+                    self._route_remote(cid, conn, req, trace=fwd)
+            return
         with obs.span("router.request", labels={"method": str(method)[:40]}):
             self._route_remote(cid, conn, req)
 
-    def _route_remote(self, cid: int, conn, req: dict) -> None:
+    def _route_remote(self, cid: int, conn, req: dict, trace=None) -> None:
         method = req.get("method")
         rid = req.get("id")
         params = dict(req.get("params") or {})
@@ -435,9 +461,11 @@ class ClusterRouter:
 
         # 6. ship on the leader's pooled connection
         try:
+            out = {"method": method, "params": params}
+            if trace is not None:
+                out["trace"] = trace
             dconn = self._data_conn(group.leader, affinity)
-            dconn.send(
-                {"method": method, "params": params}, conn, rid, ctx)
+            dconn.send(out, conn, rid, ctx)
         except _AlreadyAnswered:
             self._note_node_trouble(group, group.leader)
         except Exception as e:
@@ -519,7 +547,7 @@ class ClusterRouter:
                         entry = dconn.pending.pop(resp.get("id"), None)
                     if entry is None:
                         continue
-                    conn, rid, ctx = entry
+                    conn, rid, ctx, _method = entry
                     resp["id"] = rid
                     if ctx is not None and "error" not in resp:
                         self._apply_ctx(ctx, resp)
@@ -534,7 +562,9 @@ class ClusterRouter:
             with dconn.plock:
                 pending = list(dconn.pending.values())
                 dconn.pending.clear()
-            for conn, rid, _ctx in pending:
+            for conn, rid, _ctx, method in pending:
+                obs.count("cluster.unavailable",
+                          labels={"method": str(method)[:40]})
                 conn[2]({"id": rid, "error": {
                     "type": "Unavailable",
                     "message": f"node {dconn.addr} went away mid-request",
@@ -605,9 +635,18 @@ class ClusterRouter:
                     # can stall longer than a tight heartbeat, and a
                     # spurious promotion (while survivable — quorum acks
                     # keep it lossless) churns the group
+                    t0 = obs.now()
                     st = self._admin(
                         g.leader, "clusterStatus", {},
                         timeout=max(self.heartbeat * 2, 1.0))
+                    t1 = obs.now()
+                    # the liveness poll doubles as a clock-sync probe
+                    # (RTT midpoint), so flight-merge can chain router ->
+                    # leader -> follower onto one timeline
+                    peer_now = st.get("now")
+                    if isinstance(peer_now, (int, float)):
+                        obs.flight.note_clock_sync(
+                            st.get("nodeId") or g.leader, t0, t1, peer_now)
                     g.stream = st.get("stream") or g.stream
                     misses[g.idx] = 0
                     continue
@@ -685,6 +724,9 @@ class ClusterRouter:
             obs.count("cluster.failovers")
             obs.event("cluster.failover", group=group.idx, dead=dead,
                       promoted=winner, seconds=round(dt, 3))
+            # a failover IS a postmortem moment: snapshot the flight
+            # rings now (no-op unless a flight dir is installed)
+            obs.flight.dump(reason="failover")
         finally:
             group.failing = False
             if not group.up.is_set():
@@ -740,6 +782,8 @@ class ClusterRouter:
                 return {"id": rid, "result": {
                     "format": "prometheus",
                     "body": obs.render_prometheus()}}
+            if method == "clusterMetrics":
+                return {"id": rid, "result": self._cluster_metrics()}
             if method == "clusterInfo":
                 return {"id": rid, "result": {
                     "groups": [
@@ -761,6 +805,58 @@ class ClusterRouter:
         except Exception as e:  # noqa: BLE001 — answer, never die
             return {"id": rid, "error": {
                 "type": type(e).__name__, "message": str(e)}}
+
+    def _cluster_metrics(self) -> dict:
+        """Fan the ``metrics`` RPC out to every node (leaders AND
+        followers) and merge the expositions into one family set with a
+        ``node`` label per sample — the single scrape endpoint for the
+        whole cluster. The router's own registry joins as
+        ``node="router"``; unreachable nodes are reported, not fatal."""
+        from ..obs.metrics import merge_prometheus
+
+        bodies = {"router": obs.render_prometheus()}
+        unreachable = []
+        out_lock = threading.Lock()
+
+        def scrape(addr: str) -> None:
+            try:
+                res = self._admin(addr, "metrics", {}, timeout=5.0)
+                with out_lock:
+                    bodies[addr] = res.get("body") or ""
+            except Exception as e:  # noqa: BLE001 — scrape what's up
+                with out_lock:
+                    unreachable.append(
+                        {"node": addr, "error": str(e)[:200]})
+
+        # scrape nodes concurrently: one hung node costs the whole
+        # scrape its OWN timeout, not timeout x cluster size
+        threads = [
+            threading.Thread(target=scrape, args=(addr,), daemon=True)
+            for g in self._groups for addr in g.addrs
+        ]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 10.0
+        for t in threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        # snapshot under the lock: a straggler thread past the deadline
+        # must not mutate what merge_prometheus is iterating, and it
+        # reports as unreachable rather than vanishing
+        with out_lock:
+            bodies_snap = dict(bodies)
+            unreachable_snap = list(unreachable)
+        answered = set(bodies_snap) | {u["node"] for u in unreachable_snap}
+        for g in self._groups:
+            for addr in g.addrs:
+                if addr not in answered:
+                    unreachable_snap.append(
+                        {"node": addr, "error": "scrape deadline exceeded"})
+        return {
+            "format": "prometheus",
+            "body": merge_prometheus(bodies_snap),
+            "nodes": sorted(bodies_snap),
+            "unreachable": unreachable_snap,
+        }
 
     def _join(self, gidx: int, addr: str) -> dict:
         """Admit a (re)joined node into a group as a follower: future
@@ -902,7 +998,15 @@ def main(argv=None) -> int:
                          "(default AUTOMERGE_TPU_CLUSTER_HEARTBEAT or 1.0)")
     ap.add_argument("--miss-limit", type=int, default=3,
                     help="consecutive missed heartbeats before failover")
+    ap.add_argument("--flight-dir", metavar="DIR", default=None,
+                    help="dump the flight recorder to DIR on "
+                         "exit/failover (default AUTOMERGE_TPU_FLIGHT_DIR)")
     args = ap.parse_args(argv)
+    import os
+
+    flight_dir = args.flight_dir or os.environ.get("AUTOMERGE_TPU_FLIGHT_DIR")
+    if flight_dir:
+        obs.flight.install(flight_dir, node_id=f"router-{os.getpid()}")
     host, _, port = args.listen.rpartition(":")
     groups = [[a.strip() for a in g.split(",") if a.strip()]
               for g in args.group]

@@ -43,6 +43,7 @@ from .metrics import (  # noqa: F401 — re-exported API
     Gauge,
     Histogram,
     MetricsRegistry,
+    merge_prometheus,
     parse_prometheus,
     sanitize_metric_name,
 )
@@ -51,8 +52,12 @@ from .spans import (  # noqa: F401 — re-exported API
     SpanRecord,
     SpanRecorder,
     current_span,
+    current_trace,
+    new_trace_id,
     next_span_id,
+    now,
 )
+from .flight import FlightRecorder, merge_flights  # noqa: F401
 
 logger = logging.getLogger("automerge_tpu")
 
@@ -74,6 +79,11 @@ registry = MetricsRegistry()
 
 _SPAN_BUFFER = int(os.environ.get("AUTOMERGE_TPU_SPAN_BUFFER", "4096"))
 recorder = SpanRecorder(_SPAN_BUFFER)
+
+# the per-process flight recorder (obs/flight.py): bounded rings of
+# recent events + metric deltas around the span ring, dumped to disk on
+# exit/failover once a server entry point calls ``flight.install``
+flight = FlightRecorder(recorder, registry)
 
 # the legacy back-compat views (trace.counters / trace.timings alias these
 # exact dict objects): counters hold the label-aggregated totals; timings
@@ -105,7 +115,9 @@ def _fmt_field(v) -> str:
 
 def event(name: str, **fields) -> None:
     """One structured trace line: ``name k=v k=v`` (values quoted as
-    needed)."""
+    needed). Always lands in the flight recorder's bounded event ring;
+    the debug log line still requires ``AUTOMERGE_TPU_TRACE``."""
+    flight.note_event(name, fields)
     if logger.isEnabledFor(_DEBUG):
         body = " ".join(f"{k}={_fmt_field(v)}" for k, v in fields.items())
         logger.debug("%s %s", name, body)
@@ -140,16 +152,19 @@ def count(name: str, n: int = 1, labels: Optional[dict] = None, **fields) -> Non
         registry._get_locked(name, "counter", labels or {})._inc_locked(n)
         total = legacy_counters.get(name, 0) + n
         legacy_counters[name] = total
+    flight.note_delta("count", name, labels, n)
     if logger.isEnabledFor(_DEBUG):
         event(name, n=n, total=total, **(labels or {}), **fields)
 
 
 def gauge_set(name: str, value: float, labels: Optional[dict] = None) -> None:
     registry.gauge(name, **(labels or {})).set(value)
+    flight.note_delta("gauge", name, labels, value)
 
 
 def observe(name: str, value: float, labels: Optional[dict] = None) -> None:
     registry.histogram(name, **(labels or {})).observe(value)
+    flight.note_delta("observe", name, labels, value)
 
 
 def reset_counters() -> None:
@@ -200,15 +215,31 @@ class span:
     nests under the contextually-active span, accumulates into
     ``trace.timings`` and the ``name`` histogram, and records into the
     ring buffer for Perfetto export. Always on; cost is two clock reads,
-    one lock round-trip and a deque append."""
+    one lock round-trip and a deque append.
 
-    __slots__ = ("name", "labels", "fields", "t0", "_id", "_parent", "_token")
+    ``links`` is an optional list of ``(trace_id, span_id)`` pairs for
+    work this span covers without parenting it — a group-commit fsync
+    names every request whose records it made durable, a batched kernel
+    launch names every document's originating request. The active
+    cross-process trace id (``trace_scope``) is recorded automatically.
+    """
 
-    def __init__(self, name: str, labels: Optional[dict] = None, **fields):
+    __slots__ = ("name", "labels", "fields", "links", "t0",
+                 "_id", "_parent", "_token")
+
+    def __init__(self, name: str, labels: Optional[dict] = None,
+                 links=None, **fields):
         self.name = name
         self.labels = labels
         self.fields = fields
+        self.links = links
         self.t0 = 0.0
+
+    @property
+    def span_id(self) -> int:
+        """This span's id (valid once entered) — what a forwarded trace
+        context names as the remote parent."""
+        return self._id
 
     def __enter__(self):
         self._parent = current_span.get()
@@ -233,15 +264,93 @@ class span:
                 name, "histogram", self.labels or {}
             )._observe_locked(dur)
         if recorder.capacity > 0:
-            recorder.record(SpanRecord(
+            dropped = recorder.record(SpanRecord(
                 name, self._id, self._parent, self.t0 - _ORIGIN, dur,
                 threading.get_ident(), self.fields,
                 "error" if etype is not None else "ok",
+                current_trace.get(),
+                tuple(self.links) if self.links else None,
             ))
+            if dropped:
+                # the ring wrapping silently was invisible before: count
+                # it so a truncated flight dump advertises itself
+                with registry.lock:
+                    registry._get_locked(
+                        "obs.spans_dropped", "counter", {})._inc_locked(1)
         if logger.isEnabledFor(_DEBUG):
             event(name, ms=round(dur * 1e3, 3),
                   **(self.labels or {}), **self.fields)
         return False
+
+
+class trace_scope:
+    """Activate a cross-process trace context: ``with
+    obs.trace_scope(trace_id, parent_span_id):`` makes every span opened
+    inside record that trace id, with the (remote) parent span id as the
+    root of the local parent chain. Invalid or absent ids deactivate the
+    scope entirely — hostile wire input degrades to "no trace", never an
+    error — and with no scope active the cost a span pays is a single
+    contextvar read."""
+
+    __slots__ = ("trace_id", "parent", "_t_token", "_s_token")
+
+    def __init__(self, trace_id, parent_span_id=None):
+        self.trace_id = (
+            trace_id
+            if isinstance(trace_id, str) and 0 < len(trace_id) <= 128
+            else None
+        )
+        self.parent = (
+            parent_span_id
+            if isinstance(parent_span_id, int)
+            and not isinstance(parent_span_id, bool)
+            else None
+        )
+        self._t_token = None
+        self._s_token = None
+
+    def __enter__(self):
+        if self.trace_id is not None:
+            self._t_token = current_trace.set(self.trace_id)
+            if self.parent is not None:
+                self._s_token = current_span.set(self.parent)
+        return self
+
+    def __exit__(self, *exc):
+        if self._t_token is not None:
+            current_trace.reset(self._t_token)
+            self._t_token = None
+            if self._s_token is not None:
+                current_span.reset(self._s_token)
+                self._s_token = None
+        return False
+
+
+def current_trace_context() -> Optional[tuple]:
+    """``(trace_id, active_span_id)`` when a propagated trace is active,
+    else None — what gets captured into journal appends and batcher
+    stages so later group-commit/batched spans can link back."""
+    tid = current_trace.get()
+    if tid is None:
+        return None
+    return (tid, current_span.get())
+
+
+def decode_wire_traces(v, limit: int = 16) -> list:
+    """Sanitize a wire-supplied ``traces`` list (``[[trace_id,
+    span_id], ...]``) into span-link tuples; anything malformed is
+    silently dropped (hostile input must degrade, not raise)."""
+    out = []
+    if isinstance(v, (list, tuple)):
+        for e in v[:limit]:
+            if (
+                isinstance(e, (list, tuple)) and len(e) == 2
+                and isinstance(e[0], str) and 0 < len(e[0]) <= 128
+                and (e[1] is None
+                     or (isinstance(e[1], int) and not isinstance(e[1], bool)))
+            ):
+                out.append((e[0], e[1]))
+    return out
 
 
 def export_trace(path: str) -> int:

@@ -356,6 +356,23 @@ _SAMPLE_RE = re.compile(
 _LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
 
 
+def _parse_label_text(ltxt: Optional[str]) -> List[Tuple[str, str]]:
+    """``{k="v",...}`` (or None) -> [(k, v)] with escapes undone."""
+    labels: List[Tuple[str, str]] = []
+    if ltxt:
+        body = ltxt[1:-1]
+        pos = 0
+        while pos < len(body):
+            lm = _LABEL_RE.match(body, pos)
+            if lm is None:
+                raise ValueError(f"unparseable labels: {ltxt!r}")
+            labels.append((lm.group(1), _unescape_label_value(lm.group(2))))
+            pos = lm.end()
+            if pos < len(body) and body[pos] == ",":
+                pos += 1
+    return labels
+
+
 def parse_prometheus(text: str) -> Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float]:
     """Parse Prometheus text exposition back into
     ``{(name, sorted_label_items): value}`` — the round-trip half used by
@@ -369,18 +386,63 @@ def parse_prometheus(text: str) -> Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
         if m is None:
             raise ValueError(f"unparseable sample line: {line!r}")
         name, ltxt, vtxt = m.groups()
-        labels: List[Tuple[str, str]] = []
-        if ltxt:
-            body = ltxt[1:-1]
-            pos = 0
-            while pos < len(body):
-                lm = _LABEL_RE.match(body, pos)
-                if lm is None:
-                    raise ValueError(f"unparseable labels: {ltxt!r}")
-                labels.append((lm.group(1), _unescape_label_value(lm.group(2))))
-                pos = lm.end()
-                if pos < len(body) and body[pos] == ",":
-                    pos += 1
+        labels = _parse_label_text(ltxt)
         value = math.inf if vtxt == "+Inf" else float(vtxt)
         out[(name, tuple(sorted(labels)))] = value
     return out
+
+
+def merge_prometheus(bodies: Dict[str, str], label: str = "node") -> str:
+    """Merge per-node Prometheus expositions into ONE family set.
+
+    ``bodies`` maps a node name to that node's ``render_prometheus()``
+    text. Every sample gains a ``node="<name>"`` label (hostile node
+    names are escaped exactly like any label value; a pre-existing label
+    of the same name is replaced — the scraper's identity wins), family
+    ``# TYPE``/``# HELP`` lines are unioned (first declaration wins),
+    and histogram ``_bucket``/``_sum``/``_count`` series stay grouped
+    under their family. Sample values pass through verbatim, so the
+    merge is lossless and re-parses with ``parse_prometheus``."""
+    types: Dict[str, str] = {}
+    helps: Dict[str, str] = {}
+    samples: Dict[str, List[str]] = {}
+    for node in sorted(bodies):
+        for line in bodies[node].splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            if line.startswith("# TYPE ") or line.startswith("# HELP "):
+                parts = line.split(None, 3)
+                if len(parts) >= 4:
+                    target = types if parts[1] == "TYPE" else helps
+                    target.setdefault(parts[2], parts[3])
+                continue
+            if line.startswith("#"):
+                continue
+            m = _SAMPLE_RE.match(line)
+            if m is None:
+                raise ValueError(f"unparseable sample line: {line!r}")
+            name, ltxt, vtxt = m.groups()
+            labels = [
+                (k, v) for k, v in _parse_label_text(ltxt) if k != label
+            ] + [(label, str(node))]
+            ltxt_out = _format_labels(tuple(sorted(labels)))
+            samples.setdefault(name, []).append(f"{name}{ltxt_out} {vtxt}")
+
+    lines: List[str] = []
+    emitted = set()
+    for fam in sorted(types):
+        if fam in helps:
+            lines.append(f"# HELP {fam} {helps[fam]}")
+        lines.append(f"# TYPE {fam} {types[fam]}")
+        # counters/gauges sample under the family name itself; histogram
+        # families fan out into the three conventional series
+        for sname in (fam, fam + "_bucket", fam + "_sum", fam + "_count"):
+            for s in samples.get(sname, ()):
+                lines.append(s)
+            emitted.add(sname)
+    # samples whose body carried no TYPE line still merge (sorted tail)
+    for sname in sorted(samples):
+        if sname not in emitted:
+            lines.extend(samples[sname])
+    return "\n".join(lines) + ("\n" if lines else "")

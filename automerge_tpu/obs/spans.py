@@ -21,7 +21,9 @@ import contextvars
 import itertools
 import json
 import os
+import random
 import threading
+import uuid
 from collections import deque
 from time import perf_counter
 from typing import List, Optional
@@ -30,9 +32,18 @@ from typing import List, Optional
 # exported trace starts near ts=0 regardless of perf_counter's epoch
 _ORIGIN = perf_counter()
 
-_ids = itertools.count(1)
+# span ids start from a process-random base (high bits random, low bits a
+# plain counter): parent/link references must stay unambiguous when flight
+# dumps from SEVERAL processes are stitched into one timeline, and a
+# counter starting at 1 would collide in every process
+_ids = itertools.count((random.getrandbits(62) & ~0xFFFFFFFF) | 1)
 current_span: contextvars.ContextVar[Optional[int]] = contextvars.ContextVar(
     "automerge_tpu_span", default=None
+)
+# the active cross-process trace id (None outside any propagated trace —
+# the pay-for-what-you-use default: one contextvar read per span exit)
+current_trace: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
+    "automerge_tpu_trace", default=None
 )
 
 
@@ -40,12 +51,17 @@ def next_span_id() -> int:
     return next(_ids)
 
 
+def new_trace_id() -> str:
+    """A fresh 16-hex-char trace id for a request entering the system."""
+    return uuid.uuid4().hex[:16]
+
+
 class SpanRecord:
     __slots__ = ("name", "span_id", "parent_id", "start", "duration",
-                 "thread_id", "fields", "status")
+                 "thread_id", "fields", "status", "trace_id", "links")
 
     def __init__(self, name, span_id, parent_id, start, duration,
-                 thread_id, fields, status):
+                 thread_id, fields, status, trace_id=None, links=None):
         self.name = name
         self.span_id = span_id
         self.parent_id = parent_id
@@ -54,12 +70,20 @@ class SpanRecord:
         self.thread_id = thread_id
         self.fields = fields
         self.status = status        # "ok" | "error"
+        self.trace_id = trace_id    # cross-process trace id, or None
+        # links: ((trace_id, span_id), ...) — spans this one covers
+        # without parenting them (group commit, batched launches)
+        self.links = links
 
     def to_chrome_event(self, pid: int) -> dict:
         args = {str(k): _arg(v) for k, v in self.fields.items()}
         args["span_id"] = self.span_id
         if self.parent_id is not None:
             args["parent_id"] = self.parent_id
+        if self.trace_id is not None:
+            args["trace_id"] = self.trace_id
+        if self.links:
+            args["links"] = [list(l) for l in self.links]
         if self.status != "ok":
             args["status"] = self.status
         return {
@@ -72,6 +96,24 @@ class SpanRecord:
             "tid": self.thread_id,
             "args": args,
         }
+
+    def to_dict(self) -> dict:
+        """JSON form for flight-recorder dumps (obs/flight.py)."""
+        d = {
+            "name": self.name,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start": self.start,
+            "duration": self.duration,
+            "thread_id": self.thread_id,
+            "fields": {str(k): _arg(v) for k, v in self.fields.items()},
+            "status": self.status,
+        }
+        if self.trace_id is not None:
+            d["trace_id"] = self.trace_id
+        if self.links:
+            d["links"] = [list(l) for l in self.links]
+        return d
 
 
 def _arg(v):
@@ -88,11 +130,15 @@ class SpanRecorder:
         self._lock = threading.Lock()
         self._buf: deque = deque(maxlen=max(capacity, 0))
 
-    def record(self, rec: SpanRecord) -> None:
+    def record(self, rec: SpanRecord) -> bool:
+        """Append; returns True when the ring was full and an old span
+        was silently evicted (the caller counts ``obs.spans_dropped``)."""
         if self.capacity <= 0:
-            return
+            return False
         with self._lock:
+            evicted = len(self._buf) == self._buf.maxlen
             self._buf.append(rec)
+        return evicted
 
     def snapshot(self) -> List[SpanRecord]:
         with self._lock:

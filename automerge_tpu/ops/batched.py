@@ -84,13 +84,16 @@ class BatchStage:
     the dirty-object subset (``rows`` are log row indices, ``dirty`` the
     dense dirty-object ids) plus the document itself for the scatter."""
 
-    __slots__ = ("doc", "rows", "dirty", "error")
+    __slots__ = ("doc", "rows", "dirty", "error", "trace")
 
     def __init__(self, doc, rows: np.ndarray, dirty: np.ndarray):
         self.doc = doc
         self.rows = rows
         self.dirty = dirty
         self.error: Optional[BaseException] = None
+        # the submitting request's trace context (trace_id, span_id), so
+        # the shared launch span can link back to every request it served
+        self.trace = None
 
     @property
     def n_rows(self) -> int:
@@ -212,7 +215,8 @@ def resolve_stages(
         obs.count("device.batched_fallback")
         w.doc._reresolve(w.dirty)
     if batch:
-        with obs.span("device.batched", docs=len(batch)):
+        links = [st.trace for st in batch if st.trace is not None]
+        with obs.span("device.batched", links=links, docs=len(batch)):
             obs.observe("device.batch_docs", len(batch))
             cols, metas, n_rows, n_objs = _pack(batch)
             n_props = max(
@@ -349,6 +353,9 @@ class CrossDocBatcher:
         applied, stage = dev.stage_batches(batches)
         if stage is None:
             return applied
+        # attribute the (possibly other-thread) shared launch back to
+        # this submitter's propagated trace, if one is active
+        stage.trace = obs.current_trace_context()
         with self._cv:
             gen = self._gen
             gen.stages.append(stage)

@@ -213,6 +213,7 @@ class DeviceDoc:
             self._apply_append(info, ready)
             if info.n_new and not self._delta_resolve(info):
                 self._reresolve(info.dirty_objs)
+        self._export_doc_gauges()
         return len(ready)
 
     def apply_batches(self, batches: Sequence[Sequence]) -> int:
@@ -270,6 +271,7 @@ class DeviceDoc:
             total += len(ready)
         if inflight is not None:
             self._collect_async(inflight)
+        self._export_doc_gauges()
         return total
 
     def stage_batches(self, batches: Sequence[Sequence]):
@@ -311,7 +313,9 @@ class DeviceDoc:
                 or len(dirty) >= self.log.n_objs
             ):
                 self._reresolve(dirty)
+                self._export_doc_gauges()
                 return len(ready), None
+        self._export_doc_gauges()
         return len(ready), BatchStage(self, rows, dirty)
 
     def pending_changes(self) -> int:
@@ -357,6 +361,23 @@ class DeviceDoc:
         self.__init__(log, res)
         self._pending = pend
         self._mesh, self._mesh_min_rows, self._mesh_env_tried = mesh_state
+        self._export_doc_gauges()
+
+    # per-doc accounting label (doc.resident_ops / doc.device_bytes):
+    # set by the durable layer when this resident doc serves a named
+    # document; None (the default) keeps the export path a no-op
+    obs_name = None
+
+    def _export_doc_gauges(self) -> None:
+        if self.obs_name is None:
+            return
+        labels = {"doc": self.obs_name}
+        obs.gauge_set("doc.resident_ops", self.log.n, labels=labels)
+        obs.gauge_set(
+            "doc.device_bytes",
+            sum(a.nbytes for a in self.res.values()),
+            labels=labels,
+        )
 
     def _apply_append(self, info, ready: Sequence) -> None:
         """Splice this view's resolution arrays and host caches through an

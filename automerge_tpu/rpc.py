@@ -724,6 +724,18 @@ class RpcServer:
                       labels={"method": "unknown", "type": "UnknownMethod"})
             return {"id": rid, "error": {"type": "UnknownMethod",
                                          "message": str(method)}}
+        # optional cross-process trace context: {"trace": {"t": <trace
+        # id>, "s": <parent span id>}} on the request parents this
+        # process's spans into the caller's chain (router -> node, client
+        # -> anything). Absent (the common case) this is one dict lookup;
+        # malformed values deactivate the scope instead of erroring.
+        tr = req.get("trace")
+        if isinstance(tr, dict):
+            with obs.trace_scope(tr.get("t"), tr.get("s")):
+                return self._dispatch(rid, method, req)
+        return self._dispatch(rid, method, req)
+
+    def _dispatch(self, rid, method: str, req: dict) -> dict:
         # the span doubles as the per-method request counter (histogram
         # count) and latency distribution (rpc.request{method=...})
         with obs.span("rpc.request", labels={"method": method}):
@@ -921,7 +933,18 @@ def main(argv=None) -> int:
              "followers hold the write durably (default "
              "AUTOMERGE_TPU_CLUSTER_ACK_REPLICAS or 0)",
     )
+    ap.add_argument(
+        "--flight-dir", metavar="DIR", default=None,
+        help="dump the flight recorder (recent spans/events/metric "
+             "deltas) to DIR on exit/crash (default "
+             "AUTOMERGE_TPU_FLIGHT_DIR; merge dumps with "
+             "`python -m automerge_tpu flight-merge`)",
+    )
     args = ap.parse_args(argv)
+    flight_dir = args.flight_dir or os.environ.get("AUTOMERGE_TPU_FLIGHT_DIR")
+    if flight_dir:
+        obs.flight.install(
+            flight_dir, node_id=args.node_id or f"rpc-{os.getpid()}")
     if args.durable:
         os.makedirs(args.durable, exist_ok=True)
     if args.socket or args.unix:

@@ -72,6 +72,20 @@ def _env_int(name: str, default: int) -> int:
         return default
 
 
+def _run_trace_links(run) -> list:
+    """Span links for a coalesced run: the wire trace context of every
+    request the shared span covers (a coalesced receive executes many
+    clients' frames under ONE span — links keep each attributable).
+    Sanitization (and the 16-entry cap) is obs.decode_wire_traces' —
+    the one place the wire trace-pair contract lives."""
+    pairs = [
+        [tr.get("t"), tr.get("s")]
+        for _conn, req in run
+        if isinstance(tr := req.get("trace"), dict)
+    ]
+    return obs.decode_wire_traces(pairs)
+
+
 class _Conn:
     """One client connection: socket + serialized writes."""
 
@@ -221,9 +235,13 @@ class SocketRpcServer:
         finally:
             self.stop()
             # a shutdown REQUEST acks after the flush; the process must
-            # not exit from under that in-flight response
+            # not exit from under that in-flight response. A SECOND
+            # concurrent shutdown's thread may still be registered but
+            # unstarted at this instant — joining that raises, and its
+            # conn dies with the process anyway
             for t in self._ack_threads:
-                t.join(timeout=10)
+                with contextlib.suppress(RuntimeError):
+                    t.join(timeout=10)
 
     def stop(self) -> None:
         """Stop accepting, drain the pool, flush durable docs, close.
@@ -322,10 +340,13 @@ class SocketRpcServer:
                     # drain in-flight work and flush durable docs BEFORE
                     # answering: when the response lands, the journals'
                     # flocks are released and the server is reusable.
-                    # Claim the socket and register the ack thread BEFORE
-                    # raising the shutdown flag — the moment it is set, a
-                    # racing stop() sweeps _conns closed and serve_forever
-                    # starts joining _ack_threads
+                    # Claim the socket, register AND START the ack thread
+                    # BEFORE raising the shutdown flag — the moment it is
+                    # set, a racing stop() sweeps _conns closed and
+                    # serve_forever starts joining _ack_threads (joining
+                    # a registered-but-unstarted thread raises). The
+                    # thread's own stop() call sets the flag anyway; the
+                    # explicit set below just makes wake-up prompt.
                     with self._conns_lock:
                         self._conns.pop(cid, None)
                     handoff = True
@@ -335,8 +356,8 @@ class SocketRpcServer:
                         name="rpc-shutdown", daemon=True,
                     )
                     self._ack_threads.append(t)
-                    self._shutdown.set()
                     t.start()
+                    self._shutdown.set()
                     return
                 self._route(conn, req)
         finally:
@@ -552,7 +573,7 @@ class SocketRpcServer:
             if dev is not None
             else None
         )
-        with obs.span("rpc.request",
+        with obs.span("rpc.request", links=_run_trace_links(run),
                       labels={"method": "syncSessionReceive"}):
             accepted = sess.receive_many(
                 frames, time.monotonic(), device_feed=feed
@@ -569,7 +590,7 @@ class SocketRpcServer:
 
         doc = None
         changes_batches = []
-        with obs.span("rpc.request",
+        with obs.span("rpc.request", links=_run_trace_links(run),
                       labels={"method": "receiveSyncMessage"}):
             for conn, req in run:
                 p = req.get("params") or {}

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import threading
 from collections import deque
+from time import monotonic as _monotonic
 from typing import Callable, Dict, Hashable, List, Optional
 
 from .. import obs
@@ -41,11 +42,12 @@ class QueueFull(Exception):
 
 
 class _DocQueue:
-    __slots__ = ("items", "scheduled")
+    __slots__ = ("items", "scheduled", "first_ts")
 
     def __init__(self):
         self.items: deque = deque()
         self.scheduled = False  # a worker owns (or is queued to own) this doc
+        self.first_ts = 0.0  # enqueue time of the oldest queued item
 
 
 class ShardPool:
@@ -97,6 +99,8 @@ class ShardPool:
                     f"queue for doc {key!r} is full "
                     f"({self.max_queue} pending requests)"
                 )
+            if not q.items:
+                q.first_ts = _monotonic()
             q.items.append(item)
             if not q.scheduled:
                 q.scheduled = True
@@ -123,12 +127,18 @@ class ShardPool:
                 batch = []
                 while q.items and len(batch) < self.max_batch:
                     batch.append(q.items.popleft())
+                waited = _monotonic() - q.first_ts if batch else 0.0
+                q.first_ts = _monotonic()  # the remainder starts waiting now
                 self._busy += 1
                 busy = self._busy
                 depth = len(q.items)
             # gauges are sampled at drain boundaries, not per enqueue: a
             # gauge is a level, and per-request registry-lock traffic from
             # every submitter measurably throttles the pool
+            if batch:
+                # dequeue latency: how long the oldest request of this
+                # drain sat queued before a worker picked the doc up
+                obs.observe("serve.queue_wait", waited)
             obs.gauge_set("rpc.queue_depth", depth, labels={"doc": str(key)})
             obs.gauge_set("rpc.pool_busy", busy)
             obs.gauge_set("rpc.pool_utilization", busy / n_workers)

@@ -92,6 +92,9 @@ class DurableDocument:
         self._host = host  # the wrapped Document or AutoDoc
         self._core = core  # the underlying core Document
         self.path = path
+        # the per-doc gauge label (doc.journal_bytes{doc=...} etc.); the
+        # registry's cardinality cap bounds a many-doc server's series
+        self.obs_name = posixpath.basename(path.rstrip("/")) or path
         self._fs = fs
         self._journal = journal
         self.compact_max_records = compact_max_records
@@ -254,8 +257,14 @@ class DurableDocument:
         )
         dd._meta = meta
         dd.device_doc = dev
+        if dev is not None:
+            # the resident mirror exports doc.resident_ops /
+            # doc.device_bytes under the same per-doc label
+            dev.obs_name = dd.obs_name
+            dev._export_doc_gauges()
         dd._last_snapshot_bytes = snap_bytes
         core.change_listeners.append(dd._on_change)
+        dd._export_doc_gauges()
         return dd
 
     # -- delegation ----------------------------------------------------------
@@ -313,6 +322,18 @@ class DurableDocument:
                     self._journal.sync()
                     self.replication_gate()
                 self.maybe_compact()
+                self._export_doc_gauges()
+
+    def _export_doc_gauges(self) -> None:
+        """Per-doc accounting at the ack boundary: journal footprint and
+        a last-access stamp (seconds on the obs monotonic clock — age =
+        ``obs.now() - value``). These are the residency-admission signals
+        the tiered-store roadmap item consumes; the device layer exports
+        ``doc.resident_ops`` / ``doc.device_bytes`` alongside."""
+        labels = {"doc": self.obs_name}
+        obs.gauge_set("doc.journal_bytes", self._journal.size_bytes,
+                      labels=labels)
+        obs.gauge_set("doc.last_access_seconds", obs.now(), labels=labels)
 
     def __enter__(self):
         return self
@@ -516,6 +537,10 @@ class DurableDocument:
                 # the snapshot carries the FULL in-memory history, so disk
                 # is caught up even if a journal append had failed earlier
                 self._broken = False
+                # a background compaction shrinks the journal outside any
+                # ack scope: refresh the footprint gauge here too
+                obs.gauge_set("doc.journal_bytes", self._journal.size_bytes,
+                              labels={"doc": self.obs_name})
                 return True
             finally:
                 self._compacting = False

@@ -279,6 +279,10 @@ class Journal:
         self._f = f
         self._size = size
         self._count = count
+        # chaos-soak invariant as a scrapeable level: one open journal ==
+        # one held flock; close() (and the append-poison path) decrement,
+        # so a nonzero residue after shutdown means a stranded lock
+        obs.registry.gauge("serve.flocks_held").add(1)
         # group-commit state: appends bump _append_seq; _synced_seq is the
         # durable prefix. Both only move under _cond's lock, which also
         # serializes the file writes themselves (interleaved buffered
@@ -297,6 +301,10 @@ class Journal:
         # catch-up.
         self.on_record = None  # callable(rec_type, payload, append_seq)
         self.on_synced = None  # callable(covering_append_seq)
+        # trace contexts of appends not yet covered by an fsync (bounded):
+        # the group-commit leader attaches them as span links, so one
+        # combined fsync is attributable to every request it covered
+        self._pending_traces: List[tuple] = []
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -402,6 +410,7 @@ class Journal:
                 if self._f is not None:
                     self._f.close()
                     self._f = None
+                    obs.registry.gauge("serve.flocks_held").add(-1)
                     self._cond.notify_all()
 
     @property
@@ -457,11 +466,15 @@ class Journal:
                     except Exception:
                         self._f.close()
                         self._f = None  # closed journal: appends raise
+                        obs.registry.gauge("serve.flocks_held").add(-1)
                         self._cond.notify_all()  # wake fsync waiters
                     raise
                 self._size += len(rec)
                 self._count += 1
                 self._append_seq += 1
+                ctx = obs.current_trace_context()
+                if ctx is not None and len(self._pending_traces) < 16:
+                    self._pending_traces.append(ctx)
                 if self.on_record is not None:
                     try:
                         self.on_record(rec_type, payload, self._append_seq)
@@ -516,8 +529,9 @@ class Journal:
             self._fsync_leader = True
             covering = self._append_seq
             f = self._f
+            links, self._pending_traces = self._pending_traces, []
         try:
-            with obs.span("journal.fsync",
+            with obs.span("journal.fsync", links=links,
                           labels={"policy": self.fsync_policy}):
                 self.fs.fsync(f)
         except Exception:

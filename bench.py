@@ -1314,6 +1314,14 @@ def main():
         "kernel_launches": obs.counter_values(
             "device.kernel_launches", "path"
         ),
+        # span-ring health: how much of the run the flight recorder /
+        # Perfetto export can still see (dropped > 0 means the ring
+        # wrapped and the phase trace is a suffix, not the whole run)
+        "span_buffer": {
+            "recorded": len(obs.recorder),
+            "dropped": obs.counter_values(
+                "obs.spans_dropped", "").get("", 0),
+        },
         # tail attribution: per-phase latency distributions from the span
         # histograms (log-bucketed; "what is p99 merge latency")
         "phase_percentiles": {

@@ -230,6 +230,74 @@ def test_ack_gate_times_out_without_followers(tmp_path, monkeypatch):
         led.stop()
 
 
+# -- cluster-wide trace propagation -------------------------------------------
+
+
+def test_trace_propagates_router_leader_follower(tmp_path):
+    """One traced client write: the router span parents into the client
+    context, the leader's request span parents into the ROUTER span, the
+    leader's group-commit fsync links the trace, and the follower's
+    replicated apply links back — the parent/link chain the flight
+    recorder's merged timeline renders (all in-process here, so one
+    recorder sees every hop)."""
+    from automerge_tpu import obs
+
+    obs.reset_all()
+    fol = start_node(tmp_path, "f1", role="follower")
+    led = start_node(tmp_path, "l1", role="leader",
+                     replicate_to=[addr_of(fol)], ack_replicas=1)
+    router = ClusterRouter([[addr_of(led), addr_of(fol)]], heartbeat=5.0)
+    router.start()
+    try:
+        c = Client(router.address)
+        d = c.call("openDurable", name="docT")["doc"]
+        tid = "e2e-trace-1"
+
+        def traced(method, **params):
+            c.rid += 1
+            c.sock.sendall((json.dumps(
+                {"id": c.rid, "method": method, "params": params,
+                 "trace": {"t": tid, "s": 12345}}
+            ) + "\n").encode())
+            resp = json.loads(c.f.readline())
+            assert "error" not in resp, resp
+            return resp.get("result")
+
+        traced("put", doc=d, obj="_root", prop="k", value=1)
+        traced("commit", doc=d)  # quorum ack: follower holds it durably
+        c.close()
+
+        spans = obs.recorder.snapshot()
+        in_trace = [r for r in spans if r.trace_id == tid]
+        names = {r.name for r in in_trace}
+        # router hop: parented into the client's (remote) span id
+        router_spans = [r for r in in_trace if r.name == "router.request"]
+        assert router_spans and all(
+            r.parent_id == 12345 for r in router_spans)
+        # leader hop: rpc.request parented into a ROUTER span
+        router_ids = {r.span_id for r in router_spans}
+        node_reqs = [r for r in in_trace if r.name == "rpc.request"]
+        assert node_reqs and any(
+            r.parent_id in router_ids for r in node_reqs)
+        # the durable write path nests inside the traced request
+        assert "journal.append" in names
+        # group commit attribution: some fsync links the trace
+        fsyncs = [r for r in spans if r.name == "journal.fsync" and r.links]
+        assert any(t == tid for r in fsyncs for t, _s in r.links)
+        # follower hop: the shipped batch's apply links the client trace
+        applies = [r for r in spans if r.name == "repl.apply"]
+        assert applies and any(
+            t == tid for r in applies if r.links for t, _s in r.links)
+        # and the ship span itself carries the link on the leader side
+        ships = [r for r in spans if r.name == "cluster.ship_batch"]
+        assert any(
+            t == tid for r in ships if r.links for t, _s in r.links)
+    finally:
+        router.stop()
+        led.stop()
+        fol.stop()
+
+
 # -- the router tier ----------------------------------------------------------
 
 
@@ -258,6 +326,47 @@ def test_router_proxies_and_virtualizes_handles(tmp_path):
     finally:
         router.stop()
         n0.stop()
+
+
+def test_cluster_metrics_merges_nodes_with_labels(tmp_path):
+    """clusterMetrics fans out to every node and merges the families
+    under node labels; the cluster-metrics CLI scrapes it."""
+    from automerge_tpu.cli import main
+    from automerge_tpu.obs.metrics import parse_prometheus
+
+    fol = start_node(tmp_path, "f1", role="follower")
+    led = start_node(tmp_path, "l1", role="leader",
+                     replicate_to=[addr_of(fol)], ack_replicas=1)
+    router = ClusterRouter([[addr_of(led), addr_of(fol)]], heartbeat=5.0)
+    router.start()
+    try:
+        c = Client(router.address)
+        d = c.call("openDurable", name="docM")["doc"]
+        c.call("put", doc=d, obj="_root", prop="x", value=1)
+        c.call("commit", doc=d)
+        res = c.call("clusterMetrics")
+        assert res["format"] == "prometheus" and not res["unreachable"]
+        parsed = parse_prometheus(res["body"])
+        nodes = {dict(k[1]).get("node") for k in parsed}
+        # every sample labeled; router + both nodes present
+        assert None not in nodes
+        assert nodes >= {"router", addr_of(led), addr_of(fol)}
+        # per-doc gauges rode along from the leader
+        assert ("doc_journal_bytes",
+                (("doc", "docM"), ("node", addr_of(led)))) in parsed
+        # one merged family set: a single TYPE line per family
+        assert res["body"].count("# TYPE rpc_request_count") <= 1
+        c.close()
+        # the CLI scrape returns the same body shape
+        out = tmp_path / "cm.prom"
+        rc = main(["cluster-metrics", "%s:%d" % router.address,
+                   "-o", str(out)])
+        assert rc == 0
+        assert 'node="router"' in out.read_text()
+    finally:
+        router.stop()
+        led.stop()
+        fol.stop()
 
 
 def _kill_node_sockets(node):

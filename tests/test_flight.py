@@ -1,0 +1,233 @@
+"""Flight recorder: bounded rings, crash-dump files, and the
+multi-process merge that stitches dumps into one clock-aligned Perfetto
+timeline with parent/link ids connecting across process boundaries."""
+
+import json
+import os
+
+import pytest
+
+from automerge_tpu import obs
+from automerge_tpu.obs.flight import FlightRecorder, merge_flights
+from automerge_tpu.obs.metrics import MetricsRegistry
+from automerge_tpu.obs.spans import SpanRecord, SpanRecorder
+
+
+def test_flight_dump_contents(tmp_path):
+    obs.reset_all()
+    obs.flight.events.clear()
+    obs.flight.deltas.clear()
+    with obs.span("fl.work", rows=3):
+        obs.count("fl.counter", n=2, labels={"k": "v"})
+        obs.gauge_set("fl.gauge", 7.5)
+        obs.event("fl.event", what="happened")
+    rec = FlightRecorder(obs.recorder, obs.registry)
+    rec.install(str(tmp_path), "node-1")
+    path = rec.dump(reason="test")
+    assert os.path.basename(path).startswith("flight-node-1-")
+    d = json.load(open(path))
+    assert d["format"] == "automerge_tpu-flight-v1"
+    assert d["node_id"] == "node-1" and d["reason"] == "test"
+    assert d["origin_wall"] > 0
+    assert any(s["name"] == "fl.work" and s["fields"] == {"rows": 3}
+               for s in d["spans"])
+    # events and metric deltas landed in the GLOBAL flight rings (the
+    # obs entry points feed obs.flight, not this scratch recorder)
+    gpath = tmp_path / "global.json"
+    obs.flight.dump(str(gpath), reason="test")
+    g = json.load(open(gpath))
+    assert any(e["name"] == "fl.event" and e["fields"] == {"what": "happened"}
+               for e in g["events"])
+    deltas = {(e["kind"], e["name"]) for e in g["metric_deltas"]}
+    assert ("count", "fl.counter") in deltas
+    assert ("gauge", "fl.gauge") in deltas
+    assert any(m["name"] == "fl.counter" for m in g["metrics"])
+    # a second dump gets a fresh sequence number, never overwrites
+    assert rec.dump(reason="again") != path
+
+
+def test_flight_rings_are_bounded():
+    rec = FlightRecorder(SpanRecorder(4), MetricsRegistry(), capacity=8)
+    for i in range(100):
+        rec.note_event(f"e{i}", {"i": i})
+        rec.note_delta("count", f"c{i}", None, 1)
+    assert len(rec.events) == 8 and len(rec.deltas) == 8
+    assert rec.events[0][1] == "e92"  # oldest evicted
+    off = FlightRecorder(SpanRecorder(4), MetricsRegistry(), capacity=0)
+    off.note_event("x", {})
+    off.note_delta("count", "x", None, 1)
+    assert len(off.events) == 0 and len(off.deltas) == 0
+
+
+def _fake_process(tmp_path, node_id, spans, origin_wall, clock_sync=()):
+    """Write a flight dump for a simulated process: its own span
+    recorder, its own clock origin."""
+    srec = SpanRecorder(64)
+    for s in spans:
+        srec.record(s)
+    rec = FlightRecorder(srec, MetricsRegistry(), capacity=8)
+    for cs in clock_sync:
+        rec.note_clock_sync(*cs)
+    path = str(tmp_path / f"flight-{node_id}.json")
+    rec.node_id = node_id
+    rec.dump(path, reason="test")
+    # dumps self-report origin_wall from the shared process clock; the
+    # simulated processes need distinct origins
+    d = json.load(open(path))
+    d["node_id"] = node_id
+    d["origin_wall"] = origin_wall
+    json.dump(d, open(path, "w"))
+    return path
+
+
+def test_merge_connects_parents_and_links_across_dumps(tmp_path):
+    """The acceptance shape: one client request's spans across router,
+    leader and follower processes connect by parent/link ids in a single
+    merged timeline."""
+    tid = "req-cross"
+    # "router" process: root span of the trace
+    router_span = SpanRecord("router.request", 1001, None, 0.10, 0.30,
+                             1, {}, "ok", trace_id=tid)
+    # "leader" process: rpc.request parented to the ROUTER's span id,
+    # plus a group-commit fsync linking the trace
+    leader_req = SpanRecord("rpc.request", 2001, 1001, 0.02, 0.20,
+                            1, {}, "ok", trace_id=tid)
+    leader_fsync = SpanRecord("journal.fsync", 2002, None, 0.10, 0.05,
+                              2, {}, "ok", links=((tid, 2001),))
+    # "follower" process: repl.apply linking back to the leader span
+    follower_apply = SpanRecord("repl.apply", 3001, None, 0.01, 0.04,
+                                1, {}, "ok", trace_id=tid,
+                                links=((tid, 2001),))
+    p_router = _fake_process(tmp_path, "router", [router_span], 1000.0)
+    p_leader = _fake_process(
+        tmp_path, "leader", [leader_req, leader_fsync], 1000.1)
+    p_follower = _fake_process(
+        tmp_path, "follower", [follower_apply], 1000.2)
+
+    doc, info = merge_flights([p_router, p_leader, p_follower])
+    ev = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    by_id = {e["args"]["span_id"]: e for e in ev}
+    assert len(info["processes"]) == 3 and info["spans"] == 4
+
+    # each process got its own pid, named
+    names = {e["args"]["name"] for e in doc["traceEvents"]
+             if e.get("ph") == "M"}
+    assert names == {"router", "leader", "follower"}
+
+    # the parent chain crosses dumps: the leader's rpc.request names the
+    # router's span as parent, and both carry the trace id
+    lr = by_id[2001]
+    assert lr["args"]["parent_id"] == 1001
+    assert by_id[1001]["args"]["trace_id"] == tid
+    assert lr["pid"] != by_id[1001]["pid"]
+
+    # links cross dumps too: the follower's apply (and the leader's
+    # group-commit fsync) both name the leader request span
+    assert by_id[3001]["args"]["links"] == [[tid, 2001]]
+    assert by_id[2002]["args"]["links"] == [[tid, 2001]]
+    assert by_id[3001]["pid"] != lr["pid"]
+
+    # wall-clock alignment: all three processes share one timeline, so
+    # the leader's request (origin 1000.1 + 0.02) sits inside the
+    # router's span (origin 1000.0 + 0.10 .. 0.40)
+    assert by_id[1001]["ts"] <= lr["ts"] <= by_id[1001]["ts"] + 0.30e6
+
+
+def test_merge_aligns_clocks_from_rtt_midpoints(tmp_path):
+    """A follower whose self-reported wall origin is WRONG (skewed
+    clock) still lands correctly: the leader's RTT samples around the
+    follower's monotonic 'now' pin it to the shared timeline."""
+    leader_span = SpanRecord("a", 1, None, 1.0, 0.1, 1, {}, "ok")
+    follower_span = SpanRecord("b", 2, None, 4.0, 0.1, 1, {}, "ok")
+    # truth: leader origin_wall=1000, follower's TRUE origin is 1005 —
+    # at leader-mono 10.0 (wall 1010) the follower's mono clock reads
+    # 5.0, and again at 20.0/15.0 (median of consistent samples).
+    samples = [("follower", 9.9, 10.1, 5.0), ("follower", 19.9, 20.1, 15.0)]
+    p_leader = _fake_process(tmp_path, "leader", [leader_span], 1000.0,
+                             clock_sync=samples)
+    # follower lies about its wall origin by a full minute
+    p_follower = _fake_process(tmp_path, "follower", [follower_span], 1060.0)
+    doc, info = merge_flights([p_leader, p_follower])
+    ev = {e["args"]["span_id"]: e for e in doc["traceEvents"]
+          if e.get("ph") == "X"}
+    # leader span at wall 1001.0, follower span at true wall 1005+4=1009
+    # -> 8s apart on the merged timeline, not 64s
+    dt_us = ev[2]["ts"] - ev[1]["ts"]
+    assert abs(dt_us - 8e6) < 1e3, dt_us
+    assert info["processes"]["follower"]["aligned"] == "rtt"
+    assert info["processes"]["leader"]["aligned"] == "wall"
+
+
+def test_merge_collapses_multiple_dumps_from_one_process(tmp_path):
+    """A process that dumped twice (failover + exit) with overlapping
+    span rings renders each span ONCE, under one pid."""
+    s1 = SpanRecord("early", 21, None, 0.0, 0.1, 1, {}, "ok")
+    s2 = SpanRecord("late", 22, None, 1.0, 0.1, 1, {}, "ok")
+    p_a = str(tmp_path / "flight-r-1.json")
+    p_b = str(tmp_path / "flight-r-2.json")
+    # failover dump holds s1; the later exit dump holds s1 AND s2
+    for path, spans, mono in ((p_a, [s1], 5.0), (p_b, [s1, s2], 9.0)):
+        srec = SpanRecorder(16)
+        for s in spans:
+            srec.record(s)
+        rec = FlightRecorder(srec, MetricsRegistry(), capacity=4)
+        rec.node_id = "router-7"
+        rec.dump(path, reason="x")
+        d = json.load(open(path))
+        d["node_id"] = "router-7"
+        d["dumped_at_mono"] = mono
+        json.dump(d, open(path, "w"))
+    doc, info = merge_flights([p_b, p_a])  # order must not matter
+    ev = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert sorted(e["args"]["span_id"] for e in ev) == [21, 22]
+    assert len({e["pid"] for e in ev}) == 1
+    assert len(info["processes"]) == 1
+    assert info["processes"]["router-7"]["spans"] == 2
+    assert info["spans"] == 2
+
+
+def test_merge_aligns_when_sampler_is_not_first_dump(tmp_path):
+    """The RTT BFS roots at the dump that HOLDS samples — a sampled-only
+    follower sorting first (alphabetically or by mtime) must not disable
+    alignment."""
+    a = SpanRecord("a", 1, None, 1.0, 0.1, 1, {}, "ok")
+    b = SpanRecord("b", 2, None, 4.0, 0.1, 1, {}, "ok")
+    p_fol = _fake_process(tmp_path, "a-follower", [b], 2060.0)
+    p_led = _fake_process(tmp_path, "z-leader", [a], 2000.0,
+                          clock_sync=[("a-follower", 9.9, 10.1, 5.0)])
+    # follower first: the old first-dump root would reach nobody
+    doc, info = merge_flights([p_fol, p_led])
+    assert info["processes"]["a-follower"]["aligned"] == "rtt"
+    ev = {e["args"]["span_id"]: e for e in doc["traceEvents"]
+          if e.get("ph") == "X"}
+    assert abs((ev[2]["ts"] - ev[1]["ts"]) - 8e6) < 1e3
+
+
+def test_merge_rejects_non_flight_files(tmp_path):
+    bad = tmp_path / "x.json"
+    bad.write_text("{}")
+    with pytest.raises(ValueError):
+        merge_flights([str(bad)])
+    with pytest.raises(ValueError):
+        merge_flights([])
+
+
+def test_cli_flight_merge_subcommand(tmp_path, capsys):
+    from automerge_tpu.cli import main
+
+    s1 = SpanRecord("one", 11, None, 0.0, 0.1, 1, {}, "ok")
+    s2 = SpanRecord("two", 12, 11, 0.0, 0.05, 1, {}, "ok")
+    _fake_process(tmp_path, "p1", [s1], 100.0)
+    _fake_process(tmp_path, "p2", [s2], 100.0)
+    out = tmp_path / "merged.json"
+    # a directory of dumps is accepted and globbed
+    rc = main(["flight-merge", str(tmp_path), "-o", str(out)])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    names = {e["name"] for e in doc["traceEvents"] if e.get("ph") == "X"}
+    assert names == {"one", "two"}
+    err = capsys.readouterr().err
+    assert "2 processes" in err
+    # no dumps -> clean failure
+    rc = main(["flight-merge", str(tmp_path / "empty_dir_nope")])
+    assert rc == 1

@@ -447,6 +447,229 @@ def test_cli_metrics_subcommand(tmp_path, capsys):
     assert "journal.replayed_records" in snap["counters"]
 
 
+# -- cross-process trace context ---------------------------------------------
+
+
+def _spans_named(name):
+    return [r for r in obs.recorder.snapshot() if r.name == name]
+
+
+def test_trace_scope_propagates_into_spans():
+    obs.reset_all()
+    with obs.trace_scope("trace-abc", 4242):
+        with obs.span("ts.outer") as sp:
+            assert obs.current_trace_context() == ("trace-abc", sp.span_id)
+            with obs.span("ts.inner"):
+                pass
+    outer, inner = _spans_named("ts.outer")[0], _spans_named("ts.inner")[0]
+    # the remote parent heads the local chain; the trace id rides every span
+    assert outer.parent_id == 4242 and outer.trace_id == "trace-abc"
+    assert inner.parent_id == outer.span_id and inner.trace_id == "trace-abc"
+    # outside the scope: no trace, no context
+    assert obs.current_trace_context() is None
+    with obs.span("ts.bare"):
+        pass
+    assert _spans_named("ts.bare")[0].trace_id is None
+
+
+def test_trace_scope_rejects_hostile_input():
+    obs.reset_all()
+    for tid, sid in (({"x": 1}, "nope"), ("", 1), ("t" * 500, 1),
+                     (None, None), (7, True)):
+        with obs.trace_scope(tid, sid):
+            with obs.span("ts.hostile"):
+                pass
+    assert all(r.trace_id is None for r in _spans_named("ts.hostile"))
+    # sane id + junk parent: trace id still propagates, parent is local
+    with obs.trace_scope("ok", "junk"):
+        with obs.span("ts.half"):
+            pass
+    r = _spans_named("ts.half")[0]
+    assert r.trace_id == "ok" and r.parent_id is None
+
+
+def test_span_links_recorded_and_exported(tmp_path):
+    obs.reset_all()
+    with obs.span("lk.covered", links=[("tr1", 11), ("tr2", None)]):
+        pass
+    r = _spans_named("lk.covered")[0]
+    assert r.links == (("tr1", 11), ("tr2", None))
+    path = str(tmp_path / "links.json")
+    obs.export_trace(path)
+    ev = [e for e in json.load(open(path))["traceEvents"]
+          if e["name"] == "lk.covered"][0]
+    assert ev["args"]["links"] == [["tr1", 11], ["tr2", None]]
+
+
+def test_decode_wire_traces_sanitizes():
+    good = [["t1", 5], ["t2", None]]
+    assert obs.decode_wire_traces(good) == [("t1", 5), ("t2", None)]
+    hostile = [["t", "x"], "junk", [1, 2], ["", 3], ["ok", True],
+               ["a" * 500, 1], ["fine", 9]]
+    assert obs.decode_wire_traces(hostile) == [("fine", 9)]
+    assert obs.decode_wire_traces("notalist") == []
+    assert obs.decode_wire_traces([["t", 1]] * 100, limit=4) == [("t", 1)] * 4
+
+
+def test_rpc_trace_field_activates_context():
+    from automerge_tpu.rpc import RpcServer
+
+    obs.reset_all()
+    srv = RpcServer()
+    resp = srv.handle({"id": 1, "method": "create", "params": {},
+                       "trace": {"t": "req-77", "s": 909}})
+    assert "error" not in resp
+    spans = [r for r in obs.recorder.snapshot()
+             if r.name == "rpc.request" and r.trace_id == "req-77"]
+    assert spans and spans[0].parent_id == 909
+    # hostile trace values answer normally, without a trace
+    for tr in ("junk", {"t": 5, "s": "x"}, {"t": None}, []):
+        resp = srv.handle({"id": 2, "method": "heads",
+                           "params": {"doc": 999}, "trace": tr})
+        assert "error" in resp  # invalid handle — but answered, not raised
+    # absent trace: plain request, no trace recorded
+    srv.handle({"id": 3, "method": "create", "params": {}})
+    last = [r for r in obs.recorder.snapshot()
+            if r.name == "rpc.request"][-1]
+    assert last.trace_id is None
+
+
+def test_spans_dropped_counter_on_ring_wrap(monkeypatch):
+    obs.reset_all()
+    small = obs.SpanRecorder(capacity=8)
+    monkeypatch.setattr(obs, "recorder", small)
+    for _ in range(20):
+        with obs.span("wrap.me"):
+            pass
+    parsed = parse_prometheus(obs.render_prometheus())
+    assert parsed[("obs_spans_dropped_total", ())] == 12.0
+
+
+# -- multi-node Prometheus merging -------------------------------------------
+
+
+def test_merge_prometheus_multi_node_families():
+    from automerge_tpu.obs.metrics import MetricsRegistry, merge_prometheus
+
+    a, b = MetricsRegistry(), MetricsRegistry()
+    # conflicting label SETS on one family name across nodes
+    a.counter("rpc.errors", method="put").inc(2)
+    b.counter("rpc.errors", type="transport", peer="x").inc(5)
+    b.gauge("cluster.replication_lag", doc="d1").set(3)
+    merged = merge_prometheus({"n1": a.render_prometheus(),
+                               "n2": b.render_prometheus()})
+    parsed = parse_prometheus(merged)  # lossless: re-parses cleanly
+    assert parsed[("rpc_errors_total",
+                   (("method", "put"), ("node", "n1")))] == 2.0
+    assert parsed[("rpc_errors_total",
+                   (("node", "n2"), ("peer", "x"),
+                    ("type", "transport")))] == 5.0
+    assert parsed[("cluster_replication_lag",
+                   (("doc", "d1"), ("node", "n2")))] == 3.0
+    # ONE merged family set: a single TYPE line per family
+    assert merged.count("# TYPE rpc_errors_total counter") == 1
+
+
+def test_merge_prometheus_histogram_bucket_union():
+    from automerge_tpu.obs.metrics import MetricsRegistry, merge_prometheus
+
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.histogram("lat").observe(0.001)   # hits a tiny bucket
+    b.histogram("lat").observe(100.0)   # hits a huge bucket
+    b.histogram("lat").observe(200.0)
+    merged = merge_prometheus({"a": a.render_prometheus(),
+                               "b": b.render_prometheus()})
+    assert merged.count("# TYPE lat histogram") == 1
+    parsed = parse_prometheus(merged)
+    # each node's sparse buckets survive under its node label…
+    a_buckets = [k for k in parsed
+                 if k[0] == "lat_bucket" and ("node", "a") in k[1]]
+    b_buckets = [k for k in parsed
+                 if k[0] == "lat_bucket" and ("node", "b") in k[1]]
+    assert a_buckets and b_buckets
+    # …with per-node counts intact
+    assert parsed[("lat_count", (("node", "a"),))] == 1.0
+    assert parsed[("lat_count", (("node", "b"),))] == 2.0
+    # and the +Inf bound survives both parse and merge
+    assert any(("le", "+Inf") in k[1] for k in a_buckets)
+
+
+def test_merge_prometheus_hostile_node_labels():
+    from automerge_tpu.obs.metrics import MetricsRegistry, merge_prometheus
+
+    r = MetricsRegistry()
+    r.counter("c").inc()
+    evil = 'node"with\\quotes\nand newlines'
+    merged = merge_prometheus({evil: r.render_prometheus()})
+    parsed = parse_prometheus(merged)
+    assert parsed[("c_total", (("node", evil),))] == 1.0
+    # a pre-existing node label is replaced by the scraper's identity
+    r2 = MetricsRegistry()
+    r2.counter("c", node="liar").inc(9)
+    merged = merge_prometheus({"true-node": r2.render_prometheus()})
+    parsed = parse_prometheus(merged)
+    assert parsed[("c_total", (("node", "true-node"),))] == 9.0
+
+
+# -- per-doc accounting gauges ------------------------------------------------
+
+
+def _gauge_value(name, **labels):
+    for e in obs.snapshot():
+        if e["name"] == name and e["type"] == "gauge" and e["labels"] == labels:
+            return e["value"]
+    return None
+
+
+def test_per_doc_gauges_durable_layer(tmp_path):
+    obs.reset_all()
+    dd = AutoDoc.open(str(tmp_path / "docA"), fsync="never")
+    try:
+        dd.put("_root", "k", 1)
+        dd.commit()
+        jb = _gauge_value("doc.journal_bytes", doc="docA")
+        la = _gauge_value("doc.last_access_seconds", doc="docA")
+        assert jb is not None and jb > 0
+        assert la is not None and 0 < la <= obs.now()
+        before = la
+        dd.put("_root", "k", 2)
+        dd.commit()
+        assert _gauge_value("doc.last_access_seconds", doc="docA") >= before
+        assert _gauge_value("doc.journal_bytes", doc="docA") > jb
+    finally:
+        dd.close()
+
+
+def test_per_doc_gauges_device_layer(tmp_path):
+    obs.reset_all()
+    dd = AutoDoc.open(str(tmp_path / "docB"), fsync="never", device=True)
+    try:
+        dd.put("_root", "k", 1)
+        dd.commit()
+        dd.device_doc.apply_changes([dd.doc.history[-1].stored])
+        ops = _gauge_value("doc.resident_ops", doc="docB")
+        db = _gauge_value("doc.device_bytes", doc="docB")
+        assert ops == dd.device_doc.log.n and ops > 0
+        assert db is not None and db > 0
+    finally:
+        dd.close()
+
+
+def test_flocks_held_gauge(tmp_path):
+    from automerge_tpu.storage.journal import Journal
+
+    def held():
+        return obs.registry.gauge("serve.flocks_held").value
+
+    v0 = held()
+    j, _, _ = Journal.open(str(tmp_path / "j.waj"), fsync="never")
+    assert held() == v0 + 1
+    j.close()
+    assert held() == v0
+    j.close()  # idempotent: no double decrement
+    assert held() == v0
+
+
 # -- overhead guard ----------------------------------------------------------
 
 
