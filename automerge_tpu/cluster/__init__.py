@@ -9,8 +9,12 @@ replication and leader failover.
                     role + the cluster RPC surface
 * router.py       — the client-facing proxy: placement, handle
                     virtualization, heartbeat failover, live migration
+* chaos.py        — the chaos fabric: a seeded TCP fault interposer
+                    (drop/delay/throttle/partition/sever) + scripted
+                    fault schedules for the soak
 """
 
+from .chaos import ChaosProxy, ChaosSchedule, LinkPolicy
 from .hashring import HashRing
 from .node import ClusterNode, ClusterRpcServer, REPL_SHARD_KEY
 from .replication import (
@@ -25,10 +29,13 @@ from .replication import (
 from .router import ClusterRouter
 
 __all__ = [
+    "ChaosProxy",
+    "ChaosSchedule",
     "ClusterNode",
     "ClusterRouter",
     "ClusterRpcServer",
     "HashRing",
+    "LinkPolicy",
     "REPL_SHARD_KEY",
     "ReplicationError",
     "ReplicationHub",

@@ -52,11 +52,17 @@ REPL_SHARD_KEY = "__replication__"
 
 _REPL_METHODS = frozenset({"replApply", "replSnapshot", "migrateIn"})
 
-# what a follower will answer; everything else is NotLeader
+# what a follower will answer; everything else is NotLeader. The
+# durable-recovery and chaos-injection surfaces are follower-ok: a
+# degraded FOLLOWER doc (live disk fault on the replica) is repaired in
+# place by compact/reopen, and the chaos soak deals its faults to
+# followers directly.
 _FOLLOWER_OK = frozenset({
     "clusterStatus", "clusterPromote", "clusterReplicateTo",
     "replApply", "replSnapshot", "replPing", "replHarvest",
     "metrics", "configure",
+    "durableInfo", "durableCompact", "durableReopen", "openDurable",
+    "chaosDisk",
 })
 
 
@@ -99,6 +105,9 @@ class ClusterRpcServer(RpcServer):
                 "message": f"node {self.node_id} is a follower"
                 + (f" of {self.leader_hint}" if self.leader_hint else ""),
                 "leader": self.leader_hint,
+                # retriable: mid-failover the router can briefly route at
+                # a node that has not been promoted yet; retry re-resolves
+                "retriable": True,
             }}
         return super().handle(req)
 

@@ -68,7 +68,11 @@ class ReplicationError(Exception):
 
 class ReplicationTimeout(ReplicationError):
     """The ack gate could not confirm enough follower copies in time —
-    the covering batch must surface as errors, never as acks."""
+    the covering batch must surface as errors, never as acks. Retriable:
+    the write is journaled locally but unconfirmed; a retry after the
+    partition heals (or after failover) deduplicates by change hash."""
+
+    retriable = True
 
 
 def _env_float(name: str, default: float) -> float:
@@ -162,8 +166,16 @@ class ReplicationHub:
             ack_timeout if ack_timeout is not None
             else _env_float("AUTOMERGE_TPU_CLUSTER_ACK_TIMEOUT", 30.0)
         )
-        self.retain_bytes = retain_bytes
+        # the bounded tail-retention buffer: a follower whose cursor
+        # falls off it catches up via snapshot+tail (the chaos soak
+        # shrinks this to force that path constantly)
+        self.retain_bytes = int(_env_float(
+            "AUTOMERGE_TPU_REPL_RETAIN_BYTES", retain_bytes))
         self.batch_bytes = batch_bytes
+        # per-request I/O timeout on follower links: a STALLED follower
+        # (black-holed response path) must fail the request and recycle
+        # the link rather than freeze the ship loop forever
+        self.io_timeout = _env_float("AUTOMERGE_TPU_REPL_IO_TIMEOUT", 10.0)
         self._lock = threading.Lock()
         self._acked = threading.Condition(self._lock)
         self._streams: Dict[str, _DocStream] = {}
@@ -174,12 +186,46 @@ class ReplicationHub:
 
     def attach(self, name: str, dd) -> None:
         """Start replicating ``dd``'s journal under ``name``. Installs
-        the journal hooks and (with ``ack_replicas``) the ack gate."""
+        the journal hooks and (with ``ack_replicas``) the ack gate.
+
+        Re-attaching the same name with a DIFFERENT document (a
+        ``durableReopen`` after a disk fault replaced the wrapper and
+        its journal) swaps the stream onto the new incarnation in place:
+        the LSN sequence continues (follower cursors stay meaningful —
+        everything they hold is still a prefix of what ships next), and
+        pending never-fsynced records from the dead journal are dropped
+        (they were never acked; the reopened document no longer holds
+        them either)."""
+        reattached = False
         with self._lock:
-            if name in self._streams or self._closed:
+            if self._closed:
                 return
-            st = _DocStream(name, dd)
-            self._streams[name] = st
+            st = self._streams.get(name)
+            if st is not None:
+                if st.dd is dd:
+                    return
+                old = st.dd
+                old.journal.on_record = None
+                old.journal.on_synced = None
+                old.replication_gate = None
+                st.dd = dd
+                st.pending.clear()
+                reattached = True
+                links = list(self._links.values())
+            else:
+                st = _DocStream(name, dd)
+                self._streams[name] = st
+        if reattached:
+            # the reopened document's recovered history may contain
+            # records the old journal wrote but never confirmed (a
+            # poisoned fsync leaves the tail's durability unknowable) —
+            # records the LSN bookkeeping can no longer replay from the
+            # buffer. One forced snapshot per follower squares every
+            # cursor with the recovered state; known changes deduplicate.
+            for link in links:
+                link.force_snapshot(name)
+            obs.count("cluster.catchup_snapshots",
+                      labels={"reason": "reattach"})
         j = dd.journal
         j.on_record = lambda rt, pl, seq, _n=name: self._on_record(
             _n, rt, pl, seq)
@@ -432,6 +478,12 @@ class _FollowerLink:
     def note_doc(self, name: str) -> None:
         self._wake.set()
 
+    def force_snapshot(self, name: str) -> None:
+        """Next ship for ``name`` starts from a fresh snapshot (the
+        reattach/resync path)."""
+        self._needs_snapshot[name] = True
+        self._wake.set()
+
     def stop(self) -> None:
         self._stop.set()
         self._wake.set()
@@ -447,8 +499,13 @@ class _FollowerLink:
 
     def _connect(self):
         host, _, port = self.addr.rpartition(":")
-        sock = socket.create_connection((host, int(port)), timeout=10)
+        sock = socket.create_connection(
+            (host, int(port)), timeout=self.hub.io_timeout)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # the connect timeout stays as the per-op socket timeout: a
+        # stalled follower (response path black-holed) times the request
+        # out instead of freezing the ship loop — the link recycles and
+        # the ack gate sees an honest zero instead of a hang
         self._sock = sock
         return sock.makefile("r")
 
@@ -577,6 +634,12 @@ class _FollowerLink:
         try:
             records, last, traces = self.hub.tail_after(name, since)
         except ReplicationError:
+            # the follower's cursor fell off the bounded retention
+            # buffer (it stalled, or died and came back late): forced
+            # snapshot catch-up instead of a stall — and counted, so
+            # the soak can assert the path actually exercised
+            obs.count("cluster.catchup_snapshots",
+                      labels={"reason": "retention"})
             self._needs_snapshot[name] = True
             self._ship_snapshot(f, name)
             return True
@@ -604,6 +667,8 @@ class _FollowerLink:
                     # the follower's journal disagrees with our
                     # bookkeeping (its restart raced an ack): resync
                     # through a snapshot instead of guessing
+                    obs.count("cluster.catchup_snapshots",
+                              labels={"reason": "cursor_mismatch"})
                     self._needs_snapshot[name] = True
                     self._ship_snapshot(f, name)
                     return True

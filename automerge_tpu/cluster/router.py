@@ -354,15 +354,21 @@ class ClusterRouter:
                         # measurable cost of an outage to clients
                         obs.count("cluster.unavailable", labels={
                             "method": str(req.get("method"))[:40]})
-                    err = {"type": e.type, "message": str(e)}
-                    if e.type == "Unavailable":
-                        err["retriable"] = True
+                    # every router-originated error states its retry
+                    # semantics: Unavailable (outage windows) is
+                    # retriable, handle/placement errors are not
+                    err = {"type": e.type, "message": str(e),
+                           "retriable": e.type == "Unavailable"}
                     reply({"id": req.get("id"), "error": err})
                 except Exception as e:  # noqa: BLE001 — isolate clients
                     obs.count("router.errors",
                               labels={"type": type(e).__name__})
+                    # non-_RouteError escapes are router-side infra
+                    # mishaps (an admin call racing a failover, a dead
+                    # pooled conn) — transient by nature, so retriable
                     reply({"id": req.get("id"), "error": {
-                        "type": "RouterError", "message": str(e)}})
+                        "type": "RouterError", "message": str(e),
+                        "retriable": True}})
         finally:
             with contextlib.suppress(Exception):
                 f.close()

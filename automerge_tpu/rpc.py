@@ -183,6 +183,12 @@ class RpcServer:
         # flock — but a slow open (multi-second journal replay) of one
         # document must not head-of-line-block opens of every other
         self._open_locks: Dict[str, threading.Lock] = {}
+        # chaos mode (AUTOMERGE_TPU_CHAOS=1): durable docs open through a
+        # per-doc FaultyFS so the chaosDisk method can deal a RUNNING
+        # journal ENOSPC on append / EIO on fsync. Off (the default) the
+        # injection surface does not exist at all.
+        self.chaos_enabled = os.environ.get("AUTOMERGE_TPU_CHAOS") == "1"
+        self._chaos_fs: Dict[str, object] = {}  # doc name -> FaultyFS
 
     # -- handle plumbing ----------------------------------------------------
 
@@ -329,6 +335,14 @@ class RpcServer:
                     f"textEncoding={have_enc!r}, not {want_enc!r}"
                 )
             return {"doc": h}
+        open_kw = {}
+        if self.chaos_enabled:
+            from .storage.crashsim import FaultyFS
+
+            fs = self._chaos_fs.get(name)
+            if fs is None:
+                fs = self._chaos_fs[name] = FaultyFS()
+            open_kw["fs"] = fs
         dd = AutoDoc.open(
             path,
             fsync=p.get("fsync", "always"),
@@ -338,6 +352,7 @@ class RpcServer:
             compact_cost_ratio=float(
                 os.environ.get("AUTOMERGE_TPU_COMPACT_COST_RATIO", "0") or 0
             ),
+            **open_kw,
         )
         h = self._reg(self._docs, dd)
         with self._lock:
@@ -365,7 +380,96 @@ class RpcServer:
             "journalRecords": doc.journal.record_count,
             "journalBytes": doc.journal.size_bytes,
             "fsync": doc.journal.fsync_policy,
+            "degraded": doc.degraded,
+            "poisoned": doc.journal.poisoned_reason,
         }
+
+    def durableReopen(self, p):
+        """Close and re-open a named durable document in place — the
+        operator recovery path for a doc degraded by a live disk fault
+        (a poisoned journal re-acquires its file and flock; recovery
+        replays snapshot + intact journal prefix). The handle is
+        preserved, so clients holding it keep working; sessions attached
+        to the old incarnation are dropped exactly as ``free`` drops
+        them (re-attach resumes via the epoch handshake)."""
+        name = p.get("name")
+        path = self._durable_path(name)
+        with self._lock:
+            lk = self._open_locks.setdefault(name, threading.Lock())
+        with lk:
+            with self._lock:
+                h = self._durable_names.get(name)
+                old = self._docs.get(h) if h is not None else None
+                # unmap the NAME (so the open below builds a fresh doc)
+                # but keep the handle pointing at the old instance for
+                # the whole reopen window: a concurrent request on it
+                # answers with the doc's own (retriable) degraded error
+                # rather than a bogus invalid-handle
+                self._durable_names.pop(name, None)
+            if old is not None:
+                try:
+                    old.close()
+                except Exception as e:  # noqa: BLE001 — a degraded doc's
+                    # close may trip on its own poisoned journal; the
+                    # reopen below re-establishes a clean state anyway
+                    obs.count("rpc.reopen_close_error", error=str(e)[:200])
+            try:
+                res = self._open_durable_locked(name, path, p)
+            except Exception:
+                # reopen failed (e.g. the disk fault is still live):
+                # restore the name mapping so the doc stays addressable
+                # (still degraded) and a later reopen can retry
+                if h is not None:
+                    with self._lock:
+                        self._durable_names[name] = h
+                raise
+            new_h = res["doc"]
+            with self._lock:
+                if h is not None and new_h != h:
+                    # preserve the caller's existing handle: alias it to
+                    # the fresh doc and retire the transient handle the
+                    # open minted (nobody ever saw it)
+                    self._docs[h] = self._docs.pop(new_h)
+                    self._durable_names[name] = h
+                    new_h = h
+                # sessions attached to the old incarnation die with it
+                # (re-attach resumes via the epoch handshake)
+                if h is not None:
+                    stale = [
+                        sh for (d, _peer), sh in self._attached_sessions.items()
+                        if d == h
+                    ]
+                    for sh in stale:
+                        self._sessions.pop(sh, None)
+                        self._session_docs.pop(sh, None)
+                    self._attached_sessions = {
+                        k: v for k, v in self._attached_sessions.items()
+                        if k[0] != h
+                    }
+            obs.count("rpc.durable_reopens")
+            return {"doc": new_h, "reopened": True}
+
+    def chaosDisk(self, p):
+        """Chaos-only fault injection (requires AUTOMERGE_TPU_CHAOS=1 in
+        the server's environment): arm or clear a live disk fault on the
+        named durable document's filesystem. ``op`` is one of write /
+        truncate / fsync / replace / sync_dir; ``err`` an errno name
+        (EIO, ENOSPC); ``count`` how many calls fail (-1 = until
+        cleared); ``clear: true`` disarms (``op`` optional)."""
+        if not self.chaos_enabled:
+            raise ValueError(
+                "chaosDisk requires AUTOMERGE_TPU_CHAOS=1 in the server "
+                "environment"
+            )
+        name = p.get("name")
+        fs = self._chaos_fs.get(name)
+        if fs is None:
+            raise ValueError(f"no chaos-wrapped durable doc {name!r} open")
+        if p.get("clear"):
+            fs.clear(p.get("op"))
+        else:
+            fs.arm(p["op"], p.get("err", "EIO"), int(p.get("count", -1)))
+        return {"armed": {op: list(v) for op, v in fs.armed().items()}}
 
     def close_durables(self) -> None:
         """Flush and close every open durable document (their close()
@@ -708,7 +812,8 @@ class RpcServer:
         "syncSessionNew", "syncSessionRestore", "syncSessionPoll",
         "syncSessionReceive", "syncSessionStats", "syncSessionEncode",
         "syncSessionFree", "syncSessionAttach",
-        "openDurable", "durableCompact", "durableInfo",
+        "openDurable", "durableCompact", "durableInfo", "durableReopen",
+        "chaosDisk",
         "metrics",
     })
 
@@ -745,10 +850,15 @@ class RpcServer:
             except Exception as e:  # errors answer the request, never kill us
                 obs.count("rpc.errors", labels={"method": method,
                                                 "type": type(e).__name__})
-                return {
-                    "id": rid,
-                    "error": {"type": type(e).__name__, "message": str(e)},
-                }
+                err = {"type": type(e).__name__, "message": str(e)}
+                # exceptions that know their retry semantics (a poisoned
+                # journal, a replication-gate timeout) surface it so the
+                # client retry loop can distinguish "back off and retry"
+                # from "permanently rejected"
+                retriable = getattr(e, "retriable", None)
+                if retriable is not None:
+                    err["retriable"] = bool(retriable)
+                return {"id": rid, "error": err}
 
     @staticmethod
     def _json_default(v):

@@ -385,9 +385,10 @@ class SocketRpcServer:
         """The shard key for a request, or None to execute inline."""
         params = req.get("params") or {}
         method = req.get("method")
-        if method == "openDurable":
-            # no handle yet; one queue serializes the name-cache check
-            # against concurrent opens of the same name
+        if method in ("openDurable", "durableReopen"):
+            # no handle yet (or the handle is being replaced); one queue
+            # serializes the name-cache check against concurrent opens
+            # and reopens of the same name
             return _OPEN_DURABLE_KEY
         d = params.get("doc")
         if isinstance(d, int):
@@ -408,9 +409,13 @@ class SocketRpcServer:
         try:
             self.pool.submit(key, (conn, req))
         except QueueFull as e:
+            # retriable by contract: backpressure is a transient level,
+            # and the reference client retry loop (clients/python) backs
+            # off on exactly this flag
             conn.send(self.rpc._encode_response({
                 "id": req.get("id"),
-                "error": {"type": "Backpressure", "message": str(e)},
+                "error": {"type": "Backpressure", "message": str(e),
+                          "retriable": True},
             }) + "\n")
 
     # -- execution (worker threads) ------------------------------------------
@@ -485,12 +490,21 @@ class SocketRpcServer:
             # compaction repairs, so nothing later silently builds on this
             obs.count("rpc.errors", labels={"method": "group_commit",
                                             "type": type(e).__name__})
+            err = {"type": type(e).__name__,
+                   "message": f"group commit failed: {e}"}
+            # a poisoned journal / replication-gate timeout is a transient
+            # serving condition (failover, reopen, or heal restores it) —
+            # tell the client retry loop so. A raw OSError here is the
+            # injected-disk-fault first strike: the batch was NOT acked,
+            # so a retry is the correct client move there too.
+            retriable = getattr(e, "retriable", None)
+            if retriable is None and isinstance(e, OSError):
+                retriable = True
+            if retriable is not None:
+                err["retriable"] = bool(retriable)
             out = [
                 (c, r if "error" in r else {
-                    "id": r.get("id"),
-                    "error": {"type": type(e).__name__,
-                              "message": f"group commit failed: {e}"},
-                })
+                    "id": r.get("id"), "error": dict(err)})
                 for c, r in out
             ]
         # one write per connection per batch: a drained flight's responses

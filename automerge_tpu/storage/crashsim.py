@@ -37,8 +37,10 @@ userspace buffer — torn-tail states subsume it).
 
 from __future__ import annotations
 
+import errno as _errno
 import posixpath
 import random
+import threading
 from typing import Dict, List, Optional, Tuple
 
 
@@ -319,3 +321,146 @@ class SimFS:
         for path, data in state.items():
             fs.files[cls._norm(path)] = _Node(data, data)
         return fs
+
+
+# -- live disk-fault injection ------------------------------------------------
+
+
+class _FaultyFile:
+    """File handle issued by ``FaultyFS``: write-path calls consult the
+    armed faults before delegating; everything else passes through."""
+
+    def __init__(self, fs: "FaultyFS", inner):
+        self._fs = fs
+        self._inner = inner
+
+    def write(self, data):
+        self._fs._maybe_fail("write")
+        return self._inner.write(data)
+
+    def truncate(self, size=None):
+        self._fs._maybe_fail("truncate")
+        return self._inner.truncate(size)
+
+    def __getattr__(self, name):  # read/seek/flush/fileno/close/...
+        return getattr(self._inner, name)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._inner.close()
+        return False
+
+
+class FaultyFS:
+    """Live disk-fault injection over a real (or simulated) filesystem.
+
+    Where ``SimFS`` models crash boundaries for offline sweeps, this
+    wrapper deals I/O errors to a *running* process: arm ``ENOSPC`` on
+    write and the journal's next append raises mid-record; arm ``EIO``
+    on fsync and the next group-commit fsync fails — which the journal
+    answers by poisoning itself (storage/journal.py). Thread-safe and
+    zero-overhead-ish when nothing is armed (one lock-free dict read per
+    faultable call).
+
+        fs = FaultyFS()
+        dd = AutoDoc.open(path, fs=fs)
+        fs.arm("fsync", "EIO")          # every fsync fails until cleared
+        fs.arm("write", "ENOSPC", count=1)  # exactly the next write
+        fs.clear()                      # all faults off
+
+    Ops: ``write``, ``truncate``, ``fsync``, ``replace``, ``sync_dir``.
+    Every injected fault counts ``chaos.injected{kind=disk_<op>}`` so a
+    chaos soak can assert its faults actually fired."""
+
+    FAULTABLE = ("write", "truncate", "fsync", "replace", "sync_dir")
+
+    def __init__(self, base=None):
+        if base is None:
+            from .journal import OS_FS
+
+            base = OS_FS
+        self.base = base
+        self._lock = threading.Lock()
+        self._armed: Dict[str, List] = {}  # op -> [errno_name, remaining]
+
+    # -- arming ---------------------------------------------------------------
+
+    def arm(self, op: str, err: str = "EIO", count: int = -1) -> None:
+        """Fail the next ``count`` calls of ``op`` (-1 = until cleared)
+        with the named errno (``"EIO"``, ``"ENOSPC"``, ...)."""
+        if op not in self.FAULTABLE:
+            raise ValueError(f"unknown faultable op {op!r}")
+        if not hasattr(_errno, err):
+            raise ValueError(f"unknown errno name {err!r}")
+        with self._lock:
+            self._armed[op] = [err, int(count)]
+
+    def clear(self, op: Optional[str] = None) -> None:
+        with self._lock:
+            if op is None:
+                self._armed.clear()
+            else:
+                self._armed.pop(op, None)
+
+    def armed(self) -> Dict[str, Tuple[str, int]]:
+        with self._lock:
+            return {op: (e, n) for op, (e, n) in self._armed.items()}
+
+    def _maybe_fail(self, op: str) -> None:
+        if not self._armed:  # unarmed fast path, no lock
+            return
+        with self._lock:
+            entry = self._armed.get(op)
+            if entry is None:
+                return
+            err, remaining = entry
+            if remaining == 0:
+                self._armed.pop(op, None)
+                return
+            if remaining > 0:
+                entry[1] = remaining - 1
+                if entry[1] == 0:
+                    self._armed.pop(op, None)
+        from .. import obs
+
+        obs.count("chaos.injected", labels={"kind": f"disk_{op}"})
+        code = getattr(_errno, err)
+        raise OSError(code, f"injected {err} on {op}")
+
+    # -- the OsFS interface ---------------------------------------------------
+
+    def open(self, path: str, mode: str):
+        f = self.base.open(path, mode)
+        return _FaultyFile(self, f)
+
+    def fsync(self, f) -> None:
+        self._maybe_fail("fsync")
+        self.base.fsync(f._inner if isinstance(f, _FaultyFile) else f)
+
+    def replace(self, src: str, dst: str) -> None:
+        self._maybe_fail("replace")
+        self.base.replace(src, dst)
+
+    def sync_dir(self, path: str) -> None:
+        self._maybe_fail("sync_dir")
+        self.base.sync_dir(path)
+
+    def exists(self, path: str) -> bool:
+        return self.base.exists(path)
+
+    def getsize(self, path: str) -> int:
+        return self.base.getsize(path)
+
+    def read_bytes(self, path: str) -> bytes:
+        return self.base.read_bytes(path)
+
+    def makedirs(self, path: str) -> None:
+        self.base.makedirs(path)
+
+    def remove(self, path: str) -> None:
+        self.base.remove(path)
+
+    def lock(self, f) -> None:
+        self.base.lock(f._inner if isinstance(f, _FaultyFile) else f)

@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import contextlib
 import posixpath
+import sys
 import threading
 from typing import Dict, List, Optional
 
@@ -124,11 +125,17 @@ class DurableDocument:
         # more would strand dependents. compact() repairs (the snapshot
         # carries the full history) and clears it.
         self._broken = False
-        # >0 while inside a wrapped ack-point call: per-change fsyncs are
-        # deferred to ONE policy_sync at the ack boundary (same durability
-        # guarantee — on disk before the call returns — minus N-1 fsyncs
-        # for an N-change merge/sync batch)
-        self._ack_depth = 0
+        # per-THREAD ack-scope bookkeeping (depth + whether the current
+        # scope chain journaled anything). Depth is thread-local on
+        # purpose: a scope's deferred boundary fsync must be paid by the
+        # thread that owns the scope — were the depth shared, a scope
+        # exiting while another thread's scope is still open would ack
+        # with its fsync delegated to that OTHER thread, and a fault in
+        # that later fsync could strike after this ack already returned
+        # (the chaos suite proves exactly this). Concurrent boundary
+        # fsyncs stay cheap: the journal's group-commit combiner
+        # collapses them.
+        self._tl_scope = threading.local()
         self.device_doc = None  # set by open(device=True)
         # cluster replication gate (cluster/replication.py): when set,
         # the OUTERMOST ack-scope exit blocks until enough followers
@@ -293,6 +300,11 @@ class DurableDocument:
             return _acked
         return attr
 
+    @property
+    def _ack_depth(self) -> int:
+        """Depth of the CURRENT THREAD's ack-scope chain (0 = outside)."""
+        return getattr(self._tl_scope, "depth", 0)
+
     @contextlib.contextmanager
     def ack_scope(self):
         """Context manager marking one ack boundary: per-change fsyncs
@@ -300,29 +312,48 @@ class DurableDocument:
         check) on exit — even on error, whatever DID enter history must be
         durable at ack. The sync session wraps each received message in
         this when the document is durable."""
-        self._ack_depth += 1
+        tl = self._tl_scope
+        tl.depth = getattr(tl, "depth", 0) + 1
+        if tl.depth == 1:
+            tl.appended = False
         try:
             yield
         finally:
-            self._ack_depth -= 1
+            tl.depth -= 1
             # a double fault in append() can poison the journal closed
             # while the original I/O error is still unwinding — syncing
             # then would only mask it with 'journal is closed'.
-            # Nested scopes defer to the OUTERMOST exit: the serving
-            # layer wraps a whole drained batch of wrapped ack calls in
-            # one scope, and that group pays one fsync (group commit)
-            if self._ack_depth == 0 and not self._journal.closed:
+            # Nested scopes defer to the OUTERMOST exit ON THIS THREAD:
+            # the serving layer wraps a whole drained batch of wrapped
+            # ack calls in one scope, and that group pays one fsync
+            # (group commit)
+            if tl.depth == 0 and not self._journal.closed:
                 self._journal.policy_sync()
                 if self.replication_gate is not None:
-                    # quorum before ack: the ack_replicas contract ("on
-                    # K+1 disks when acked") overrides a lazier fsync
-                    # policy — force local durability so the gate's
-                    # target covers this batch, then wait for the
-                    # follower copies the contract promises
+                    # quorum before ack: the ack_replicas contract
+                    # ("on K+1 disks when acked") overrides a lazier
+                    # fsync policy — force local durability so the
+                    # gate's target covers this batch, then wait for
+                    # the follower copies the contract promises
                     self._journal.sync()
                     self.replication_gate()
                 self.maybe_compact()
                 self._export_doc_gauges()
+            elif (
+                tl.depth == 0
+                and self._journal.poisoned
+                and getattr(tl, "appended", False)
+                and sys.exc_info()[0] is None
+            ):
+                # ANOTHER thread's failed fsync poisoned the journal
+                # while this scope's appends were pending: they can
+                # never be made durable, so exiting cleanly here would
+                # ack un-fsynced writes. Every covered waiter errors —
+                # unless an exception is already unwinding (masking the
+                # original fault helps nobody). A scope that journaled
+                # nothing (a read batch on the degraded doc) still
+                # serves.
+                raise self._journal._closed_error()
 
     def _export_doc_gauges(self) -> None:
         """Per-doc accounting at the ack boundary: journal footprint and
@@ -348,14 +379,17 @@ class DurableDocument:
         """Change listener (core/document.py ``_update_history``): journal
         every change the moment it enters history, before the mutating
         call acks to its caller."""
-        from .journal import JournalError
+        from .journal import JournalPoisoned
 
         if self._broken:
             # refusing BEFORE the append keeps every later change un-acked
-            # while memory is ahead of disk — no silently stranded deps
-            raise JournalError(
-                "durable document out of sync with its journal after a "
-                "failed append; compact() or reopen to recover"
+            # while memory is ahead of disk — no silently stranded deps.
+            # JournalPoisoned is retriable: the doc is degraded (read-only)
+            # until a compaction or reopen restores it, and in a cluster a
+            # failover can restore service before that
+            raise JournalPoisoned(
+                "durable document degraded: out of sync with its journal "
+                "after a failed append; compact() or reopen to recover"
             )
         raw = stored.raw_bytes
         if raw is None:
@@ -373,6 +407,7 @@ class DurableDocument:
             self._journal.append(
                 REC_CHANGE, raw, auto_sync=self._ack_depth == 0
             )
+            self._tl_scope.appended = True
         except Exception:
             # the change is already in history (listeners fire after the
             # bookkeeping): memory is now ahead of disk. Poison until a
@@ -383,6 +418,16 @@ class DurableDocument:
     @property
     def journal(self) -> Journal:
         return self._journal
+
+    @property
+    def degraded(self) -> bool:
+        """True while this document cannot ack writes: a journal append
+        failed after its change entered history (memory ahead of disk),
+        or a failed fsync poisoned the journal outright. Reads still
+        serve; mutations raise the retriable ``JournalPoisoned`` until
+        ``compact()`` (fresh snapshot re-establishes disk >= memory,
+        reviving a poisoned journal) or a reopen recovers."""
+        return self._broken or self._journal.poisoned
 
     @property
     def meta(self) -> Dict[str, bytes]:
@@ -396,6 +441,7 @@ class DurableDocument:
         self._journal.append(
             REC_META, encode_meta(name, blob), auto_sync=self._ack_depth == 0
         )
+        self._tl_scope.appended = True
 
     def sync(self) -> None:
         """Force-fsync the journal regardless of policy."""
@@ -418,7 +464,9 @@ class DurableDocument:
         # responsibility, as everywhere else.)
         try:
             commit = getattr(self._host, "commit", None)
-            if callable(commit):
+            # a degraded doc cannot journal the commit anyway — raising
+            # out of close() would only block the reopen that repairs it
+            if callable(commit) and not self.degraded:
                 commit()  # journals through the listener; close syncs below
         finally:
             # even if that last commit fails, the journal handle (and its
@@ -440,6 +488,11 @@ class DurableDocument:
         background mode the actual compaction runs on a daemon thread
         under this document's lock, so it never stalls the ack path."""
         j = self._journal
+        if j.closed:
+            # a poisoned journal never auto-compacts: recovery from a
+            # disk fault is an EXPLICIT compact()/reopen (the fault may
+            # still be live — ENOSPC does not clear itself)
+            return False
         if (
             j.record_count <= self.compact_max_records
             and j.size_bytes <= self.compact_max_bytes
@@ -496,10 +549,11 @@ class DurableDocument:
         re-appended so they survive). Every step durable before the next
         — the orderings the crash suite proves are exactly these."""
         with self.lock:
-            if self._compacting or self._closed or self._journal.closed:
-                # a poisoned-closed journal cannot be truncated: only a
-                # reopen recovers (the snapshot-repair path needs a live
-                # journal)
+            if (
+                self._compacting
+                or self._closed
+                or (self._journal.closed and not self._journal.poisoned)
+            ):
                 return False
             live = self._core._live_transaction()
             if live is not None and live.pending_ops():
@@ -525,7 +579,14 @@ class DurableDocument:
                         self._fs.replace(tmp, snap)
                         self._fs.sync_dir(self.path)
                     with obs.span("compact.truncate"):
-                        self._journal.truncate()
+                        if self._journal.poisoned:
+                            # the snapshot above covers the FULL history,
+                            # so the unknowable on-disk journal tail can
+                            # be discarded: re-acquire the file + flock
+                            # as an empty journal (hooks survive)
+                            self._journal.revive()
+                        else:
+                            self._journal.truncate()
                         for name, blob in self._meta.items():
                             self._journal.append(
                                 REC_META, encode_meta(name, blob),

@@ -73,6 +73,18 @@ class JournalError(Exception):
     pass
 
 
+class JournalPoisoned(JournalError):
+    """The journal closed itself after an unrecoverable I/O fault (a
+    failed fsync, or a failed append whose cleanup also failed): nothing
+    more will be acked through it until the document is compacted or
+    reopened. Marked retriable — in a cluster the covering document
+    answers requests with this error while a failover, reopen, or
+    compaction restores service, so clients should back off and retry
+    rather than treat the write as permanently rejected."""
+
+    retriable = True
+
+
 class OsFS:
     """The real filesystem, behind the narrow interface the durable layer
     uses (so storage/crashsim.py can substitute a fault-injecting one)."""
@@ -305,6 +317,9 @@ class Journal:
         # the group-commit leader attaches them as span links, so one
         # combined fsync is attributable to every request it covered
         self._pending_traces: List[tuple] = []
+        # non-None once an I/O fault closed the journal for good; names
+        # the faulting operation (journal.poisoned{reason})
+        self.poisoned_reason: Optional[str] = None
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -395,9 +410,42 @@ class Journal:
 
     @property
     def closed(self) -> bool:
-        """True once closed (explicitly, or poisoned by a double fault in
-        ``append``): every further append/sync raises."""
+        """True once closed (explicitly, or poisoned by an fsync failure
+        or a double fault in ``append``): every further append/sync
+        raises."""
         return self._f is None
+
+    @property
+    def poisoned(self) -> bool:
+        """True when an unrecoverable I/O fault closed the journal (as
+        opposed to an orderly ``close()``)."""
+        return self.poisoned_reason is not None
+
+    def _poison_locked(self, reason: str) -> None:
+        """Close the journal for good after an unrecoverable I/O fault
+        (``_cond`` held). Every waiter parked in the fsync combiner wakes
+        and raises; nothing is ever acked through this journal again —
+        the only recovery is compaction (fresh snapshot) or a reopen."""
+        if self._f is None:
+            return
+        try:
+            self._f.close()
+        except Exception:  # noqa: BLE001 — the fd is lost either way
+            pass
+        self._f = None
+        self.poisoned_reason = reason
+        obs.registry.gauge("serve.flocks_held").add(-1)
+        obs.count("journal.poisoned", labels={"reason": reason})
+        obs.event("journal.poisoned", path=self.path, reason=reason)
+        self._cond.notify_all()
+
+    def _closed_error(self) -> JournalError:
+        if self.poisoned_reason is not None:
+            return JournalPoisoned(
+                f"journal poisoned by a failed {self.poisoned_reason}; "
+                "compact or reopen the document to recover"
+            )
+        return JournalError("journal is closed")
 
     def close(self) -> None:
         if self._f is None:
@@ -452,7 +500,7 @@ class Journal:
         with obs.span("journal.append", bytes=len(rec)):
             with self._cond:
                 if self._f is None:
-                    raise JournalError("journal is closed")
+                    raise self._closed_error()
                 try:
                     self._f.write(rec)
                 except Exception:
@@ -464,10 +512,7 @@ class Journal:
                     try:
                         self._f.truncate(self._size)
                     except Exception:
-                        self._f.close()
-                        self._f = None  # closed journal: appends raise
-                        obs.registry.gauge("serve.flocks_held").add(-1)
-                        self._cond.notify_all()  # wake fsync waiters
+                        self._poison_locked("append")
                     raise
                 self._size += len(rec)
                 self._count += 1
@@ -514,7 +559,7 @@ class Journal:
         into ~2 physical fsyncs instead of N."""
         with self._cond:
             if self._f is None:
-                raise JournalError("journal is closed")
+                raise self._closed_error()
             target = self._append_seq
             if self._synced_seq >= target:
                 return
@@ -525,7 +570,7 @@ class Journal:
                     obs.count("journal.fsync_combined")
                     return
                 if self._f is None:
-                    raise JournalError("journal is closed")
+                    raise self._closed_error()
             self._fsync_leader = True
             covering = self._append_seq
             f = self._f
@@ -535,9 +580,17 @@ class Journal:
                           labels={"policy": self.fsync_policy}):
                 self.fs.fsync(f)
         except Exception:
+            # a failed fsync POISONS the journal — no retry. After EIO the
+            # kernel may have dropped the dirty pages, so a later fsync
+            # can "succeed" while the records it claims to cover were
+            # never written (the classic fsync-gate). Closing the file
+            # here converts every combined-fsync waiter parked above into
+            # an error too: an un-fsynced ack is no ack, for every caller
+            # this fsync covered. Recovery is compact() (fresh snapshot
+            # re-establishes disk >= memory) or a reopen.
             with self._cond:
                 self._fsync_leader = False
-                self._cond.notify_all()
+                self._poison_locked("fsync")
             raise
         with self._cond:
             batch = covering - self._synced_seq
@@ -556,13 +609,13 @@ class Journal:
         fsynced before return so stale records cannot resurrect."""
         with self._cond:
             if self._f is None:
-                raise JournalError("journal is closed")
+                raise self._closed_error()
             # wait out any in-flight fsync: its covering seq refers to
             # the pre-truncation file
             while self._fsync_leader:
                 self._cond.wait()
                 if self._f is None:
-                    raise JournalError("journal is closed")
+                    raise self._closed_error()
             self._f.truncate(len(JOURNAL_MAGIC))
             self._f.seek(len(JOURNAL_MAGIC))
             with obs.span("journal.fsync",
@@ -571,3 +624,40 @@ class Journal:
             self._synced_seq = self._append_seq
             self._size = len(JOURNAL_MAGIC)
             self._count = 0
+
+    def revive(self) -> None:
+        """Re-open a POISONED journal in place as an empty journal.
+
+        Only the compaction path may call this, and only after a snapshot
+        covering the full in-memory history is durable on disk — the
+        on-disk journal's contents past the poison point are unknowable
+        (the failed fsync may or may not have persisted them), so they
+        are discarded wholesale and the snapshot becomes the only truth.
+        The Journal object (and the replication hooks installed on it)
+        survives; the file handle and flock are re-acquired. Counters:
+        the durable acked prefix jumps to cover every append — the
+        snapshot now holds them all."""
+        with self._cond:
+            if self._f is not None:
+                return  # live journal: nothing to revive
+            if self.poisoned_reason is None:
+                raise JournalError("cannot revive an orderly-closed journal")
+            # append-mode first (never truncates a file another process
+            # may own), lock, THEN cut back to a bare header
+            f = self.fs.open(self.path, "ab")
+            try:
+                self.fs.lock(f)
+                f.truncate(len(JOURNAL_MAGIC))
+                self.fs.fsync(f)
+            except Exception:
+                f.close()
+                raise
+            self._f = f
+            self.poisoned_reason = None
+            self._size = len(JOURNAL_MAGIC)
+            self._count = 0
+            self._synced_seq = self._append_seq
+            self._fsync_leader = False
+            obs.registry.gauge("serve.flocks_held").add(1)
+            obs.count("journal.revived")
+            self._cond.notify_all()

@@ -1,0 +1,574 @@
+"""Chaos fabric: the TCP fault proxy, live disk-fault injection, the
+degraded-document semantics, and the cluster paths the faults force.
+
+Three layers: proxy units over a local echo server (passthrough,
+asymmetric partition, sever/heal, seeded determinism), disk-fault
+semantics on a durable doc (ENOSPC append, fsync EIO poison, compact
+revive, reopen), and in-process leader/follower pairs with the fault
+proxy on the replication link (ack-gate timeout under partition,
+retention-overflow snapshot catch-up). The full multi-process soak
+lives in scripts/ci/run_chaos.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from automerge_tpu import obs
+from automerge_tpu.api import AutoDoc
+from automerge_tpu.cluster import ChaosProxy, ChaosSchedule, ClusterNode
+from automerge_tpu.rpc import RpcServer
+from automerge_tpu.storage.crashsim import FaultyFS
+from automerge_tpu.storage.journal import JournalPoisoned
+from automerge_tpu.types import ActorId
+
+
+def actor(i):
+    return ActorId(bytes([i]) * 16)
+
+
+def wait_until(pred, timeout=10.0, interval=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# -- proxy units --------------------------------------------------------------
+
+
+class EchoServer:
+    """A line-echo TCP server for proxy tests."""
+
+    def __init__(self):
+        self.ls = socket.socket()
+        self.ls.bind(("127.0.0.1", 0))
+        self.ls.listen(16)
+        self.received = []
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    @property
+    def target(self):
+        return "127.0.0.1:%d" % self.ls.getsockname()[1]
+
+    def _accept(self):
+        while True:
+            try:
+                c, _ = self.ls.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._pump, args=(c,),
+                             daemon=True).start()
+
+    def _pump(self, c):
+        while True:
+            try:
+                d = c.recv(4096)
+            except OSError:
+                return
+            if not d:
+                return
+            self.received.append(d)
+            try:
+                c.sendall(d)
+            except OSError:
+                return
+
+    def close(self):
+        self.ls.close()
+
+
+@pytest.fixture
+def echo():
+    srv = EchoServer()
+    yield srv
+    srv.close()
+
+
+def _connect(proxy):
+    host, _, port = proxy.address.rpartition(":")
+    s = socket.create_connection((host, int(port)), timeout=5)
+    s.settimeout(5)
+    return s
+
+
+def test_proxy_transparent_passthrough(echo):
+    p = ChaosProxy(echo.target, seed=1).start()
+    try:
+        s = _connect(p)
+        s.sendall(b"hello proxy\n")
+        assert s.recv(100) == b"hello proxy\n"
+        s.close()
+    finally:
+        p.stop()
+
+
+def test_proxy_asymmetric_partition_and_heal(echo):
+    """Black-holing one direction swallows bytes without resetting the
+    connection — the far side sees silence. The other direction still
+    flows, and heal() restores both."""
+    p = ChaosProxy(echo.target, seed=2).start()
+    try:
+        s = _connect(p)
+        s.sendall(b"before\n")
+        assert s.recv(100) == b"before\n"
+        # server->client black-holed: the request ARRIVES (the server
+        # echoes into the void), the response never returns
+        p.partition("s2c")
+        n_seen = len(echo.received)
+        s.sendall(b"void\n")
+        wait_until(lambda: len(echo.received) > n_seen,
+                   msg="request delivery through partition")
+        s.settimeout(0.3)
+        with pytest.raises(socket.timeout):
+            s.recv(100)
+        p.heal()
+        s.settimeout(5)
+        s.sendall(b"after\n")
+        assert s.recv(100) == b"after\n"
+        kinds = obs.counter_values("chaos.injected", "kind")
+        assert kinds.get("blackhole_s2c", 0) >= 1
+        assert kinds.get("partition_s2c", 0) >= 1
+        s.close()
+    finally:
+        p.stop()
+
+
+def test_proxy_sever_cuts_and_refuses_until_heal(echo):
+    p = ChaosProxy(echo.target, seed=3).start()
+    try:
+        s = _connect(p)
+        s.sendall(b"x\n")
+        assert s.recv(100) == b"x\n"
+        p.sever()
+        # the live connection resets (possibly after one send); a fresh
+        # one is refused (accepted then immediately closed)
+        with pytest.raises(OSError):
+            for _ in range(20):
+                s.sendall(b"y\n")
+                if s.recv(100) == b"":
+                    raise OSError("peer closed")
+                time.sleep(0.05)
+        s2 = _connect(p)
+        s2.settimeout(1)
+        assert s2.recv(10) == b""
+        s2.close()
+        p.heal()
+        s3 = _connect(p)
+        s3.sendall(b"z\n")
+        assert s3.recv(100) == b"z\n"
+        s3.close()
+        wait_until(lambda: p.live_connections() == 1,
+                   msg="severed conns reaped")
+    finally:
+        p.stop()
+        wait_until(lambda: p.live_connections() == 0,
+                   msg="no leaked proxied connections")
+
+
+def test_proxy_seeded_faults_are_deterministic(echo):
+    """Two proxies with the same seed drop the same chunks — the replay
+    property CHAOS_SEED relies on."""
+
+    def run(seed):
+        p = ChaosProxy(echo.target, seed=seed).start()
+        p.set_policy("c2s", drop=0.5)
+        got = []
+        try:
+            s = _connect(p)
+            for i in range(20):
+                n0 = len(echo.received)
+                s.sendall(b"m%02d\n" % i)
+                time.sleep(0.03)
+                got.append(len(echo.received) > n0)
+            s.close()
+        finally:
+            p.stop()
+        return got
+
+    a = run(1234)
+    b = run(1234)
+    c = run(4321)
+    assert a == b
+    assert True in a and False in a  # both outcomes actually occurred
+    assert c != a  # and the seed matters
+
+
+def test_chaos_schedule_runs_in_order_and_records_errors():
+    ran = []
+    sched = ChaosSchedule()
+    sched.at(0.05, "b", lambda: ran.append("b"))
+    sched.at(0.0, "a", lambda: ran.append("a"))
+    sched.at(0.1, "boom", lambda: 1 / 0)
+    assert sched.plan() == [(0.0, "a"), (0.05, "b"), (0.1, "boom")]
+    sched.start()
+    assert sched.join(timeout=5)
+    assert ran == ["a", "b"]
+    assert sched.executed == [(0.0, "a"), (0.05, "b"), (0.1, "boom")]
+    assert sched.errors and sched.errors[0][0] == "boom"
+
+
+# -- live disk faults on a durable document -----------------------------------
+
+
+def test_enospc_append_degrades_then_compact_recovers(tmp_path):
+    fs = FaultyFS()
+    d = str(tmp_path / "doc")
+    dd = AutoDoc.open(d, fs=fs, fsync="always", actor=actor(1))
+    dd.put("_root", "a", 1)
+    dd.commit()
+
+    fs.arm("write", "ENOSPC")
+    dd.put("_root", "b", 2)
+    with pytest.raises(OSError):
+        dd.commit()
+    assert dd.degraded and not dd.journal.poisoned  # broken, journal live
+    # every further mutation refuses with the retriable error, BEFORE
+    # touching the disk (no silently stranded dependents)
+    dd.put("_root", "c", 3)
+    with pytest.raises(JournalPoisoned) as ei:
+        dd.commit()
+    assert ei.value.retriable is True
+
+    fs.clear()
+    assert dd.compact() is True  # fresh snapshot re-establishes disk>=memory
+    assert not dd.degraded
+    dd.put("_root", "d", 4)
+    dd.commit()
+    dd.close()
+    dd2 = AutoDoc.open(d)
+    assert dd2.hydrate()["a"] == 1 and dd2.hydrate()["d"] == 4
+    dd2.close()
+
+
+def test_fsync_eio_poisons_and_reopen_replays_acked_prefix(tmp_path):
+    obs.reset_all()
+    fs = FaultyFS()
+    d = str(tmp_path / "doc")
+    dd = AutoDoc.open(d, fs=fs, fsync="always", actor=actor(1))
+    for i in range(4):
+        dd.put("_root", f"k{i}", i)
+        dd.commit()
+
+    fs.arm("fsync", "EIO", count=1)
+    dd.put("_root", "doomed", 1)
+    with pytest.raises(OSError):
+        dd.commit()
+    # poisoned: no retry-after-fsync-failure — the journal closed itself
+    assert dd.journal.poisoned and dd.journal.poisoned_reason == "fsync"
+    assert obs.counter_values("journal.poisoned", "reason") == {"fsync": 1}
+    assert obs.counter_values("chaos.injected", "kind") == {"disk_fsync": 1}
+    with pytest.raises(JournalPoisoned):
+        dd.put("_root", "more", 1)
+        dd.commit()
+    # reads on the degraded doc still serve
+    assert dd.hydrate()["k3"] == 3
+    dd.close()
+
+    # the fault is cleared (count=1 consumed): a reopen recovers, and
+    # every write acked BEFORE the fault is present
+    dd2 = AutoDoc.open(d, actor=actor(2))
+    got = dd2.hydrate()
+    for i in range(4):
+        assert got[f"k{i}"] == i
+    dd2.put("_root", "recovered", 1)
+    dd2.commit()
+    dd2.close()
+
+
+def test_poisoned_journal_revive_keeps_flock_accounting(tmp_path):
+    """Poison then compact-revive: the flocks_held gauge returns to its
+    pre-fault level (the chaos soak's leak invariant) and the journal
+    accepts appends again."""
+    fs = FaultyFS()
+    g = obs.registry.gauge("serve.flocks_held")
+    base = g.value
+    dd = AutoDoc.open(str(tmp_path / "doc"), fs=fs, fsync="always",
+                      actor=actor(1))
+    assert g.value == base + 1
+    fs.arm("fsync", "EIO", count=1)
+    dd.put("_root", "x", 1)
+    with pytest.raises(OSError):
+        dd.commit()
+    assert g.value == base  # poison released the handle + flock
+    assert dd.compact() is True
+    assert g.value == base + 1  # revive re-acquired them
+    dd.put("_root", "y", 2)
+    dd.commit()
+    dd.close()
+    assert g.value == base
+
+
+# -- the RPC surface ----------------------------------------------------------
+
+
+def test_rpc_chaos_disk_degraded_retriable_and_reopen(tmp_path, monkeypatch):
+    monkeypatch.setenv("AUTOMERGE_TPU_CHAOS", "1")
+    rpc = RpcServer(durable_dir=str(tmp_path))
+    h = rpc.handle({"id": 1, "method": "openDurable",
+                    "params": {"name": "doc1"}})["result"]["doc"]
+    rpc.handle({"id": 2, "method": "put", "params": {
+        "doc": h, "obj": "_root", "prop": "a", "value": 1}})
+    assert "error" not in rpc.handle(
+        {"id": 3, "method": "commit", "params": {"doc": h}})
+
+    r = rpc.handle({"id": 4, "method": "chaosDisk", "params": {
+        "name": "doc1", "op": "fsync", "err": "EIO", "count": 1}})
+    assert r["result"]["armed"] == {"fsync": ["EIO", 1]}
+
+    rpc.handle({"id": 5, "method": "put", "params": {
+        "doc": h, "obj": "_root", "prop": "b", "value": 2}})
+    r = rpc.handle({"id": 6, "method": "commit", "params": {"doc": h}})
+    assert r["error"]["type"] == "OSError", r
+    # degraded mode is visible, and further writes carry retriable: true
+    info = rpc.handle({"id": 7, "method": "durableInfo",
+                       "params": {"doc": h}})["result"]
+    assert info["degraded"] is True and info["poisoned"] == "fsync"
+    rpc.handle({"id": 8, "method": "put", "params": {
+        "doc": h, "obj": "_root", "prop": "c", "value": 3}})
+    r = rpc.handle({"id": 9, "method": "commit", "params": {"doc": h}})
+    assert r["error"]["type"] == "JournalPoisoned"
+    assert r["error"]["retriable"] is True
+    # reads still answer on the degraded doc
+    assert rpc.handle({"id": 10, "method": "get", "params": {
+        "doc": h, "obj": "_root", "prop": "a"}})["result"] == 1
+
+    # durableReopen recovers IN PLACE: the handle stays valid
+    r = rpc.handle({"id": 11, "method": "durableReopen",
+                    "params": {"name": "doc1"}})["result"]
+    assert r["doc"] == h and r["reopened"] is True
+    rpc.handle({"id": 12, "method": "put", "params": {
+        "doc": h, "obj": "_root", "prop": "d", "value": 4}})
+    assert "error" not in rpc.handle(
+        {"id": 13, "method": "commit", "params": {"doc": h}})
+    info = rpc.handle({"id": 14, "method": "durableInfo",
+                       "params": {"doc": h}})["result"]
+    assert info["degraded"] is False and info["poisoned"] is None
+    rpc.close_durables()
+
+
+def test_rpc_chaos_disk_requires_env(tmp_path):
+    rpc = RpcServer(durable_dir=str(tmp_path))
+    assert not rpc.chaos_enabled
+    rpc.handle({"id": 1, "method": "openDurable",
+                "params": {"name": "doc1"}})
+    r = rpc.handle({"id": 2, "method": "chaosDisk", "params": {
+        "name": "doc1", "op": "fsync"}})
+    assert "error" in r and "AUTOMERGE_TPU_CHAOS" in r["error"]["message"]
+    rpc.close_durables()
+
+
+# -- cluster under chaos (in-process) -----------------------------------------
+
+
+class Client:
+    """Minimal JSON-RPC socket client (same idiom as test_cluster.py)."""
+
+    def __init__(self, address):
+        self.sock = socket.create_connection(address)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.f = self.sock.makefile("r")
+        self.rid = 0
+
+    def call(self, method, allow_error=False, **params):
+        self.rid += 1
+        self.sock.sendall((json.dumps(
+            {"id": self.rid, "method": method, "params": params}
+        ) + "\n").encode())
+        resp = json.loads(self.f.readline())
+        if not allow_error:
+            assert "error" not in resp, resp
+        return resp if "error" in resp else resp.get("result")
+
+    def close(self):
+        self.sock.close()
+
+
+def start_node(tmp, name, **kw):
+    d = os.path.join(str(tmp), name)
+    node = ClusterNode(
+        node_id=name, host="127.0.0.1", port=0, durable_dir=d, **kw
+    )
+    node.start()
+    return node
+
+
+def test_ack_gate_errors_not_deadlocks_under_asymmetric_partition(
+        tmp_path, monkeypatch):
+    """The replication link black-holed in the response direction: the
+    quorum gate must time out into a RETRIABLE error (never hang, never
+    ack), and healing the link resumes acks and convergence."""
+    monkeypatch.setenv("AUTOMERGE_TPU_CLUSTER_ACK_TIMEOUT", "0.6")
+    monkeypatch.setenv("AUTOMERGE_TPU_REPL_IO_TIMEOUT", "0.5")
+    fol = start_node(tmp_path, "f1", role="follower")
+    proxy = ChaosProxy("%s:%d" % fol.address, seed=5).start()
+    led = start_node(tmp_path, "l1", role="leader",
+                     replicate_to=[proxy.address], ack_replicas=1)
+    try:
+        c = Client(led.address)
+        d = c.call("openDurable", name="docA")["doc"]
+        c.call("put", doc=d, obj="_root", prop="k0", value=0)
+        c.call("commit", doc=d)  # healthy quorum ack through the proxy
+
+        proxy.partition("s2c")
+        t0 = time.monotonic()
+        c.call("put", doc=d, obj="_root", prop="k1", value=1)
+        r = c.call("commit", doc=d, allow_error=True)
+        dt = time.monotonic() - t0
+        assert "error" in r, r
+        assert "ReplicationTimeout" in r["error"]["type"], r
+        assert r["error"]["retriable"] is True, r
+        assert dt < 10, f"gate hung for {dt}s"
+
+        proxy.heal()
+        # the link self-heals and the pending write replicates; retrying
+        # the commit eventually acks
+        deadline = time.monotonic() + 20
+        while True:
+            r = c.call("commit", doc=d, allow_error=True)
+            if not isinstance(r, dict) or "error" not in r:
+                break
+            assert time.monotonic() < deadline, r
+            time.sleep(0.1)
+        fc = Client(fol.address)
+        wait_until(
+            lambda: (fc.call("clusterStatus")["docs"].get("docA") or {})
+            .get("acked", 0) >= 2,
+            timeout=15, msg="follower holding the healed writes")
+        fc.close()
+        c.close()
+    finally:
+        proxy.stop()
+        led.stop()
+        fol.stop()
+
+
+def test_slow_follower_catches_up_via_forced_snapshot(tmp_path, monkeypatch):
+    """A follower cut off while the leader keeps writing falls off the
+    (tiny) retention buffer; reconnecting must recover through
+    snapshot+tail — counted in cluster.catchup_snapshots — with no
+    operator involved."""
+    monkeypatch.setenv("AUTOMERGE_TPU_REPL_RETAIN_BYTES", "256")
+    monkeypatch.setenv("AUTOMERGE_TPU_REPL_IO_TIMEOUT", "0.5")
+    obs.reset_all()
+    fol = start_node(tmp_path, "f1", role="follower")
+    proxy = ChaosProxy("%s:%d" % fol.address, seed=6).start()
+    led = start_node(tmp_path, "l1", role="leader",
+                     replicate_to=[proxy.address])  # no ack gate: full rate
+    try:
+        c = Client(led.address)
+        d = c.call("openDurable", name="docA")["doc"]
+        c.call("put", doc=d, obj="_root", prop="k0", value=0)
+        c.call("commit", doc=d)
+        fc = Client(fol.address)
+        wait_until(
+            lambda: (fc.call("clusterStatus")["docs"].get("docA") or {})
+            .get("cursor") is not None,
+            msg="initial replication")
+
+        proxy.partition("both")
+        for i in range(1, 40):  # far more than 256 retained bytes
+            c.call("put", doc=d, obj="_root", prop=f"k{i}", value=i)
+            c.call("commit", doc=d)
+        proxy.heal()
+
+        target = led.rpc.hub.lsn("docA")
+        wait_until(
+            lambda: (fc.call("clusterStatus")["docs"]["docA"]["cursor"]
+                     or {}).get("lsn", 0) >= target,
+            timeout=20, msg="follower converging past the trimmed tail")
+        kinds = obs.counter_values("cluster.catchup_snapshots", "reason")
+        assert sum(kinds.values()) >= 1, kinds
+        # and the follower's state matches the leader byte-for-byte
+        # (replHarvest is the follower-ok full-state surface)
+        assert (fc.call("replHarvest", name="docA")["snapshot"]
+                == c.call("replHarvest", name="docA")["snapshot"])
+        fc.close()
+        c.close()
+    finally:
+        proxy.stop()
+        led.stop()
+        fol.stop()
+
+
+# -- the reference retry client (clients/python) ------------------------------
+
+
+def _client_mod():
+    import importlib.util
+    import pathlib
+
+    path = (pathlib.Path(__file__).parent.parent / "clients" / "python"
+            / "amtpu_client.py")
+    spec = importlib.util.spec_from_file_location("amtpu_client", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_retry_client_rides_out_garbled_frames_and_retriable_errors():
+    """The reference client's contract: result or RpcError, never a raw
+    socket/JSON exception — garbled frames and retriable errors redial
+    and retry under the deadline budget."""
+    amtpu = _client_mod()
+    ls = socket.socket()
+    ls.bind(("127.0.0.1", 0))
+    ls.listen(8)
+    script = ["garbage", "retriable", "ok"]
+
+    def serve():
+        for behavior in script:
+            c, _ = ls.accept()
+            f = c.makefile("r")
+            req = json.loads(f.readline())
+            if behavior == "garbage":
+                c.sendall(b"{not json at all\n")
+            elif behavior == "retriable":
+                c.sendall((json.dumps({"id": req["id"], "error": {
+                    "type": "Unavailable", "retriable": True,
+                    "message": "try later"}}) + "\n").encode())
+                # next request arrives on the SAME conn and succeeds
+                req = json.loads(f.readline())
+                c.sendall((json.dumps(
+                    {"id": req["id"], "result": "done"}) + "\n").encode())
+                c.close()
+                return
+            c.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    c = amtpu.RetryingClient(
+        "127.0.0.1:%d" % ls.getsockname()[1], deadline_s=10, backoff_s=0.01)
+    assert c.call("anything") == "done"
+    assert c.last.attempts == 3, c.last.attempts
+    assert c.last.blocked_s > 0
+    c.close()
+    ls.close()
+
+
+def test_retry_client_deadline_bounds_a_blackholed_response():
+    """A peer that receives but never answers (the asymmetric partition
+    shape) must cost at most the deadline budget, not hang forever."""
+    amtpu = _client_mod()
+    ls = socket.socket()
+    ls.bind(("127.0.0.1", 0))
+    ls.listen(8)
+    threading.Thread(
+        target=lambda: [ls.accept() for _ in range(10)],
+        daemon=True).start()  # accept, read nothing, answer nothing
+    c = amtpu.RetryingClient(
+        "127.0.0.1:%d" % ls.getsockname()[1], deadline_s=0.8,
+        backoff_s=0.01)
+    t0 = time.monotonic()
+    with pytest.raises(amtpu.Deadline):
+        c.call("hello")
+    dt = time.monotonic() - t0
+    assert dt < 5.0, f"deadline not enforced: blocked {dt}s"
+    c.close()
+    ls.close()

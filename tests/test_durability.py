@@ -844,3 +844,85 @@ def test_cost_ratio_defers_compaction_for_large_snapshots(tmp_path):
     assert dd.journal.record_count >= 8  # deferred by cost
     assert trace.counters.get("compact.deferred_by_cost", 0) > 0
     dd.close()
+
+
+# -- live disk faults: group-commit fsync failure semantics -------------------
+
+
+def test_group_commit_fsync_eio_errors_every_covered_waiter(tmp_path):
+    """An injected EIO on the COMBINED fsync: every ack_scope waiter the
+    fsync covered errors (an un-fsynced ack is no ack, for the whole
+    group), the journal poisons itself — no retry-after-fsync-failure —
+    and the on-disk acked prefix (everything acked before the fault)
+    replays intact on reopen."""
+    import threading
+    import time as _time
+
+    from automerge_tpu import obs
+    from automerge_tpu.storage.crashsim import FaultyFS
+    from automerge_tpu.storage.journal import OS_FS, JournalPoisoned
+
+    class SlowFaultyFS(FaultyFS):
+        """Arrivals overlap the in-flight fsync, so the combiner forms
+        real multi-waiter groups before the injected fault lands."""
+
+        def fsync(self, f):
+            _time.sleep(0.005)
+            super().fsync(f)
+
+    fs = SlowFaultyFS(OS_FS)
+    d = str(tmp_path / "doc")
+    dd = AutoDoc.open(d, fs=fs, fsync="always", actor=actor(1))
+    pre = [f"pre{i}" for i in range(5)]
+    for k in pre:
+        dd.put("_root", k, 1)
+        dd.commit()  # acked + durable before any fault
+
+    obs.reset_all()
+    n_threads = 6
+    results = [None] * n_threads
+    start = threading.Barrier(n_threads)
+
+    def committer(ti):
+        start.wait()
+        try:
+            with dd.ack_scope():
+                with dd.lock:
+                    dd.put("_root", f"w{ti}", ti)
+                    dd.commit()
+            results[ti] = "acked"
+        except Exception as e:  # noqa: BLE001
+            results[ti] = type(e).__name__
+
+    fs.arm("fsync", "EIO", count=1)
+    ts = [threading.Thread(target=committer, args=(i,))
+          for i in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+
+    # the fault fired, the journal poisoned, and NO waiter the poisoned
+    # fsync covered was acked: with count=1 the very first physical fsync
+    # dies, so every committer errors (none can have been covered by an
+    # earlier successful fsync)
+    assert dd.journal.poisoned and dd.journal.poisoned_reason == "fsync"
+    assert obs.counter_values("journal.poisoned", "reason") == {"fsync": 1}
+    assert all(r != "acked" for r in results), results
+    assert dd.degraded
+
+    # no-ack-after-poison: the journal never acks another write until
+    # reopened/compacted, and the refusal is the retriable kind
+    with pytest.raises(JournalPoisoned):
+        dd.put("_root", "late", 1)
+        dd.commit()
+    assert JournalPoisoned.retriable is True
+
+    # the acked prefix is replayable: everything acked pre-fault reads
+    # back; the un-acked group MAY be present (durability is allowed to
+    # exceed acks, never to lag them)
+    dd2 = AutoDoc.open(d, actor=actor(2))
+    got = dd2.hydrate()
+    for k in pre:
+        assert got.get(k) == 1, (k, sorted(got))
+    dd2.close()
