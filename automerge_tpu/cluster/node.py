@@ -63,6 +63,9 @@ _FOLLOWER_OK = frozenset({
     "metrics", "configure",
     "durableInfo", "durableCompact", "durableReopen", "openDurable",
     "chaosDisk",
+    # residency is node-local: a follower's store demotes and hydrates
+    # its replica copies independently of the leader's tiers
+    "storeStatus", "storeDemote",
 })
 
 
@@ -116,9 +119,11 @@ class ClusterRpcServer(RpcServer):
     def _repl_doc(self, name):
         """Open-or-get the named durable doc for the replication /
         migration paths (bypasses the follower gate by construction:
-        these handlers are already past it)."""
+        these handlers are already past it). A cold-demoted replica
+        hydrates here — applying a shipped batch needs the live doc."""
         h = self.openDurable({"name": name})["doc"]
-        return self._docs[h]
+        doc = self._ensure_resident(h)
+        return doc if doc is not None else self._docs[h]
 
     # -- cluster status ------------------------------------------------------
 
@@ -228,12 +233,18 @@ class ClusterRpcServer(RpcServer):
         self.on_durable_open = self._on_durable_open
         n = self._warm_open()
         # docs opened before the hub existed (or by a prior role) must
-        # attach too — attach() is idempotent per name
+        # attach too — attach() is idempotent per name. Cold docs have
+        # no live journal to hook; they attach lazily when an access
+        # hydrates them (on_durable_open fires on the hydration path)
         with self._lock:
             named = list(self._durable_names.items())
         for name, h in named:
             doc = self._docs.get(h)
-            if doc is not None and hasattr(doc, "journal"):
+            if (
+                doc is not None
+                and hasattr(doc, "journal")
+                and not getattr(doc, "_closed", False)
+            ):
                 self.hub.attach(name, doc)
         return n
 
@@ -291,10 +302,19 @@ class ClusterRpcServer(RpcServer):
         """Phase 1 of the handoff: a full snapshot pinned to an LSN,
         taken while the document keeps serving. The journal meta rides
         along (minus replication bookkeeping) so attached sync sessions
-        resume on the target instead of renegotiating from nothing."""
+        resume on the target instead of renegotiating from nothing.
+
+        A COLD document short-circuits all of that: its entire state IS
+        the fsynced on-disk snapshot + journal tail, so the response
+        ships those bytes verbatim (``cold: true``, tail records in
+        ``data``) with no hydration and no residency rebuild — the cheap
+        live-migration source rebalancing wants. The router re-runs this
+        under the routing pause, making the cold bytes authoritative."""
         if self.hub is None:
             raise NotLeader("migration source must be a leader")
         name = p["name"]
+        if self.store is not None and self.store.tier(name) == "cold":
+            return self._migrate_out_cold(name)
         doc = self._repl_doc(name)  # ensure open + attached
         data, lsn = self.hub.snapshot(name)
         from ..storage.durable import REPL_META_PREFIX
@@ -308,6 +328,53 @@ class ClusterRpcServer(RpcServer):
             "snapshot": base64.b64encode(data).decode("ascii"),
             "lsn": lsn,
             "stream": self.hub.stream_id,
+            "meta": meta,
+        }
+
+    def _migrate_out_cold(self, name: str):
+        """Read a cold document's on-disk bytes for migration: snapshot
+        file verbatim, journal change-records as the shipped tail, meta
+        records latest-wins (minus replication bookkeeping). Read-only —
+        the flock is free (the journal is closed) and the doc stays
+        cold on this node throughout."""
+        from ..storage.durable import (
+            JOURNAL_NAME,
+            REPL_META_PREFIX,
+            SNAPSHOT_NAME,
+        )
+        from ..storage.journal import (
+            REC_CHANGE,
+            REC_META,
+            decode_meta,
+            scan_records,
+        )
+
+        path = self._durable_path(name)
+        snap = b""
+        sp = os.path.join(path, SNAPSHOT_NAME)
+        if os.path.exists(sp):
+            with open(sp, "rb") as f:
+                snap = f.read()
+        records = []
+        meta = {}
+        jp = os.path.join(path, JOURNAL_NAME)
+        if os.path.exists(jp):
+            with open(jp, "rb") as f:
+                raw = f.read()
+            recs, _tail = scan_records(raw)  # read-only torn-tail scan
+            for r in recs:
+                if r.rec_type == REC_CHANGE:
+                    records.append((r.rec_type, r.payload))
+                elif r.rec_type == REC_META:
+                    mname, blob = decode_meta(r.payload)
+                    if not mname.startswith(REPL_META_PREFIX):
+                        meta[mname] = base64.b64encode(blob).decode("ascii")
+        obs.count("cluster.migrate_cold_source")
+        return {
+            "snapshot": base64.b64encode(snap).decode("ascii"),
+            "data": base64.b64encode(encode_batch(records)).decode("ascii"),
+            "lsn": -1,  # no live stream to pin; the router skips the tail
+            "cold": True,
             "meta": meta,
         }
 
@@ -331,7 +398,9 @@ class ClusterRpcServer(RpcServer):
         merges any state the promoted leader was missing."""
         name = p["name"]
         doc = self._repl_doc(name)
-        doc.apply_replicated_snapshot(base64.b64decode(p["snapshot"]), None)
+        snap = base64.b64decode(p["snapshot"])
+        if snap:  # a cold source that never compacted ships no snapshot
+            doc.apply_replicated_snapshot(snap, None)
         records = decode_batch(base64.b64decode(p.get("data") or ""))
         if records:
             doc.apply_replicated(records, None)

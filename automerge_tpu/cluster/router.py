@@ -900,7 +900,10 @@ class ClusterRouter:
         sentinel = (None, None, lambda _resp: done.set())
         try:
             dconn = self._data_conn(group.leader, vid)
-            dconn.send({"method": "heads", "params": {"doc": h.real}},
+            # docFence, not heads: the fence must not HYDRATE a cold
+            # document — keeping it cold is what makes it the cheap
+            # migration source this fence is clearing the way for
+            dconn.send({"method": "docFence", "params": {"doc": h.real}},
                        sentinel, 0, None)
         except Exception:
             return  # conn is dead: nothing pipelined survives on it
@@ -931,15 +934,28 @@ class ClusterRouter:
             # proves everything ahead of it has fully executed;
             # migrateTail then queues strictly after the fence.
             self._fence_doc(src, name)
-            try:
-                tail = self._admin(
-                    src.leader, "migrateTail",
-                    {"name": name, "since": out["lsn"]}, timeout=60.0)
-            except Exception:
-                # tail trimmed: re-snapshot under the pause (now final)
+            if out.get("cold"):
+                # cold source: the phase-1 bytes could have gone stale if
+                # an access hydrated the doc in between — re-read under
+                # the pause (cheap: file reads, no residency rebuild).
+                # Still cold => snapshot+tail came back whole in `data`
+                # and there is no live stream to tail.
                 out = self._admin(src.leader, "migrateOut", {"name": name},
                                   timeout=60.0)
-                tail = {"data": "", "lsn": out["lsn"]}
+            if out.get("cold"):
+                tail = {"data": out.get("data") or "", "lsn": out["lsn"]}
+            else:
+                try:
+                    tail = self._admin(
+                        src.leader, "migrateTail",
+                        {"name": name, "since": out["lsn"]}, timeout=60.0)
+                except Exception:
+                    # tail trimmed (or the doc demoted mid-pause):
+                    # re-snapshot under the pause (now final)
+                    out = self._admin(src.leader, "migrateOut",
+                                      {"name": name}, timeout=60.0)
+                    tail = {"data": out.get("data") or "",
+                            "lsn": out["lsn"]}
             self._admin(dst.leader, "migrateIn", {
                 "name": name, "snapshot": out["snapshot"],
                 "data": tail.get("data") or "",

@@ -167,6 +167,37 @@ def observe(name: str, value: float, labels: Optional[dict] = None) -> None:
     flight.note_delta("observe", name, labels, value)
 
 
+def remove_labels(name: str, labels: dict, type_: Optional[str] = None) -> int:
+    """Remove one label set from the named families (see
+    ``MetricsRegistry.remove_labels``) — the hygiene call for
+    per-subject series whose subject is gone."""
+    return registry.remove_labels(name, labels, type_=type_)
+
+
+def gauge_remove(name: str, labels: Optional[dict] = None) -> bool:
+    return registry.gauge_remove(name, **(labels or {}))
+
+
+# the per-document gauge families the durable and device layers export;
+# one hygiene call drops every series for a document that closed or
+# went cold, so the cardinality cap's slots keep circulating among LIVE
+# documents instead of filling with dead ones (past the cap, new docs
+# would collapse into {overflow=true} — exactly the admission signal
+# the tiered store cannot afford to lose)
+DOC_GAUGES = ("doc.journal_bytes", "doc.last_access_seconds")
+DEVICE_DOC_GAUGES = ("doc.resident_ops", "doc.device_bytes")
+
+
+def remove_doc_gauges(doc_name: Optional[str], *, device_only: bool = False) -> int:
+    if not doc_name:
+        return 0
+    names = DEVICE_DOC_GAUGES if device_only else DOC_GAUGES + DEVICE_DOC_GAUGES
+    n = 0
+    for fam in names:
+        n += registry.remove_labels(fam, {"doc": doc_name}, type_="gauge")
+    return n
+
+
 def reset_counters() -> None:
     """Clear the legacy counter view (the registry's Prometheus counters
     stay monotone over process life, as scrapers expect)."""

@@ -281,6 +281,40 @@ class MetricsRegistry:
         with self.lock:
             return sorted(self._families)
 
+    # -- removal (per-doc label hygiene) -------------------------------------
+
+    def remove_labels(self, name: str, labels: dict,
+                      type_: Optional[str] = None) -> int:
+        """Remove the child with exactly this label set from every
+        family named ``name`` (optionally one type). Returns how many
+        children were removed.
+
+        The reason this exists: per-document gauges
+        (``doc.journal_bytes{doc=...}`` and friends) are keyed by an
+        unbounded domain, and a long-lived server that opens documents
+        forever would otherwise fill each family's cardinality cap with
+        dead label sets — at which point every NEW document collapses
+        into ``{overflow="true"}`` and the admission signal the tiered
+        store's policy feeds on goes dark. Removing the labels when a
+        document closes or demotes to cold keeps the cap's slots
+        circulating among live documents. (Counters stay monotone for
+        scrapers; removal is meant for gauges/histograms whose subject
+        no longer exists — removing a counter's child is allowed but
+        resets that series.)"""
+        key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        removed = 0
+        with self.lock:
+            for (fname, ftype), fam in self._families.items():
+                if fname != name or (type_ is not None and ftype != type_):
+                    continue
+                if fam.children.pop(key, None) is not None:
+                    removed += 1
+        return removed
+
+    def gauge_remove(self, name: str, **labels) -> bool:
+        """Remove one gauge child (sugar over ``remove_labels``)."""
+        return self.remove_labels(name, labels, type_="gauge") > 0
+
     def reset(self) -> None:
         with self.lock:
             self._families.clear()

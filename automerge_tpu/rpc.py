@@ -139,6 +139,28 @@ def _from_rendered(rendered, exid, doc) -> object:
     return sv.value
 
 
+class _StoreOps:
+    """The tier-transition mechanics the DocStore delegates back to the
+    serving layer (store/docstore.py owns policy + bookkeeping only)."""
+
+    __slots__ = ("_rpc",)
+
+    def __init__(self, rpc: "RpcServer"):
+        self._rpc = rpc
+
+    def open_cold(self, name):
+        return self._rpc._store_open_cold(name)
+
+    def close_cold(self, name, compact):
+        return self._rpc._store_close_cold(name, compact=compact)
+
+    def drop_device(self, name):
+        return self._rpc._store_drop_device(name)
+
+    def build_device(self, name):
+        return self._rpc._store_build_device(name)
+
+
 class RpcServer:
     """One frontend session: documents + sync states by integer handle."""
 
@@ -189,6 +211,17 @@ class RpcServer:
         # injection surface does not exist at all.
         self.chaos_enabled = os.environ.get("AUTOMERGE_TPU_CHAOS") == "1"
         self._chaos_fs: Dict[str, object] = {}  # doc name -> FaultyFS
+        # tiered residency (store/): every named durable document this
+        # server serves is tracked in the DocStore, which demotes idle
+        # documents hot -> warm -> cold under the configured budgets and
+        # hydrates cold ones lazily on access. Unconfigured budgets (the
+        # default) make it pure bookkeeping — nothing is ever demoted.
+        self.store = None
+        self._handle_names: Dict[int, str] = {}  # doc handle -> durable name
+        if durable_dir is not None:
+            from .store import DocStore
+
+            self.store = DocStore(_StoreOps(self))
 
     # -- handle plumbing ----------------------------------------------------
 
@@ -203,6 +236,33 @@ class RpcServer:
         doc = self._docs.get(p["doc"])
         if doc is None:
             raise ValueError(f"invalid doc handle {p.get('doc')}")
+        if getattr(doc, "_closed", False) and self.store is not None:
+            # a cold-demoted document: hydrate it (single-flight, inside
+            # this doc's ordered queue) before serving the request
+            doc = self._ensure_resident(p["doc"])
+        touch = getattr(doc, "touch", None)
+        if touch is not None:
+            # read-path recency: without this a read-hot document looks
+            # idle to the store's LRU policy (writes refresh at ack exit,
+            # reads previously refreshed nothing)
+            touch()
+            if self.store is not None:
+                self.store.touch(self._handle_names.get(p["doc"], ""))
+        return doc
+
+    def _ensure_resident(self, h):
+        """The document behind handle ``h``, hydrated if it was demoted
+        to cold (may raise the retriable ``StoreBackpressure`` past the
+        store's concurrent-hydration bound). None for unknown handles."""
+        doc = self._docs.get(h)
+        if (
+            doc is not None
+            and getattr(doc, "_closed", False)
+            and self.store is not None
+        ):
+            name = self._handle_names.get(h)
+            if name is not None:
+                doc = self.store.ensure_open(name)
         return doc
 
     def _heads(self, p, key="heads"):
@@ -270,6 +330,7 @@ class RpcServer:
                 k: h for k, h in self._attached_sessions.items()
                 if k[0] != p["doc"]
             }
+            name = None
             if doc is not None and hasattr(doc, "journal"):  # durable wrapper
                 # drop the name mapping BEFORE closing: if close raises,
                 # the name must not stay pointed at a dead handle
@@ -277,7 +338,10 @@ class RpcServer:
                     n: h for n, h in self._durable_names.items()
                     if h != p["doc"]
                 }
+                name = self._handle_names.pop(p["doc"], None)
         if doc is not None and hasattr(doc, "journal"):
+            if self.store is not None and name is not None:
+                self.store.forget(name)
             doc.close()
         return None
 
@@ -334,6 +398,10 @@ class RpcServer:
                     f"durable doc {name!r} is already open with "
                     f"textEncoding={have_enc!r}, not {want_enc!r}"
                 )
+            # a cold doc's handle answers without hydrating — residency
+            # is paid on first real access, not on re-open
+            if self.store is not None:
+                self.store.touch(name)
             return {"doc": h}
         open_kw = {}
         if self.chaos_enabled:
@@ -357,8 +425,11 @@ class RpcServer:
         h = self._reg(self._docs, dd)
         with self._lock:
             self._durable_names[name] = h
+            self._handle_names[h] = name
         if self.on_durable_open is not None:
             self.on_durable_open(name, dd)
+        if self.store is not None:
+            self.store.admit(name, dd, device=bool(p.get("device", False)))
         return {"doc": h}
 
     def _durable_doc(self, p):
@@ -431,6 +502,8 @@ class RpcServer:
                     # open minted (nobody ever saw it)
                     self._docs[h] = self._docs.pop(new_h)
                     self._durable_names[name] = h
+                    self._handle_names.pop(new_h, None)
+                    self._handle_names[h] = name
                     new_h = h
                 # sessions attached to the old incarnation die with it
                 # (re-attach resumes via the epoch handshake)
@@ -471,12 +544,162 @@ class RpcServer:
             fs.arm(p["op"], p.get("err", "EIO"), int(p.get("count", -1)))
         return {"armed": {op: list(v) for op, v in fs.armed().items()}}
 
+    # -- tiered residency mechanics (store/docstore.py drives these) ---------
+
+    def _store_doc(self, name: str):
+        """(handle, live durable doc) for a store transition; raises for
+        unknown or already-cold names."""
+        with self._lock:
+            h = self._durable_names.get(name)
+            dd = self._docs.get(h) if h is not None else None
+        if h is None or dd is None or not hasattr(dd, "journal"):
+            raise ValueError(f"durable doc {name!r} is not open")
+        return h, dd
+
+    def _store_open_cold(self, name: str):
+        """Hydrate a cold document: reopen its directory through the
+        standard warm-recovery path (salvage snapshot load + journal
+        replay) and alias the existing client handle to the fresh
+        instance. Runs under the store's per-doc single-flight lock."""
+        h, ref = self._store_doc(name)
+        path = self._durable_path(name)
+        open_kw = {}
+        if self.chaos_enabled:
+            from .storage.crashsim import FaultyFS
+
+            fs = self._chaos_fs.get(name)
+            if fs is None:
+                fs = self._chaos_fs[name] = FaultyFS()
+            open_kw["fs"] = fs
+        dd = AutoDoc.open(
+            path,
+            fsync=getattr(ref, "fsync_policy", "always"),
+            text_encoding=getattr(ref, "text_encoding", None),
+            device=False,  # cold hydrates to WARM; hot is a promotion
+            background_compact=self.serve_background_compact,
+            compact_cost_ratio=float(
+                os.environ.get("AUTOMERGE_TPU_COMPACT_COST_RATIO", "0") or 0
+            ),
+            **open_kw,
+        )
+        with self._lock:
+            self._docs[h] = dd
+        if self.on_durable_open is not None:
+            # replication: the hub reattaches the fresh journal in place
+            # (followers whose cursors name the old stream resync via the
+            # cursor-mismatch snapshot path)
+            self.on_durable_open(name, dd)
+        return dd
+
+    def _store_close_cold(self, name: str, *, compact: bool = True):
+        """Demote to cold: optionally compact (bounding the hydration
+        replay), close the journal (flock released), drop the sessions
+        attached to the document (clients re-attach; the epoch handshake
+        resumes them, exactly as after ``durableReopen``), and leave a
+        ``ColdDocRef`` placeholder on the handle so the materialized
+        document — host op-store, device mirror, journal buffers — is
+        garbage the moment the last request drains."""
+        from .store import ColdDocRef
+
+        h, dd = self._store_doc(name)
+        if getattr(dd, "_closed", False):
+            return dd  # already cold
+        hub = getattr(self, "hub", None)
+        if hub is not None:
+            # a live stream must not keep shipping (or referencing) a
+            # journal that is about to close; hydration re-attaches
+            try:
+                hub.detach(name)
+            except Exception as e:  # noqa: BLE001 — demotion must win
+                obs.count("store.demote_error", error=str(e)[:200])
+        with dd.lock:
+            if compact and not dd.degraded:
+                dd.compact()
+            dd.close()
+            acked, appended = dd.acked_prefix()
+            ref = ColdDocRef(
+                name,
+                fsync_policy=dd.journal.fsync_policy,
+                text_encoding=dd._core.text_encoding,
+                acked=acked,
+                appended=appended,
+                replication_cursor=dd.replication_cursor,
+            )
+        with self._lock:
+            # every session holding the closed instance dies with it —
+            # feeding a closed journal would poison-error the client
+            stale = [sh for sh, d in self._session_docs.items() if d == h]
+            for sh in stale:
+                self._sessions.pop(sh, None)
+                self._session_docs.pop(sh, None)
+            self._attached_sessions = {
+                k: v for k, v in self._attached_sessions.items()
+                if k[0] != h
+            }
+            self._docs[h] = ref
+        return ref  # dd.close() above already removed the per-doc gauges
+
+    def _store_drop_device(self, name: str) -> None:
+        """Demote hot -> warm: release the device mirror and detach it
+        from live sessions (which would otherwise keep feeding — and
+        keeping alive — the dropped arrays)."""
+        h, dd = self._store_doc(name)
+        with dd.lock:
+            dev = dd.drop_device_mirror()
+        if dev is not None:
+            with self._lock:
+                for sh, d in self._session_docs.items():
+                    if d == h:
+                        sess = self._sessions.get(sh)
+                        if sess is not None:
+                            sess.device_doc = None
+
+    def _store_build_device(self, name: str) -> bool:
+        """Promote warm -> hot: rebuild the device mirror and hand it to
+        the document's live sessions."""
+        h, dd = self._store_doc(name)
+        try:
+            dev = dd.build_device_mirror()
+        except Exception as e:  # noqa: BLE001 — promotion is best-effort
+            obs.count("store.promote_error", error=str(e)[:200])
+            return False
+        with self._lock:
+            for sh, d in self._session_docs.items():
+                if d == h:
+                    sess = self._sessions.get(sh)
+                    if sess is not None:
+                        sess.device_doc = dev
+        return True
+
+    def storeStatus(self, p):
+        """Tier population, budgets and process RSS; ``{"docs": true}``
+        adds per-document tier/idle/footprint detail."""
+        if self.store is None:
+            raise ValueError("server is not running in --durable mode")
+        return self.store.status(docs=bool(p.get("docs")))
+
+    def storeDemote(self, p):
+        """Explicitly demote a named document (``to``: "warm" or
+        "cold") — the operator/CI surface over the same transition the
+        LRU policy drives."""
+        if self.store is None:
+            raise ValueError("server is not running in --durable mode")
+        name = p.get("name")
+        if not isinstance(name, str):
+            raise ValueError("storeDemote requires a doc name")
+        tier = self.store.demote(name, p.get("to", "cold"))
+        return {"name": name, "tier": tier}
+
     def close_durables(self) -> None:
         """Flush and close every open durable document (their close()
         commits pending autocommit edits and releases the journal locks);
-        serve() calls this on every exit path."""
+        serve() calls this on every exit path. Cold documents are
+        already closed — their placeholder's close() is a no-op."""
+        if self.store is not None:
+            self.store.close()  # stop the eviction sweeper first
         with self._lock:
             self._durable_names.clear()
+            self._handle_names.clear()
             durable = [
                 (h, doc) for h, doc in self._docs.items()
                 if hasattr(doc, "journal")
@@ -506,6 +729,16 @@ class RpcServer:
     def heads(self, p):
         return [_b64(h) for h in self._doc(p).get_heads()]
 
+    def docFence(self, p):
+        """Affinity-matched no-op: routed through the document's shard
+        queue like any other ``doc`` request, so its response proves
+        every frame pipelined ahead of it has fully executed (the
+        router's migration fence). Deliberately does NOT touch the
+        document — fencing a cold doc must not hydrate it."""
+        if p.get("doc") not in self._docs:
+            raise ValueError(f"invalid doc handle {p.get('doc')}")
+        return None
+
     def commit(self, p):
         h = self._doc(p).commit(message=p.get("message"))
         return _b64(h) if h is not None else None
@@ -521,7 +754,11 @@ class RpcServer:
         return None
 
     def merge(self, p):
-        return [_b64(h) for h in self._doc(p).merge(self._docs[p["other"]])]
+        # the merge source may be cold too: hydrate it like the target
+        other = self._ensure_resident(p["other"])
+        if other is None:
+            raise ValueError(f"invalid doc handle {p.get('other')}")
+        return [_b64(h) for h in self._doc(p).merge(other)]
 
     # mutation
     def put(self, p):
@@ -814,6 +1051,7 @@ class RpcServer:
         "syncSessionFree", "syncSessionAttach",
         "openDurable", "durableCompact", "durableInfo", "durableReopen",
         "chaosDisk",
+        "storeStatus", "storeDemote", "docFence",
         "metrics",
     })
 

@@ -64,6 +64,14 @@ _OPEN_DURABLE_KEY = "__open_durable__"  # serializes name-cache races
 # sync/session handle): their device feed batches into one apply_batches
 _COALESCE_METHODS = ("receiveSyncMessage", "syncSessionReceive")
 
+# methods that must NOT hydrate a cold document before executing: they
+# either retire it (free), or exist precisely because the document is
+# cold (the migration source path ships a cold doc's on-disk bytes with
+# no residency rebuild — hydrating it first would defeat that)
+_NO_HYDRATE_METHODS = frozenset(
+    {"free", "docFence", "migrateOut", "migrateTail", "migrateRelease"}
+)
+
 
 def _env_int(name: str, default: int) -> int:
     try:
@@ -454,6 +462,22 @@ class SocketRpcServer:
         written only after the covering fsync."""
         rpc = self.rpc
         doc = rpc._docs.get(key) if isinstance(key, int) else None
+        if doc is not None and getattr(doc, "_closed", False):
+            # cold-demoted document: hydrate once, here, inside this
+            # doc's ordered drain — the whole batch then runs against
+            # the live instance under ONE ack scope. Failures (e.g. the
+            # store's retriable hydration backpressure) fall through to
+            # per-request handling, which answers each with the error.
+            if all(
+                req.get("method") in _NO_HYDRATE_METHODS
+                for _c, req in items
+            ):
+                doc = None  # the cold doc stays cold; no ack scope needed
+            else:
+                try:
+                    doc = rpc._ensure_resident(key)
+                except Exception:
+                    doc = None
         scope = getattr(doc, "ack_scope", None)
         out: List[Tuple[_Conn, dict]] = []
         try:

@@ -75,6 +75,18 @@ REPL_META_PREFIX = "repl/"
 REPL_CURSOR_KEY = REPL_META_PREFIX + "cursor"
 
 
+class DocumentEvicted(Exception):
+    """The durable document behind this reference was closed (demoted to
+    the cold tier, or shut down) between the caller resolving the handle
+    and issuing a mutation. Retrying re-resolves the handle, which
+    hydrates a fresh instance — hence retriable. Without this guard a
+    mutation could SILENTLY stage state on a closed instance whose
+    change listener is gone: never journaled, never acked-visible,
+    dropped when the instance is garbage-collected."""
+
+    retriable = True
+
+
 class DurableDocument:
     """A document whose changes survive the process. See module docstring."""
 
@@ -85,6 +97,19 @@ class DurableDocument:
         {"commit", "apply_changes", "merge", "load_incremental",
          "receive_sync_message"}
     )
+
+    # host methods that mutate document state WITHOUT acking durably on
+    # the spot (they stage an autocommit transaction). On a live doc they
+    # delegate straight through; on a closed one they must refuse — the
+    # staged ops would otherwise die with the evicted instance. Reads
+    # stay allowed on a closed instance: the op-store is immutable from
+    # here on, so a request that resolved the doc just before demotion
+    # still answers consistently.
+    _MUTATING_METHODS = frozenset({
+        "put", "put_object", "insert", "insert_object", "delete",
+        "increment", "splice", "splice_text", "splice_text_many",
+        "mark", "unmark", "isolate", "integrate", "rollback",
+    })
 
     def __init__(self, host, core, path, journal, *, fs,
                  compact_max_records: int, compact_max_bytes: int,
@@ -136,6 +161,10 @@ class DurableDocument:
         # fsyncs stay cheap: the journal's group-commit combiner
         # collapses them.
         self._tl_scope = threading.local()
+        # read/write recency stamp (obs monotonic clock) — the tiered
+        # store's LRU signal; refreshed by touch() and every ack exit
+        self.last_access = obs.now()
+        self._touch_exported = 0.0
         self.device_doc = None  # set by open(device=True)
         # cluster replication gate (cluster/replication.py): when set,
         # the OUTERMOST ack-scope exit blocks until enough followers
@@ -284,6 +313,11 @@ class DurableDocument:
             # from racing a commit/merge/sync apply; uncontended RLock
             # cost on the single-threaded path is negligible
             def _acked(*a, _attr=attr, **kw):
+                if self._closed:
+                    raise DocumentEvicted(
+                        f"durable document {self.obs_name!r} was demoted "
+                        "to cold; retry to reopen"
+                    )
                 # ack scope OUTSIDE the lock (the same shape the serving
                 # layer's batch drain uses): the boundary fsync and the
                 # replication ack gate then run lock-free, so a follower
@@ -298,6 +332,17 @@ class DurableDocument:
             # the __getattr__ fallback + closure rebuild from now on
             self.__dict__[name] = _acked
             return _acked
+        if name in DurableDocument._MUTATING_METHODS and callable(attr):
+            def _guarded(*a, _attr=attr, **kw):
+                if self._closed:
+                    raise DocumentEvicted(
+                        f"durable document {self.obs_name!r} was demoted "
+                        "to cold; retry to reopen"
+                    )
+                return _attr(*a, **kw)
+
+            self.__dict__[name] = _guarded
+            return _guarded
         return attr
 
     @property
@@ -359,12 +404,68 @@ class DurableDocument:
         """Per-doc accounting at the ack boundary: journal footprint and
         a last-access stamp (seconds on the obs monotonic clock — age =
         ``obs.now() - value``). These are the residency-admission signals
-        the tiered-store roadmap item consumes; the device layer exports
+        the tiered store's policy consumes; the device layer exports
         ``doc.resident_ops`` / ``doc.device_bytes`` alongside."""
+        self.last_access = self._touch_exported = obs.now()
         labels = {"doc": self.obs_name}
         obs.gauge_set("doc.journal_bytes", self._journal.size_bytes,
                       labels=labels)
-        obs.gauge_set("doc.last_access_seconds", obs.now(), labels=labels)
+        obs.gauge_set("doc.last_access_seconds", self.last_access,
+                      labels=labels)
+
+    # touch() refreshes the exported gauge at most this often: the stamp
+    # the eviction policy reads is the plain attribute (free), and a
+    # registry-lock + flight-ring write per REQUEST would make every
+    # shard thread serialize on two process-global locks
+    TOUCH_EXPORT_INTERVAL_S = 1.0
+
+    def touch(self) -> None:
+        """Refresh the last-access stamp from the READ path. The write
+        path refreshes at every ack-scope exit, but a read-hot document
+        that never commits would otherwise look idle to the tiered
+        store's LRU policy and be demoted out from under its readers —
+        the RPC layer calls this on every document access. The policy
+        reads ``self.last_access`` directly, so the hot path is one
+        clock read + one attribute store; the scrape-visible gauge
+        refreshes at a bounded (1s) cadence."""
+        now = obs.now()
+        self.last_access = now
+        if now - self._touch_exported >= self.TOUCH_EXPORT_INTERVAL_S:
+            self._touch_exported = now
+            obs.gauge_set("doc.last_access_seconds", now,
+                          labels={"doc": self.obs_name})
+
+    # -- device-mirror residency (tiered store hot <-> warm) -----------------
+
+    def drop_device_mirror(self):
+        """Demote hot -> warm: release the resident ``DeviceDoc`` (and
+        its per-doc device gauges) while the host op-store keeps
+        serving. Returns the dropped mirror (for callers that need to
+        detach it from live sessions) or None."""
+        dev = self.device_doc
+        self.device_doc = None
+        if dev is not None:
+            obs.remove_doc_gauges(self.obs_name, device_only=True)
+        return dev
+
+    def build_device_mirror(self):
+        """Promote warm -> hot: build a resident ``DeviceDoc`` from the
+        committed history (the same construction ``open(device=True)``
+        performs). No-op when a mirror already exists."""
+        if self.device_doc is not None:
+            return self.device_doc
+        from ..ops.device_doc import DeviceDoc
+        from ..ops.oplog import OpLog
+
+        with self.lock:
+            with obs.span("device.recover", phase="promote"):
+                dev = DeviceDoc.resolve(
+                    OpLog.from_changes([a.stored for a in self._core.history])
+                )
+            dev.obs_name = self.obs_name
+            self.device_doc = dev
+            dev._export_doc_gauges()
+        return dev
 
     def __enter__(self):
         return self
@@ -478,6 +579,11 @@ class DurableDocument:
             except ValueError:
                 pass
             self._journal.close()
+            # per-doc gauge hygiene: a closed document's label sets must
+            # not occupy the registry's cardinality cap forever (at
+            # store scale that would collapse every later document's
+            # admission signal into {overflow=true})
+            obs.remove_doc_gauges(self.obs_name)
 
     # -- compaction ----------------------------------------------------------
 

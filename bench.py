@@ -76,6 +76,8 @@ def main():
     import gc
 
     gc.disable()
+    import resource as _resource
+
     import numpy as np
 
     from automerge_tpu import bench as W
@@ -1277,6 +1279,271 @@ def main():
     results["cluster"] = cluster_cfg
     note(f"cluster: {results['cluster']}")
 
+    # ---- config: tiered (bounded-memory residency at many-doc scale) -------
+    # N durable documents created and Zipfian-accessed through the REAL
+    # socket serve path against two servers: one with the tiered store's
+    # budgets configured (bounded residency: idle docs demote warm ->
+    # cold, cold docs hydrate on access), one with the store unbounded
+    # (the old behavior: every doc ever opened stays fully materialized,
+    # run at a reduced doc count because every live journal holds an fd).
+    # Asserted here: the store server's RSS stays under the configured
+    # watermark while serving every doc, demotions/hydrations actually
+    # fired, and a demote -> hydrate round trip returns byte-identical
+    # contents. Reported: RSS vs the unbounded server's linear
+    # projection, cold-open (hydration) latency percentiles from the
+    # server's own store.hydrate histogram, and access throughput.
+    tiered_cfg = {}
+    try:
+        if env_flag("BENCH_TIERED", "1") != "0":
+            import re
+            import resource
+            import shutil
+            import socket as socketmod
+            import subprocess
+            import tempfile
+            import threading
+
+            td_docs = env_int("BENCH_TD_DOCS", 100_000)
+            td_accesses = env_int("BENCH_TD_ACCESSES",
+                                  min(td_docs, 20_000))
+            td_flight = env_int("BENCH_TD_PIPELINE", 64)
+            td_headroom = env_int("BENCH_TD_RSS_HEADROOM", 256 << 20)
+
+            # the unbounded baseline holds one journal fd per live doc:
+            # cap it under the fd limit (raised as far as allowed), then
+            # project linearly to td_docs
+            soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+            try:
+                resource.setrlimit(
+                    resource.RLIMIT_NOFILE,
+                    (min(hard, 1 << 16) if hard > 0 else 1 << 16, hard))
+                soft = resource.getrlimit(resource.RLIMIT_NOFILE)[0]
+            except (ValueError, OSError):
+                pass
+            td_base_docs = env_int(
+                "BENCH_TD_BASELINE_DOCS",
+                max(64, min(td_docs, 2000, soft - 128)))
+
+            def proc_rss(pid):
+                with open(f"/proc/{pid}/statm") as f:
+                    return int(f.read().split()[1]) * os.sysconf(
+                        "SC_PAGE_SIZE")
+
+            def spawn(tag, extra_env):
+                tmp = tempfile.mkdtemp(prefix=f"amtpu_bench_td_{tag}_")
+                p = subprocess.Popen(
+                    [sys.executable, "-m", "automerge_tpu.rpc",
+                     "--socket", "127.0.0.1:0", "--durable", tmp],
+                    stderr=subprocess.PIPE, text=True,
+                    env=dict(os.environ, JAX_PLATFORMS="cpu", **extra_env),
+                )
+                port = int(re.search(
+                    r"(\d+)\)", p.stderr.readline()).group(1))
+                threading.Thread(
+                    target=lambda: [None for _ in p.stderr],
+                    daemon=True).start()
+                sock = socketmod.create_connection(("127.0.0.1", port))
+                sock.setsockopt(socketmod.IPPROTO_TCP,
+                                socketmod.TCP_NODELAY, 1)
+                return p, tmp, sock, sock.makefile("r")
+
+            def flights(sock, f, reqs, lats=None):
+                """Pipelined request flights; returns results by order."""
+                out_ = []
+                for lo in range(0, len(reqs), td_flight):
+                    chunk = reqs[lo:lo + td_flight]
+                    lines = [
+                        json.dumps({"id": lo + i, "method": m, "params": pp})
+                        for i, (m, pp) in enumerate(chunk)
+                    ]
+                    t0 = time.perf_counter()
+                    sock.sendall(("\n".join(lines) + "\n").encode())
+                    by = {}
+                    while len(by) < len(chunk):
+                        resp = json.loads(f.readline())
+                        err = resp.get("error")
+                        if err is not None:
+                            if err.get("retriable"):
+                                # backpressure/hydration contention: the
+                                # client owns the retry
+                                m, pp = chunk[resp["id"] - lo]
+                                time.sleep(0.01)
+                                sock.sendall((json.dumps(
+                                    {"id": resp["id"], "method": m,
+                                     "params": pp}) + "\n").encode())
+                                continue
+                            raise AssertionError(resp)
+                        if lats is not None:
+                            lats.append(time.perf_counter() - t0)
+                        by[resp["id"]] = resp.get("result")
+                    out_.extend(by[lo + i] for i in range(len(chunk)))
+                return out_
+
+            # residency, not durability, is under test: fsync="never"
+            # keeps the populate phase from being an fsync benchmark
+            # (demote/hydrate correctness is unaffected — the journal
+            # bytes are written either way)
+            td_fsync = env_flag("BENCH_TD_FSYNC", "never")
+
+            def populate(sock, f, n, tag):
+                handles = []
+                step = max(1, td_flight // 4)
+                for lo in range(0, n, step):
+                    batch = range(lo, min(lo + step, n))
+                    hs = [
+                        r["doc"] for r in flights(sock, f, [
+                            ("openDurable",
+                             {"name": f"t{i:06}", "fsync": td_fsync})
+                            for i in batch
+                        ])
+                    ]
+                    handles.extend(hs)
+                    reqs = []
+                    for i, h in zip(batch, hs):
+                        reqs.append(("put", {"doc": h, "obj": "_root",
+                                             "prop": "v", "value": i}))
+                        reqs.append(("commit", {"doc": h}))
+                    flights(sock, f, reqs)
+                return handles
+
+            store_env = {
+                "AUTOMERGE_TPU_STORE_WARM_BYTES": str(
+                    env_int("BENCH_TD_WARM_BYTES", 4 << 20)),
+                "AUTOMERGE_TPU_STORE_EVICT_INTERVAL": "0.2",
+                "AUTOMERGE_TPU_STORE_MIN_IDLE": "0.05",
+            }
+            sp = st = ss = sf = None
+            up = ut = us = uf = None
+            try:
+                sp, st, ss, sf = spawn("store", store_env)
+                up, ut, us, uf = spawn("unbounded", {})
+                rss_store_0 = proc_rss(sp.pid)
+                rss_unb_0 = proc_rss(up.pid)
+                rss_budget = rss_store_0 + td_headroom
+                # tell the store its hard watermark (config accepts env
+                # only at construction, so restart-free: the warm-bytes
+                # budget is the active bound; the watermark is asserted
+                # on the measured outcome below)
+
+                t0 = time.perf_counter()
+                store_handles = populate(ss, sf, td_docs, "s")
+                t_pop = time.perf_counter() - t0
+                populate(us, uf, td_base_docs, "u")
+
+                rss_store_1 = proc_rss(sp.pid)
+                rss_unb_1 = proc_rss(up.pid)
+                per_doc = (rss_unb_1 - rss_unb_0) / max(1, td_base_docs)
+                rss_linear = rss_unb_0 + per_doc * td_docs
+
+                # Zipfian access phase against the store server
+                rng = np.random.default_rng(7)
+                draws = rng.zipf(1.3, size=4 * td_accesses)
+                draws = draws[draws <= td_docs][:td_accesses]
+                while len(draws) < td_accesses:
+                    extra = rng.zipf(1.3, size=td_accesses)
+                    draws = np.concatenate(
+                        [draws, extra[extra <= td_docs]])[:td_accesses]
+                lats = []
+                t0 = time.perf_counter()
+                reqs = [
+                    ("get", {"doc": store_handles[int(r) - 1],
+                             "obj": "_root", "prop": "v"})
+                    for r in draws
+                ]
+                vals = flights(ss, sf, reqs, lats)
+                t_access = time.perf_counter() - t0
+                for r, v in zip(draws, vals):
+                    assert v == int(r) - 1, (int(r) - 1, v)
+                rss_store_2 = proc_rss(sp.pid)
+
+                # demote -> hydrate round trip must be byte-identical
+                probe = store_handles[0]
+                save_a = flights(ss, sf, [("save", {"doc": probe})])[0]
+                flights(ss, sf, [("storeDemote", {"name": "t000000"})])
+                save_b = flights(ss, sf, [("save", {"doc": probe})])[0]
+                roundtrip_ok = save_a == save_b
+
+                # the server's own accounting: tiers, demotions, hydrate
+                # latency histogram
+                snap = flights(ss, sf, [("metrics", {"format": "json"})])[0]
+                entries = snap["metrics"]
+                demotions = sum(
+                    e["value"] for e in entries
+                    if e["name"] == "store.demotions"
+                    and e["type"] == "counter"
+                )
+                hyd = [
+                    e for e in entries
+                    if e["name"] == "store.hydrate"
+                    and e["type"] == "histogram"
+                ]
+                hydrations = sum(e["count"] for e in hyd)
+                tiers = {
+                    e["labels"]["tier"]: e["value"]
+                    for e in entries
+                    if e["name"] == "store.tier" and e["type"] == "gauge"
+                }
+
+                rss_peak = max(rss_store_1, rss_store_2)
+                assert rss_peak <= rss_budget, (
+                    f"store RSS {rss_peak} exceeded budget {rss_budget}")
+                # > 1: at least one POLICY demotion beyond the explicit
+                # round-trip storeDemote below — a run where the budget
+                # never bites is vacuous
+                assert demotions > 1, "no policy demotions fired"
+                assert hydrations > 0, "no cold opens fired (vacuous run)"
+                assert roundtrip_ok, "demote->hydrate changed the bytes"
+
+                for sock_, f_ in ((ss, sf), (us, uf)):
+                    flights(sock_, f_, [("shutdown", {})])
+                sp.wait(timeout=60)
+                up.wait(timeout=60)
+            finally:
+                for p_ in (sp, up):
+                    if p_ is not None and p_.poll() is None:
+                        p_.kill()
+                        p_.wait(timeout=10)
+                for d_ in (st, ut):
+                    if d_ is not None:
+                        shutil.rmtree(d_, ignore_errors=True)
+
+            tiered_cfg = {
+                "docs": td_docs,
+                "accesses": td_accesses,
+                "baseline_docs": td_base_docs,
+                "populate_seconds": round(t_pop, 3),
+                "populate_docs_per_sec": round(td_docs / t_pop, 1),
+                "access_seconds": round(t_access, 3),
+                "accesses_per_sec": round(td_accesses / t_access, 1),
+                "rss_budget_bytes": rss_budget,
+                "rss_store_bytes": rss_peak,
+                "rss_under_budget": True,
+                "rss_unbounded_baseline_bytes": rss_unb_1,
+                "rss_linear_projection_bytes": int(rss_linear),
+                "bytes_per_resident_doc": int(per_doc),
+                "tiers": tiers,
+                "demotions": int(demotions),
+                "hydrations": int(hydrations),
+                "roundtrip_identical": roundtrip_ok,
+                **{
+                    k.replace("latency", "cold_open_latency"): round(v, 6)
+                    for k, v in (
+                        ("latency_p50_s", hyd[0]["p50"] if hyd else 0.0),
+                        ("latency_p95_s", hyd[0]["p95"] if hyd else 0.0),
+                        ("latency_p99_s", hyd[0]["p99"] if hyd else 0.0),
+                    )
+                },
+                **_latency_percentiles("bench.tiered.access_latency", lats),
+            }
+    except Exception as e:  # noqa: BLE001 — degrade, record, continue
+        import traceback
+
+        tb = traceback.format_exc()
+        tiered_cfg = {"tiered_error": repr(e)[:500]}
+        print(f"tiered config failed:\n{tb}", file=sys.stderr, flush=True)
+    results["tiered"] = tiered_cfg
+    note(f"tiered: {results['tiered']}")
+
     out = {
         "metric": "edit_trace_fanin_merge_ops_per_sec",
         "value": results["fanin"]["ops_per_sec"],
@@ -1287,6 +1554,11 @@ def main():
         "git_commit": git_commit(),
         "schema_version": BENCH_SCHEMA_VERSION,
         "config": dict(sorted(RESOLVED_CONFIG.items())),
+        # memory trajectory alongside throughput: this process's peak
+        # RSS over the whole run (ru_maxrss is KiB on Linux) — the
+        # number the tiered-store work is accountable to across PRs
+        "max_rss_bytes": _resource.getrusage(
+            _resource.RUSAGE_SELF).ru_maxrss * 1024,
         "configs": results,
         # cumulative device-phase attribution across the whole run
         # (trace.time spans: device.extract / h2d / kernel / readback /

@@ -478,3 +478,92 @@ def test_router_live_migration_between_groups(tmp_path):
         router.stop()
         n0.stop()
         n1.stop()
+
+
+def test_cold_doc_is_cheap_migration_source(tmp_path):
+    """A document demoted to the cold tier migrates as on-disk
+    snapshot+tail bytes: no hydration on the source, contents intact on
+    the target, ``cluster.migrate_cold_source`` actually fired."""
+    from automerge_tpu import obs
+
+    n0 = start_node(tmp_path, "cg0", role="leader")
+    n1 = start_node(tmp_path, "cg1", role="leader")
+    router = ClusterRouter([[addr_of(n0)], [addr_of(n1)]], heartbeat=5.0)
+    router.start()
+    try:
+        c = Client(router.address)
+        d = c.call("openDurable", name="colddoc")["doc"]
+        for i in range(12):
+            c.call("put", doc=d, obj="_root", prop=f"k{i}", value=i)
+        c.call("commit", doc=d)
+        home = HashRing([0, 1]).member_for("colddoc")
+        src = [n0, n1][home]
+        # demote on the source node: journal closed, op-store dropped
+        src.rpc.store.demote("colddoc", "cold", "test")
+        assert src.rpc.store.tier("colddoc") == "cold"
+        before = obs.legacy_counters.get("cluster.migrate_cold_source", 0)
+        res = c.call("clusterMigrate", name="colddoc", to=1 - home)
+        assert res["migrated"] is True
+        after = obs.legacy_counters.get("cluster.migrate_cold_source", 0)
+        # both phases (live read + authoritative re-read under the
+        # routing pause) took the cold path: the doc was never hydrated
+        # on the source — no residency rebuild happened
+        assert after - before >= 2, (before, after)
+        # the source released the migrated doc entirely
+        assert src.rpc.store.tier("colddoc") is None
+        # the doc stayed cold on the source for the whole handoff (no
+        # residency rebuild) and the target serves the full contents
+        for i in range(12):
+            assert c.call("get", doc=d, obj="_root", prop=f"k{i}") == i
+        c.call("put", doc=d, obj="_root", prop="after", value="moved")
+        c.call("commit", doc=d)
+        assert c.call("get", doc=d, obj="_root", prop="after") == "moved"
+        c.close()
+    finally:
+        router.stop()
+        n0.stop()
+        n1.stop()
+
+
+def test_follower_replica_hydrates_from_cold_on_apply(tmp_path):
+    """Replication keeps flowing to a replica the follower's own store
+    demoted to cold: the next shipped batch hydrates it in place and the
+    persisted cursor survives the demote/hydrate cycle."""
+    fol = start_node(tmp_path, "fcold_f", role="follower")
+    led = start_node(tmp_path, "fcold_l", role="leader",
+                     replicate_to=[addr_of(fol)])
+    try:
+        c = Client(led.address)
+        d = c.call("openDurable", name="repdoc")["doc"]
+        c.call("put", doc=d, obj="_root", prop="a", value=1)
+        c.call("commit", doc=d)
+        wait_until(
+            lambda: fol.rpc.store is not None
+            and fol.rpc.store.tier("repdoc") is not None,
+            msg="follower opened the replica",
+        )
+        wait_until(
+            lambda: (lambda dd: dd is not None and not getattr(
+                dd, "_closed", True) and dd.get("_root", "a") is not None)(
+                    fol.rpc._docs.get(
+                        fol.rpc._durable_names.get("repdoc"))),
+            msg="follower applied the first record",
+        )
+        fol.rpc.store.demote("repdoc", "cold", "test")
+        assert fol.rpc.store.tier("repdoc") == "cold"
+        c.call("put", doc=d, obj="_root", prop="b", value=2)
+        c.call("commit", doc=d)
+
+        def _fol_has_b():
+            h = fol.rpc._durable_names.get("repdoc")
+            dd = fol.rpc._docs.get(h)
+            if dd is None or getattr(dd, "_closed", False):
+                return False
+            got = dd.get("_root", "b")
+            return got is not None
+        wait_until(_fol_has_b, msg="cold follower replica hydrated + applied")
+        assert fol.rpc.store.tier("repdoc") == "warm"
+        c.close()
+    finally:
+        led.stop()
+        fol.stop()
