@@ -1,6 +1,6 @@
 """Command-line interface: export / import / merge / examine / examine-sync
 / change / journal-info / compact / metrics / serve / cluster-router /
-cluster-metrics / flight-merge.
+cluster-metrics / flight-merge / perf-report.
 
 Mirrors the reference CLI's subcommands (reference:
 rust/automerge-cli/src/main.rs:81-161). Documents read and write the
@@ -437,6 +437,75 @@ def cmd_flight_merge(args) -> int:
     return 0
 
 
+def cmd_perf_report(args) -> int:
+    """Render the drain-cycle performance observatory (obs/prof.py):
+    live from a running server's ``perfStatus`` RPC (``--connect``), or
+    offline from flight-recorder dumps — every finished drain cycle
+    lands in the flight ring as a ``drain.cycle_report`` event, so a
+    dead process's last dump still answers "where did the drain wall
+    clock go"."""
+    import glob
+    import os
+    import socket
+
+    from .obs import prof
+
+    if args.connect:
+        host, _, port = args.connect.rpartition(":")
+        req = {"id": 1, "method": "perfStatus",
+               "params": {"top": args.top}}
+        try:
+            with socket.create_connection((host or "127.0.0.1", int(port)),
+                                          timeout=10) as sock:
+                sock.settimeout(30)
+                sock.sendall((json.dumps(req) + "\n").encode())
+                raw = sock.makefile("r").readline()
+        except (OSError, ValueError) as e:
+            print(f"perf-report: {args.connect}: {e}", file=sys.stderr)
+            return 1
+        if not raw:
+            print("perf-report: server closed the connection",
+                  file=sys.stderr)
+            return 1
+        resp = json.loads(raw)
+        if "error" in resp:
+            print(f"perf-report: {resp['error']}", file=sys.stderr)
+            return 1
+        summary = resp["result"]
+    else:
+        paths = []
+        for inp in args.input:
+            if os.path.isdir(inp):
+                paths.extend(
+                    sorted(glob.glob(os.path.join(inp, "flight-*.json"))))
+            else:
+                paths.append(inp)
+        if not paths:
+            print("perf-report: provide --connect HOST:PORT or flight "
+                  "dumps / directories", file=sys.stderr)
+            return 1
+        events = []
+        for p in paths:
+            with open(p) as f:
+                d = json.load(f)
+            if d.get("format") != "automerge_tpu-flight-v1":
+                print(f"perf-report: {p}: not a flight dump",
+                      file=sys.stderr)
+                return 1
+            events.extend(d.get("events", ()))
+        summary = prof.summarize_flight_events(events)
+        if not summary["cycles"]:
+            print("perf-report: no drain.cycle_report events in the "
+                  "given dumps (profiling off, or no drains ran)",
+                  file=sys.stderr)
+            return 1
+    if args.format == "json":
+        _write(args.out, (json.dumps(summary, indent=2) + "\n").encode())
+    else:
+        _write(args.out, prof.render_text(summary, top=args.top).encode())
+    return 0
+
+
 def cmd_serve(args) -> int:
     """Run the concurrent JSON-RPC server (serve/server.py) over TCP or
     a unix-domain socket — the same method surface as the stdio frontend
@@ -555,6 +624,19 @@ def build_parser() -> argparse.ArgumentParser:
                     help="router address to scrape")
     sp.add_argument("--format", choices=("prometheus", "json"),
                     default="prometheus")
+
+    sp = add("perf-report", cmd_perf_report,
+             help="drain-cycle stage attribution: host/device split, "
+                  "occupancy, top docs — live (--connect) or from "
+                  "flight dumps")
+    sp.add_argument("input", nargs="*",
+                    help="flight-*.json dumps (or directories holding "
+                         "them) for offline mode")
+    sp.add_argument("--connect", metavar="HOST:PORT", default=None,
+                    help="scrape a live server's perfStatus RPC instead")
+    sp.add_argument("--format", choices=("text", "json"), default="text")
+    sp.add_argument("--top", type=int, default=8,
+                    help="rows in the expensive-docs table")
 
     sp = add("flight-merge", cmd_flight_merge,
              help="merge flight-recorder dumps from several processes "

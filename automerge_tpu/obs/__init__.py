@@ -85,6 +85,13 @@ recorder = SpanRecorder(_SPAN_BUFFER)
 # exit/failover once a server entry point calls ``flight.install``
 flight = FlightRecorder(recorder, registry)
 
+# drain-cycle profiler hooks (obs/prof.py installs these when imported;
+# None — the default until a server/bench imports prof — costs one
+# global read per span). When installed, each hook is a contextvar read
+# unless a prof.cycle is actually active in the calling context.
+cycle_enter = None
+cycle_exit = None
+
 # the legacy back-compat views (trace.counters / trace.timings alias these
 # exact dict objects): counters hold the label-aggregated totals; timings
 # hold [total_seconds, count] per span name. Mutated only under
@@ -276,6 +283,8 @@ class span:
         self._parent = current_span.get()
         self._id = next_span_id()
         self._token = current_span.set(self._id)
+        if cycle_enter is not None:
+            cycle_enter(self.name)
         self.t0 = _perf_counter()
         return self
 
@@ -284,6 +293,8 @@ class span:
         dur = t1 - self.t0
         current_span.reset(self._token)
         name = self.name
+        if cycle_exit is not None:
+            cycle_exit(name, dur)
         with registry.lock:
             slot = legacy_timings.get(name)
             if slot is None:

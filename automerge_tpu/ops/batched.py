@@ -60,6 +60,7 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import obs
+from ..obs import prof as _prof
 
 # the READ_FETCH surface a DeviceDoc subset scatter consumes
 _FETCH = (
@@ -165,8 +166,18 @@ def _launch_packed(cols, n_objs: int, n_props: int):
     )
     from .oplog import host_linearize, pad_columns
 
-    cols = pad_columns(cols, n_objs)
+    useful = len(cols["action"])
+    with obs.span("device.pack", rows=useful):
+        cols = pad_columns(cols, n_objs)
     P = len(cols["action"])
+    # occupancy at the pack site: padded-vs-useful rows were invisible
+    # before, and the ratio is the first input the super-batch tuner
+    # needs (a batch padded 10x past its useful rows is burning its win)
+    obs.count("device.batch_rows", n=useful)
+    obs.count("device.batch_padding_rows", n=P - useful)
+    _prof.note("useful_rows", useful)
+    _prof.note("padded_rows", P - useful)
+    _prof.note("launches")
     obs.count("device.kernel_launches", labels={"path": "batched"})
     with obs.span("device.h2d", rows=P):
         cols_dev = {k: jnp.asarray(v) for k, v in cols.items()}
@@ -175,9 +186,11 @@ def _launch_packed(cols, n_objs: int, n_props: int):
         if scatter_geometry_ok(P, n_objs, n_props)
         else merge_kernel_core
     )
-    with obs.span("device.kernel", rows=P):
+    with obs.span("device.kernel", rows=P), \
+            _prof.annotate("amtpu.batched_launch"):
         out = fn(cols_dev)  # async dispatch
-    ei = host_linearize(cols)
+    with obs.span("device.linearize", rows=P):
+        ei = host_linearize(cols)
     with obs.span("device.readback", rows=P):
         res = {
             k: np.asarray(out[k])
@@ -218,12 +231,14 @@ def resolve_stages(
         links = [st.trace for st in batch if st.trace is not None]
         with obs.span("device.batched", links=links, docs=len(batch)):
             obs.observe("device.batch_docs", len(batch))
-            cols, metas, n_rows, n_objs = _pack(batch)
+            with obs.span("device.pack", docs=len(batch)):
+                cols, metas, n_rows, n_objs = _pack(batch)
             n_props = max(
                 (len(st.doc.log.props) for st in batch), default=1
             )
             res = _launch_packed(cols, n_objs, max(n_props, 1))
-            _scatter(metas, res)
+            with obs.span("device.scatter", docs=len(batch)):
+                _scatter(metas, res)
     return {"batched": len(batch), "fallback": len(whales)}
 
 
@@ -258,12 +273,19 @@ def apply_cross_doc(
             order.append(k)
     applied = 0
     stages: List[BatchStage] = []
-    for k in order:
+    for i, k in enumerate(order):
         dev, batches = merged[k]
+        t0 = time.perf_counter()
         n, st = dev.stage_batches(batches)
+        _prof.note_doc(
+            getattr(dev, "obs_name", None) or f"doc{i}",
+            time.perf_counter() - t0,
+        )
         applied += n
         if st is not None:
             stages.append(st)
+    _prof.note("docs", len(order))
+    _prof.note("changes", applied)
     out = {"applied": applied, "batched": 0, "fallback": 0}
     step = max_docs_per_launch or len(stages) or 1
     for lo in range(0, len(stages), step):
@@ -350,7 +372,13 @@ class CrossDocBatcher:
         shared launch; blocks until resolved. Returns changes applied."""
         if not self.active():
             return dev.apply_batches(batches)
+        t0 = time.perf_counter()
         applied, stage = dev.stage_batches(batches)
+        _prof.note("docs")
+        _prof.note("changes", applied)
+        _prof.note_doc(
+            getattr(dev, "obs_name", None), time.perf_counter() - t0
+        )
         if stage is None:
             return applied
         # attribute the (possibly other-thread) shared launch back to

@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import obs
+from ..obs import prof as _prof
 from ..core.marks import Mark
 from ..patches.patch import (
     DeleteMap,
@@ -165,13 +166,13 @@ class DeviceDoc:
     @classmethod
     def resolve(cls, log: OpLog) -> "DeviceDoc":
         obs.count("device.kernel_launches", labels={"path": "per_doc"})
-        return cls(
-            log,
-            merge_columns(
+        _prof.note("launches")
+        with _prof.annotate("amtpu.resolve"):
+            res = merge_columns(
                 log.columns(), fetch=cls.READ_FETCH, n_objs=log.n_objs,
                 n_props=len(log.props),
-            ),
-        )
+            )
+        return cls(log, res)
 
     # -- incremental updates ------------------------------------------------
     #
@@ -194,18 +195,22 @@ class DeviceDoc:
         """
         if self._base is not self:
             raise ValueError("apply_changes on a historical view; use the base doc")
-        ready = self._take_ready(changes)
-        if not ready:
-            return 0
-        with obs.span("device.apply", changes=len(ready)):
+        # the umbrella span covers the WHOLE host apply — dedup, causal
+        # ordering, splice, delta resolution — so a drain-cycle profiler
+        # report attributes the staging wall clock without gaps (the
+        # stage spans inside are its breakdown)
+        with obs.span("device.apply", changes=len(changes)):
+            ready = self._take_ready(changes)
+            if not ready:
+                return 0
             # an empty resident log (a device doc opened before any
             # history existed) has no actor table to splice into: the
             # rebuild path IS the initial build
-            info = (
-                self.log.append_changes(ready)
-                if incremental and self.log.n
-                else None
-            )
+            if incremental and self.log.n:
+                with obs.span("device.stage.splice", changes=len(ready)):
+                    info = self.log.append_changes(ready)
+            else:
+                info = None
             if info is None:
                 obs.count("device.apply_rebuild")
                 self._rebuild(list(self.log.changes) + ready)
@@ -213,7 +218,7 @@ class DeviceDoc:
             self._apply_append(info, ready)
             if info.n_new and not self._delta_resolve(info):
                 self._reresolve(info.dirty_objs)
-        self._export_doc_gauges()
+            self._export_doc_gauges()
         return len(ready)
 
     def apply_batches(self, batches: Sequence[Sequence]) -> int:
@@ -238,7 +243,11 @@ class DeviceDoc:
             ready = self._take_ready(chs)
             if not ready:
                 continue
-            info = self.log.append_changes(ready) if self.log.n else None
+            if self.log.n:
+                with obs.span("device.stage.splice", changes=len(ready)):
+                    info = self.log.append_changes(ready)
+            else:
+                info = None
             if info is None:
                 if inflight is not None:
                     self._collect_async(inflight)
@@ -294,11 +303,17 @@ class DeviceDoc:
 
         if self._base is not self:
             raise ValueError("stage_batches on a historical view; use the base doc")
-        ready = self._take_ready([ch for b in batches for ch in b])
-        if not ready:
-            return 0, None
-        with obs.span("device.apply", changes=len(ready)):
-            info = self.log.append_changes(ready) if self.log.n else None
+        # same umbrella as apply_changes: the whole host staging half is
+        # one contiguous device.apply region for cycle attribution
+        with obs.span("device.apply", batches=len(batches)):
+            ready = self._take_ready([ch for b in batches for ch in b])
+            if not ready:
+                return 0, None
+            if self.log.n:
+                with obs.span("device.stage.splice", changes=len(ready)):
+                    info = self.log.append_changes(ready)
+            else:
+                info = None
             if info is None:
                 obs.count("device.apply_rebuild")
                 self._rebuild(list(self.log.changes) + ready)
@@ -315,7 +330,7 @@ class DeviceDoc:
                 self._reresolve(dirty)
                 self._export_doc_gauges()
                 return len(ready), None
-        self._export_doc_gauges()
+            self._export_doc_gauges()
         return len(ready), BatchStage(self, rows, dirty)
 
     def pending_changes(self) -> int:
@@ -324,26 +339,32 @@ class DeviceDoc:
 
     def _take_ready(self, changes: Sequence) -> list:
         """Dedup + causal-order the incoming batch against what the log
-        already holds; buffer changes with missing deps."""
-        have = self._hash_index
-        pend = self._pending
-        for ch in changes:
-            h = ch.hash
-            if h is None or h in have or h in pend:
-                continue
-            pend[h] = ch
-        ready: list = []
-        ready_set: set = set()
-        progress = True
-        while progress and pend:
-            progress = False
-            for h in list(pend):
-                ch = pend[h]
-                if all(d in have or d in ready_set for d in ch.dependencies):
-                    ready.append(ch)
-                    ready_set.add(h)
-                    del pend[h]
-                    progress = True
+        already holds; buffer changes with missing deps. The two halves
+        are timed separately (``device.stage.dedup`` /
+        ``device.stage.causal_order``) — the drain-cycle profiler's host
+        stage attribution starts here."""
+        with obs.span("device.stage.dedup", changes=len(changes)):
+            have = self._hash_index
+            pend = self._pending
+            for ch in changes:
+                h = ch.hash
+                if h is None or h in have or h in pend:
+                    continue
+                pend[h] = ch
+        with obs.span("device.stage.causal_order", pending=len(pend)):
+            ready: list = []
+            ready_set: set = set()
+            progress = True
+            while progress and pend:
+                progress = False
+                for h in list(pend):
+                    ch = pend[h]
+                    if all(d in have or d in ready_set
+                           for d in ch.dependencies):
+                        ready.append(ch)
+                        ready_set.add(h)
+                        del pend[h]
+                        progress = True
         if pend:
             obs.count("device.apply_deferred", n=len(pend))
         return ready
@@ -354,10 +375,12 @@ class DeviceDoc:
         mesh_state = (self._mesh, self._mesh_min_rows, self._mesh_env_tried)
         log = OpLog.from_changes(changes)
         obs.count("device.kernel_launches", labels={"path": "per_doc"})
-        res = merge_columns(
-            log.columns(), fetch=self.READ_FETCH, n_objs=log.n_objs,
-            n_props=len(log.props),
-        )
+        _prof.note("launches")
+        with _prof.annotate("amtpu.rebuild"):
+            res = merge_columns(
+                log.columns(), fetch=self.READ_FETCH, n_objs=log.n_objs,
+                n_props=len(log.props),
+            )
         self.__init__(log, res)
         self._pending = pend
         self._mesh, self._mesh_min_rows, self._mesh_env_tried = mesh_state
@@ -805,10 +828,12 @@ class DeviceDoc:
             res = self._mesh_resolve()
             if res is None:
                 obs.count("device.kernel_launches", labels={"path": "per_doc"})
-                res = merge_columns(
-                    log.columns(), fetch=self.READ_FETCH, n_objs=log.n_objs,
-                    n_props=len(log.props),
-                )
+                _prof.note("launches")
+                with _prof.annotate("amtpu.reresolve_full"):
+                    res = merge_columns(
+                        log.columns(), fetch=self.READ_FETCH,
+                        n_objs=log.n_objs, n_props=len(log.props),
+                    )
             n = log.n
             vis = np.asarray(res["visible"])[:n]
             win = np.asarray(res["winner"])[:n]
@@ -829,11 +854,14 @@ class DeviceDoc:
                     dirty_rows=len(rows), frac=round(frac, 4))
         cols = self._subset_cols(rows, dirty)
         obs.count("device.kernel_launches", labels={"path": "per_doc"})
-        res_sub = merge_columns(
-            cols, fetch=self.READ_FETCH, n_objs=len(dirty),
-            n_props=len(log.props),
-        )
-        self._scatter_subset(rows, dirty, res_sub)
+        _prof.note("launches")
+        with _prof.annotate("amtpu.reresolve_subset"):
+            res_sub = merge_columns(
+                cols, fetch=self.READ_FETCH, n_objs=len(dirty),
+                n_props=len(log.props),
+            )
+        with obs.span("device.scatter", rows=len(rows)):
+            self._scatter_subset(rows, dirty, res_sub)
 
     # staged async subset resolution (apply_batches) --------------------------
 
@@ -873,10 +901,13 @@ class DeviceDoc:
             else merge_kernel_core
         )
         obs.count("device.kernel_launches", labels={"path": "per_doc"})
-        with obs.span("device.kernel", rows=P):
+        _prof.note("launches")
+        with obs.span("device.kernel", rows=P), \
+                _prof.annotate("amtpu.dispatch_async"):
             out = fn(cols_dev)  # async dispatch
         # element order overlaps the kernel — it needs only the columns
-        ei = host_linearize(cols_np)
+        with obs.span("device.linearize", rows=P):
+            ei = host_linearize(cols_np)
         return {"rows": rows, "dirty": dirty, "out": out, "ei": ei}
 
     def _collect_async(self, handle) -> None:
@@ -894,7 +925,8 @@ class DeviceDoc:
                 "obj_vis_len": np.asarray(out["obj_vis_len"]),
                 "obj_text_width": np.asarray(out["obj_text_width"]),
             }
-        self._scatter_subset(handle["rows"], handle["dirty"], res_sub)
+        with obs.span("device.scatter", rows=S):
+            self._scatter_subset(handle["rows"], handle["dirty"], res_sub)
 
     # -- whale-doc mesh residency (parallel/sharding.py) ---------------------
     #
@@ -1081,12 +1113,14 @@ class DeviceDoc:
         if view is None:
             covered = base.log.covered_mask(base._clock_vec(heads))
             obs.count("device.kernel_launches", labels={"path": "per_doc"})
-            res = merge_columns(
-                base.log.padded_columns(covered=covered),
-                fetch=self.VIEW_FETCH,
-                n_objs=base.log.n_objs,
-                n_props=len(base.log.props),
-            )
+            _prof.note("launches")
+            with _prof.annotate("amtpu.at_view"):
+                res = merge_columns(
+                    base.log.padded_columns(covered=covered),
+                    fetch=self.VIEW_FETCH,
+                    n_objs=base.log.n_objs,
+                    n_props=len(base.log.props),
+                )
             view = DeviceDoc(base.log, res, covered=covered, base=base)
             base._views[key] = view
         return view

@@ -45,6 +45,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import obs
+from ..obs import prof as _prof
 from ..ops.merge import (
     NONE32,
     _ceil_log2,
@@ -568,6 +569,7 @@ def sharded_merge_columns(
         }
 
     obs.count("device.kernel_launches", labels={"path": "sharded"})
+    _prof.note("launches")
     if transport == "packed":
         static_key, arrays = encode_transport(cols_np)
         fn = _make_sharded_fn(
@@ -580,7 +582,8 @@ def sharded_merge_columns(
                 for k, v in arrays.items()
             }
             cond = put_cond() if R2 else None
-        with obs.span("parallel.kernel", rows=Ptot, devices=n):
+        with obs.span("parallel.kernel", rows=Ptot, devices=n), \
+                _prof.annotate("amtpu.sharded_launch"):
             out = fn(arrs, cond) if R2 else fn(arrs)
     else:
         with obs.span("parallel.h2d", rows=Ptot):
@@ -590,7 +593,8 @@ def sharded_merge_columns(
             }
             cond = put_cond() if R2 else None
         fn = _make_sharded_fn(mesh, Ptot, n_objs2, np_eff, None, R2)
-        with obs.span("parallel.kernel", rows=Ptot, devices=n):
+        with obs.span("parallel.kernel", rows=Ptot, devices=n), \
+                _prof.annotate("amtpu.sharded_launch"):
             out = fn(cols, cond) if R2 else fn(cols)
     with obs.span("parallel.readback", rows=Ptot):
         return {k: np.asarray(v) for k, v in out.items()}

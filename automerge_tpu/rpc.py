@@ -1032,6 +1032,35 @@ class RpcServer:
             }
         raise ValueError(f"unknown metrics format {fmt!r}")
 
+    def perfStatus(self, p):
+        """The drain-cycle performance observatory's merged report
+        (obs/prof.py): cumulative per-stage attribution with a
+        host-vs-device split, batch occupancy, docs-per-launch,
+        drain-cycle and queue-wait percentiles, and the bounded top-K
+        expensive-docs table. ``{"top": n}`` sizes the doc table."""
+        from .obs import prof
+
+        top = p.get("top")
+        return prof.profiler.status(top=int(top) if top is not None else None)
+
+    def profileStart(self, p):
+        """Start a ``jax.profiler`` device-trace capture with named
+        annotations on every kernel-launch site; ``{"dir": path}``
+        overrides the capture directory (default: a fresh temp dir,
+        named in the response). Degrades cleanly where the profiler
+        backend is unavailable: the answer is ``{"ok": false, "reason":
+        ...}``, never an error (the ``enable_mesh`` contract)."""
+        from .obs import prof
+
+        return prof.jax_profile_start(p.get("dir"))
+
+    def profileStop(self, p):
+        """Stop the active ``jax.profiler`` capture; the response names
+        the trace directory."""
+        from .obs import prof
+
+        return prof.jax_profile_stop()
+
     # -- dispatch -----------------------------------------------------------
 
     # explicit allowlist: getattr dispatch must never reach serve/handle or
@@ -1052,7 +1081,7 @@ class RpcServer:
         "openDurable", "durableCompact", "durableInfo", "durableReopen",
         "chaosDisk",
         "storeStatus", "storeDemote", "docFence",
-        "metrics",
+        "metrics", "perfStatus", "profileStart", "profileStop",
     })
 
     def handle(self, req: dict) -> dict:

@@ -68,6 +68,29 @@ def git_commit():
         return None
 
 
+def host_fingerprint():
+    """Which box produced these numbers. scripts/ci/perf_gate refuses to
+    compare trajectory points whose fingerprints differ — a number from
+    a different host is a different experiment, not a regression."""
+    import platform
+
+    fp = {
+        "cpu_count": os.cpu_count(),
+        "machine": platform.machine(),
+        "platform": platform.platform(),
+        "python": platform.python_version(),
+    }
+    try:
+        import jax
+
+        fp["jax_backend"] = jax.default_backend()
+        fp["jax_device_count"] = jax.device_count()
+    except Exception:
+        fp["jax_backend"] = None
+        fp["jax_device_count"] = 0
+    return fp
+
+
 def main():
     # Benchmark hygiene (what pytest-benchmark and criterion do): cyclic-GC
     # pauses are runtime noise, not framework cost — the store's bulk builds
@@ -1028,6 +1051,7 @@ def main():
     sb_cfg = {}
     try:
         if env_flag("BENCH_SERVE_BATCHED", "1") != "0":
+            from automerge_tpu.obs import prof
             from automerge_tpu.ops.batched import apply_cross_doc
 
             sb_docs = env_int("BENCH_SB_DOCS", 32)
@@ -1071,7 +1095,10 @@ def main():
                     wl.append((chs, cycles))
                 return wl
 
-            def sb_run(wl, max_per_launch):
+            def sb_run(wl, max_per_launch, reports=None):
+                """``reports`` (a list, if given) collects one profiler
+                cycle report per drain cycle — the observatory's
+                attribution for exactly these drains."""
                 devs = [
                     DeviceDoc.resolve(OpLog.from_changes(chs))
                     for chs, _ in wl
@@ -1079,10 +1106,14 @@ def main():
                 l0 = sb_launches()
                 t0 = time.perf_counter()
                 for c in range(sb_cycles):
-                    apply_cross_doc(
-                        [(devs[i], [wl[i][1][c]]) for i in range(sb_docs)],
-                        max_docs_per_launch=max_per_launch,
-                    )
+                    with prof.cycle(kind="bench_drain") as cyc:
+                        apply_cross_doc(
+                            [(devs[i], [wl[i][1][c]])
+                             for i in range(sb_docs)],
+                            max_docs_per_launch=max_per_launch,
+                        )
+                    if reports is not None and cyc.report is not None:
+                        reports.append(cyc.report)
                 dt = time.perf_counter() - t0
                 l1 = sb_launches()
                 dl = {
@@ -1100,11 +1131,18 @@ def main():
             sb_run(sb_workload(1), 1)
             sb_run(sb_workload(1), None)
             t_per = t_bat = float("inf")
+            cycle_reports = []
             for _ in range(max(reps, 1)):
                 devs_p, dt_p, l_per = sb_run(wl, 1)
-                devs_b, dt_b, l_bat = sb_run(wl, None)
+                devs_b, dt_b, l_bat = sb_run(
+                    wl, None, reports=cycle_reports
+                )
                 t_per = min(t_per, dt_p)
                 t_bat = min(t_bat, dt_b)
+            # the observatory's view of the batched drains: >=90% of the
+            # measured drain wall clock attributed to named stages, with
+            # the host/device split and the pack-site occupancy figure
+            cycle_report = prof.summarize_reports(cycle_reports)
             # both modes must materialize identical documents
             for i in (0, sb_docs // 2, sb_docs - 1):
                 assert devs_p[i].hydrate() == devs_b[i].hydrate(), i
@@ -1127,6 +1165,8 @@ def main():
                     l_bat.get("batched", 0) / sb_cycles, 2
                 ),
                 "uplift_vs_per_doc": round(t_per / t_bat, 2),
+                "occupancy": cycle_report["occupancy"],
+                "cycle_report": cycle_report,
             }
             del devs_p, devs_b, wl
     except Exception as e:  # noqa: BLE001 — degrade, record, continue
@@ -1550,8 +1590,11 @@ def main():
         "unit": "ops/s",
         "vs_baseline": results["fanin"]["vs_baseline"],
         # provenance: which code produced these numbers, under exactly
-        # which resolved knobs — the JSON is self-describing across PRs
+        # which resolved knobs, on which box — the JSON is
+        # self-describing across PRs and perf_gate can refuse to compare
+        # points from different hosts
         "git_commit": git_commit(),
+        "host": host_fingerprint(),
         "schema_version": BENCH_SCHEMA_VERSION,
         "config": dict(sorted(RESOLVED_CONFIG.items())),
         # memory trajectory alongside throughput: this process's peak
@@ -1569,6 +1612,15 @@ def main():
         # counter each dispatch site increments)
         "kernel_launches": obs.counter_values(
             "device.kernel_launches", "path"
+        ),
+        # pack-site occupancy across every batched launch of the run:
+        # useful rows / (useful + padded) from the device.batch_rows /
+        # device.batch_padding_rows counters (None = nothing packed)
+        "batch_occupancy": (
+            lambda u, p: round(u / (u + p), 4) if (u + p) else None
+        )(
+            obs.counter_values("device.batch_rows", "").get("", 0),
+            obs.counter_values("device.batch_padding_rows", "").get("", 0),
         ),
         # span-ring health: how much of the run the flight recorder /
         # Perfetto export can still see (dropped > 0 means the ring
