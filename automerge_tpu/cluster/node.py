@@ -30,6 +30,7 @@ handshake renegotiates in one round instead of a full resync.
 from __future__ import annotations
 
 import base64
+import contextlib
 import os
 import threading
 import time
@@ -90,6 +91,11 @@ class ClusterRpcServer(RpcServer):
         self.hub: Optional[ReplicationHub] = None
         self.last_leader_contact = 0.0
         self._role_lock = threading.RLock()
+        # set by the node's batched follower drain for the duration of a
+        # coalesced replApply run (the repl shard is single-threaded):
+        # apply_replicated hands each doc's applied changes here instead
+        # of leaving the device mirror untouched
+        self._repl_device_feed = None
 
     # -- gating --------------------------------------------------------------
 
@@ -187,7 +193,8 @@ class ClusterRpcServer(RpcServer):
                       links=obs.decode_wire_traces(p.get("traces")),
                       records=len(records)):
             applied = doc.apply_replicated(
-                records, base64.b64decode(p["cursor"]))
+                records, base64.b64decode(p["cursor"]),
+                device_feed=self._repl_device_feed)
         obs.count("cluster.records_applied", n=len(records))
         return {"lsn": int(p["lsn"]), "applied": applied}
 
@@ -489,6 +496,159 @@ class ClusterNode(SocketRpcServer):
                 if h is not None:
                     return h
         return super()._affinity(req)
+
+    # -- batched follower apply ----------------------------------------------
+    #
+    # A drained grab of the replication shard's queue holds replApply
+    # requests for MANY documents (the leader ships per doc, the pool
+    # batches up to max_batch per grab). The old path replayed them
+    # per-request and serially; now adjacent replApply frames coalesce
+    # into one run: same-doc sub-runs share one ack scope (one fsync per
+    # doc per drain instead of one per shipped batch), and every touched
+    # device mirror's feed drains through ONE vectorized cross-doc
+    # staging pass + shared launch (ops/host_batch.py) — the follower
+    # applies at the same super-batch discipline as the serve drain, so
+    # replication lag stops being the ceiling for follower reads.
+    # ``AUTOMERGE_TPU_REPL_BATCH=0`` forces the old serial path (the
+    # bench / soak A/B knob).
+
+    @staticmethod
+    def _repl_batch_enabled() -> bool:
+        return os.environ.get("AUTOMERGE_TPU_REPL_BATCH", "1") != "0"
+
+    def _coalesce_key(self, req):
+        if req.get("method") == "replApply" and self._repl_batch_enabled():
+            # every adjacent replApply frame coalesces regardless of its
+            # target doc — the batched drain groups per doc itself
+            return ("replApply",)
+        return super()._coalesce_key(req)
+
+    def _coalesce_single(self, method) -> bool:
+        if method == "replApply":
+            return True
+        return super()._coalesce_single(method)
+
+    def _run_coalesced(self, run, out) -> None:
+        if run[0][1].get("method") == "replApply":
+            self._run_repl_apply(run, out)
+            return
+        super()._run_coalesced(run, out)
+
+    def _run_repl_apply(self, run, out) -> None:
+        rpc = self.rpc
+        obs.observe("cluster.repl_apply_batch_size", len(run))
+        if len(run) > 1:
+            obs.count("rpc.coalesced", n=len(run),
+                      labels={"method": "replApply"})
+        feeds: list = []
+
+        def defer_feed(doc, dev, changes):
+            feeds.append((doc, dev, [changes]))
+
+        i = 0
+        while i < len(run):
+            name = (run[i][1].get("params") or {}).get("name")
+            j = i
+            while (
+                j + 1 < len(run)
+                and (run[j + 1][1].get("params") or {}).get("name") == name
+            ):
+                j += 1
+            group = run[i : j + 1]
+            scope = None
+            if len(group) > 1 and isinstance(name, str):
+                # same-doc sub-run: one shared ack scope — the nested
+                # apply_replicated scopes defer their fsync to this exit
+                try:
+                    doc = rpc._repl_doc(name)
+                    scope = getattr(doc, "ack_scope", None)
+                except Exception:  # noqa: BLE001 — handle() reports it
+                    scope = None
+            first = len(out)
+            rpc._repl_device_feed = defer_feed
+            try:
+                with scope() if scope is not None else (
+                    contextlib.nullcontext()
+                ):
+                    for conn2, req2 in group:
+                        out.append((conn2, rpc.handle(req2)))
+            except Exception as e:  # the shared group fsync failed
+                # an un-fsynced ack is no ack: convert the sub-run
+                obs.count("rpc.errors", labels={
+                    "method": "replApply", "type": type(e).__name__})
+                err = {"type": type(e).__name__,
+                       "message": f"replicated group commit failed: {e}"}
+                retriable = getattr(e, "retriable", None)
+                if retriable is None and isinstance(e, OSError):
+                    retriable = True
+                if retriable is not None:
+                    err["retriable"] = bool(retriable)
+                out[first:] = [
+                    (c, r if "error" in r else {
+                        "id": r.get("id"), "error": dict(err)})
+                    for c, r in out[first:]
+                ]
+            finally:
+                rpc._repl_device_feed = None
+            i = j + 1
+        if feeds:
+            self._feed_repl_mirrors(feeds)
+
+    def _feed_repl_mirrors(self, feeds) -> None:
+        """One vectorized cross-doc staging pass + shared launch for
+        every device mirror the drained replApply run touched — the
+        follower-side analogue of the serve drain's batcher feed.
+        Mirror failures are isolated (the journaled host apply already
+        acked; it is authoritative): a mirror whose feed errored is
+        dropped and rebuilt on its next use instead of serving stale
+        reads."""
+        from ..ops import host_batch
+        from ..ops.batched import resolve_stages
+
+        try:
+            docs = {}
+            for doc, _dev, _b in feeds:
+                docs.setdefault(id(doc), doc)
+            with contextlib.ExitStack() as st:
+                # deterministic multi-lock order; single-lock takers
+                # (background compaction) cannot form a cycle with it
+                for doc in sorted(
+                    docs.values(),
+                    key=lambda d: str(getattr(d, "path", "")),
+                ):
+                    st.enter_context(doc.lock)
+                stages, results = host_batch.stage_docs(
+                    [(dev, b) for _doc, dev, b in feeds]
+                )
+                bad = {
+                    key for key, r in results.items()
+                    if r.error is not None
+                }
+                if stages:
+                    resolve_stages(
+                        [s for s in stages if id(s.doc) not in bad]
+                    )
+                # one error/drop per DOCUMENT: feeds holds one entry per
+                # coalesced frame, and a 10-frame doc must not count 10
+                # errors or drop its mirror 10 times
+                dropped = set()
+                for doc, dev, _b in feeds:
+                    if id(dev) in bad and id(dev) not in dropped:
+                        dropped.add(id(dev))
+                        obs.count("cluster.repl_device_feed_error")
+                        obs.event("cluster.repl_device_feed_error",
+                                  doc=str(getattr(doc, "obs_name", "")),
+                                  error=str(results[id(dev)].error)[:200])
+                        doc.drop_device_mirror()
+        except Exception as e:  # noqa: BLE001 — never fail the acked path
+            obs.count("cluster.repl_device_feed_error")
+            obs.event("cluster.repl_device_feed_error", error=str(e)[:200])
+            # a failed staging/launch leaves mirrors part-updated: drop
+            # them all; build_device_mirror recovers from history on the
+            # next use (never serve a possibly-corrupt resolution)
+            for doc, _dev, _b in feeds:
+                with contextlib.suppress(Exception):
+                    doc.drop_device_mirror()
 
     def _stop_inner(self) -> None:
         hub = self.rpc.hub

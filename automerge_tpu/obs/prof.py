@@ -82,6 +82,10 @@ STAGES: Dict[str, tuple] = {
     "device.stage.dedup": ("dedup", "host"),
     "device.stage.causal_order": ("causal_order", "host"),
     "device.stage.splice": ("splice", "host"),
+    # the vectorized cross-doc staging passes (ops/host_batch.py): one
+    # span each per drain, covering every packed document at once
+    "host.pack": ("host_pack", "host"),
+    "host.sort": ("host_sort", "host"),
     "device.materialize": ("materialize", "host"),
     "device.delta_resolve": ("delta_resolve", "host"),
     "device.extract": ("extract", "host"),
@@ -109,13 +113,17 @@ PARENTS: Dict[str, tuple] = {
     "device.stage.splice": ("splice", "host"),
     "device.batched": (None, "device"),
     "rpc.request": (None, "host"),
+    # the cross-doc splice is a stage row AND a parent umbrella, so any
+    # span a future splice internals nests stays breakdown-only
+    "host.splice": ("host_splice", "host"),
 }
 
 # host breakdown rows that partition the host side without overlapping
 # each other (extract lives inside splice, so it is excluded): host_other
 # in a report is host - sum(these) - nested device time
 _HOST_EXCLUSIVE = ("dedup", "causal_order", "splice", "materialize",
-                   "delta_resolve", "write")
+                   "delta_resolve", "write", "host_pack", "host_sort",
+                   "host_splice")
 
 _NOTE_KEYS = ("useful_rows", "padded_rows", "launches", "docs", "changes")
 
@@ -397,6 +405,19 @@ class CycleProfiler:
         out["enabled"] = self.enabled
         out["jax_profiler"] = dict(_jax_trace)
         out["top_docs"] = self.top_docs(top)
+        # extraction-cache efficacy: extract is a named dominant host
+        # stage, and hit ratio is what separates "re-decoding the same
+        # changes" from real staging work (None = never consulted)
+        hits = _obs.counter_values("extract.change_cache_hit", "").get("", 0)
+        misses = _obs.counter_values(
+            "extract.change_cache_miss", "").get("", 0)
+        out["extract_cache"] = {
+            "hits": hits,
+            "misses": misses,
+            "cache_hit_ratio": (
+                round(hits / (hits + misses), 4) if (hits + misses) else None
+            ),
+        }
         out["drain_cycle_seconds"] = {
             f"p{int(q * 100)}": round(v, 6)
             for q, v in _obs.percentiles("drain.cycle_seconds").items()
@@ -565,6 +586,31 @@ def render_text(summary: dict, top: Optional[int] = None) -> str:
         f"fsync {summary.get('fsync_pct', 0.0):.1f}%"
     )
     stages = summary.get("stages") or {}
+    # the host/device share of the measured drain wall itself, plus how
+    # the host half split between the vectorized cross-doc staging
+    # passes (host_pack/host_sort/host_splice) and the scalar per-doc
+    # fallback (splice) — the ROADMAP item 4 acceptance line
+    wall = summary.get("wall_s", 0.0)
+    if wall > 0:
+        hs = summary.get("host_s", 0.0)
+        ds = summary.get("device_s", 0.0)
+        vec = sum(
+            stages.get(k, {}).get("seconds", 0.0)
+            for k in ("host_pack", "host_sort", "host_splice")
+        )
+        sca = stages.get("splice", {}).get("seconds", 0.0)
+        lines.append(
+            f"share of wall: host {100.0 * hs / wall:.1f}%  |  "
+            f"device {100.0 * ds / wall:.1f}%   "
+            f"(host staging: vectorized {100.0 * vec / wall:.1f}%, "
+            f"scalar {100.0 * sca / wall:.1f}%)"
+        )
+    ec = summary.get("extract_cache") or {}
+    if ec.get("cache_hit_ratio") is not None:
+        lines.append(
+            f"extract cache: {100.0 * ec['cache_hit_ratio']:.1f}% hits "
+            f"({ec.get('hits', 0)}/{ec.get('hits', 0) + ec.get('misses', 0)})"
+        )
     if stages:
         lines.append(f"  {'stage':<14} {'seconds':>10} {'% wall':>8}")
         for k, v in stages.items():

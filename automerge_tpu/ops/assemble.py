@@ -840,4 +840,8 @@ def assemble_log(log, changes: Sequence, rank_of: Dict[bytes, int]):
         log.n_objs = int(out_meta[0])
         log.obj_table = obj_table_buf[: log.n_objs].copy()
         log.obj_dense = obj_dense
+    from .oplog import ELEM_MISSING
+
+    log.n_miss_elem = int(np.count_nonzero(log.elem_ref == ELEM_MISSING))
+    log.n_miss_pred = int(np.count_nonzero(log.pred_tgt < 0))
     return log

@@ -258,10 +258,10 @@ def apply_cross_doc(
     packed launches, "fallback": docs resolved per-doc}.
     """
     # the same DeviceDoc may appear several times in ``work``; its
-    # batches must merge into ONE stage_batches call — a later append
-    # splices the log and would silently invalidate an earlier stage's
-    # row/object indices (apply_batches remaps its in-flight handle for
-    # exactly this; the stage path merges up front instead)
+    # batches must merge into ONE staging — a later append splices the
+    # log and would silently invalidate an earlier stage's row/object
+    # indices (apply_batches remaps its in-flight handle for exactly
+    # this; the stage path merges up front instead)
     merged: dict = {}
     order: List[int] = []
     for dev, batches in work:
@@ -273,17 +273,33 @@ def apply_cross_doc(
             order.append(k)
     applied = 0
     stages: List[BatchStage] = []
-    for i, k in enumerate(order):
-        dev, batches = merged[k]
-        t0 = time.perf_counter()
-        n, st = dev.stage_batches(batches)
-        _prof.note_doc(
-            getattr(dev, "obs_name", None) or f"doc{i}",
-            time.perf_counter() - t0,
+    from . import host_batch
+
+    if host_batch.enabled():
+        # the vectorized cross-doc staging: dedup/causal-order/extract/
+        # Lamport-sort/splice run as shared columnar passes with per-doc
+        # offset ranges; ineligible documents stage through the scalar
+        # path inside (host_batch.stage_docs merges duplicates itself,
+        # but the merge above also backs the scalar branch below)
+        stages, results = host_batch.stage_docs(
+            [merged[k] for k in order]
         )
-        applied += n
-        if st is not None:
-            stages.append(st)
+        for r in results.values():
+            if r.error is not None:
+                raise r.error
+            applied += r.applied
+    else:
+        for i, k in enumerate(order):
+            dev, batches = merged[k]
+            t0 = time.perf_counter()
+            n, st = dev.stage_batches(batches)
+            _prof.note_doc(
+                getattr(dev, "obs_name", None) or f"doc{i}",
+                time.perf_counter() - t0,
+            )
+            applied += n
+            if st is not None:
+                stages.append(st)
     _prof.note("docs", len(order))
     _prof.note("changes", applied)
     out = {"applied": applied, "batched": 0, "fallback": 0}
@@ -298,11 +314,28 @@ def apply_cross_doc(
 # -- the serving-layer collector ---------------------------------------------
 
 
+class _Submission:
+    """One document's raw drained batches awaiting the leader-staged
+    vectorized flush (host_batch mode): the submitter keeps holding its
+    document lock while the flush leader stages every co-arriving
+    document in one columnar pass."""
+
+    __slots__ = ("dev", "batches", "trace", "applied", "error")
+
+    def __init__(self, dev, batches, trace):
+        self.dev = dev
+        self.batches = batches
+        self.trace = trace
+        self.applied = 0
+        self.error: Optional[BaseException] = None
+
+
 class _Generation:
-    __slots__ = ("stages", "done")
+    __slots__ = ("stages", "subs", "done")
 
     def __init__(self):
-        self.stages: List[BatchStage] = []
+        self.stages: List[BatchStage] = []  # scalar (submitter-staged)
+        self.subs: List[_Submission] = []  # vectorized (leader-staged)
         self.done = threading.Event()
 
 
@@ -369,9 +402,23 @@ class CrossDocBatcher:
 
     def apply(self, dev, batches) -> int:
         """Stage ``dev``'s drained batches and resolve them in the next
-        shared launch; blocks until resolved. Returns changes applied."""
+        shared launch; blocks until resolved. Returns changes applied.
+
+        With the vectorized host staging active (the default,
+        ``AUTOMERGE_TPU_HOST_BATCH``), the submitter hands its RAW
+        batches over and the generation's flush leader stages every
+        co-arriving document in one shared columnar pass
+        (host_batch.stage_docs) before the shared kernel launch — the
+        submitter keeps holding its document lock while it waits, so the
+        single-writer discipline is unchanged. With the knob off, each
+        submitter stages its own document (the scalar per-doc path) and
+        only the launch is shared, exactly as before."""
         if not self.active():
             return dev.apply_batches(batches)
+        from . import host_batch
+
+        if host_batch.enabled():
+            return self._apply_leader_staged(dev, batches)
         t0 = time.perf_counter()
         applied, stage = dev.stage_batches(batches)
         _prof.note("docs")
@@ -387,39 +434,105 @@ class CrossDocBatcher:
         with self._cv:
             gen = self._gen
             gen.stages.append(stage)
-            leader = len(gen.stages) == 1
-            if not leader and len(gen.stages) >= self.max_docs:
+            # leadership is elected over BOTH submission kinds: a
+            # mid-generation AUTOMERGE_TPU_HOST_BATCH flip can mix
+            # leader-staged subs and submitter-staged stages in one
+            # generation, and exactly ONE leader must flush it
+            leader = len(gen.stages) + len(gen.subs) == 1
+            if not leader and len(gen.stages) + len(gen.subs) >= self.max_docs:
                 self._cv.notify_all()  # wake the leader early
         if leader:
-            deadline = time.monotonic() + self.window
-            with self._cv:
-                while len(gen.stages) < self.max_docs:
-                    left = deadline - time.monotonic()
-                    if left <= 0:
-                        break
-                    self._cv.wait(left)
-                if self._gen is gen:  # close the generation we lead
-                    self._gen = _Generation()
-            self._flush(gen)
+            self._lead(gen)
         else:
             gen.done.wait()
         if stage.error is not None:
             raise stage.error
         return applied
 
+    def _apply_leader_staged(self, dev, batches) -> int:
+        sub = _Submission(dev, list(batches), obs.current_trace_context())
+        with self._cv:
+            gen = self._gen
+            gen.subs.append(sub)
+            leader = len(gen.stages) + len(gen.subs) == 1
+            if not leader and len(gen.stages) + len(gen.subs) >= self.max_docs:
+                self._cv.notify_all()  # wake the leader early
+        if leader:
+            self._lead(gen)
+        else:
+            gen.done.wait()
+        if sub.error is not None:
+            raise sub.error
+        return sub.applied
+
+    def _lead(self, gen: _Generation) -> None:
+        """The (single) flush leader: wait out the batch window for
+        co-arriving documents, close the generation, flush it."""
+        deadline = time.monotonic() + self.window
+        with self._cv:
+            while len(gen.stages) + len(gen.subs) < self.max_docs:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                self._cv.wait(left)
+            if self._gen is gen:  # close the generation we lead
+                self._gen = _Generation()
+        self._flush(gen)
+
     def _flush(self, gen: _Generation) -> None:
+        """Close one generation: stage any leader-staged submissions in
+        one vectorized pass (host_batch.stage_docs), merge them with any
+        submitter-staged stages (the scalar-knob mode — an env-knob flip
+        mid-generation can mix the two; both drain here), launch once,
+        and release every waiter. On failure everything degrades per
+        doc."""
+        from . import host_batch
+
+        stages: List[BatchStage] = list(gen.stages)
+        subs_staged = False
         try:
-            resolve_stages(gen.stages, self.fallback_ratio)
+            if gen.subs:
+                more, results = host_batch.stage_docs(
+                    [(s.dev, s.batches) for s in gen.subs]
+                )
+                subs_staged = True
+                trace_of = {}
+                n_changes = 0
+                for s in gen.subs:
+                    r = results.get(id(s.dev))
+                    if r is not None:
+                        s.applied = r.applied
+                        s.error = r.error
+                        n_changes += r.applied
+                    if s.trace is not None:
+                        trace_of.setdefault(id(s.dev), s.trace)
+                for st in more:
+                    st.trace = trace_of.get(id(st.doc))
+                _prof.note("docs", len(gen.subs))
+                _prof.note("changes", n_changes)
+                stages.extend(more)
+            resolve_stages(stages, self.fallback_ratio)
         except BaseException as e:  # noqa: BLE001 — degrade per doc
             obs.count("device.batched_error")
-            for st in gen.stages:
+            recovered = True
+            for st in stages:
                 try:
                     st.doc._reresolve(st.dirty)
                 except BaseException as e2:  # noqa: BLE001
                     st.error = e2
-            # the leader's own caller still sees the original failure if
-            # even its per-doc fallback could not recover
-            if gen.stages and gen.stages[0].error is None:
+                    recovered = False
+            if not subs_staged:
+                # staging itself failed before any submission's state
+                # moved: every leader-staged submitter must see it
+                for s in gen.subs:
+                    if s.error is None:
+                        s.error = e
+            for st in stages:
+                if st.error is not None:
+                    for s in gen.subs:
+                        if s.dev is st.doc and s.error is None:
+                            s.error = st.error
+            if recovered and stages:
                 obs.event("device.batched_recovered", error=str(e)[:200])
         finally:
             gen.done.set()

@@ -299,38 +299,51 @@ class DeviceDoc:
         ``apply_changes`` takes, run eagerly so a returned stage is
         always pack-eligible.
         """
-        from .batched import BatchStage
-
         if self._base is not self:
             raise ValueError("stage_batches on a historical view; use the base doc")
         # same umbrella as apply_changes: the whole host staging half is
         # one contiguous device.apply region for cycle attribution
         with obs.span("device.apply", batches=len(batches)):
             ready = self._take_ready([ch for b in batches for ch in b])
-            if not ready:
-                return 0, None
-            if self.log.n:
-                with obs.span("device.stage.splice", changes=len(ready)):
-                    info = self.log.append_changes(ready)
-            else:
-                info = None
-            if info is None:
-                obs.count("device.apply_rebuild")
-                self._rebuild(list(self.log.changes) + ready)
-                return len(ready), None
-            self._apply_append(info, ready)
-            if not info.n_new:
-                return len(ready), None
-            dirty = np.asarray(info.dirty_objs, np.int64)
-            rows = self._subset_rows(dirty)
-            if (
-                len(rows) / self.log.n > self._dirty_fraction_limit()
-                or len(dirty) >= self.log.n_objs
-            ):
-                self._reresolve(dirty)
-                self._export_doc_gauges()
-                return len(ready), None
+            return self._stage_ready(ready)
+
+    def stage_ready(self, ready: Sequence):
+        """``stage_batches`` over an already-deduped/causally-ordered
+        ready list — the scalar per-doc fallback (and differential
+        oracle) of the cross-doc vectorized staging in
+        ops/host_batch.py, which runs ``_take_ready``'s halves itself."""
+        if self._base is not self:
+            raise ValueError("stage_ready on a historical view; use the base doc")
+        with obs.span("device.apply", changes=len(ready)):
+            return self._stage_ready(ready)
+
+    def _stage_ready(self, ready):
+        from .batched import BatchStage
+
+        if not ready:
+            return 0, None
+        if self.log.n:
+            with obs.span("device.stage.splice", changes=len(ready)):
+                info = self.log.append_changes(ready)
+        else:
+            info = None
+        if info is None:
+            obs.count("device.apply_rebuild")
+            self._rebuild(list(self.log.changes) + ready)
+            return len(ready), None
+        self._apply_append(info, ready)
+        if not info.n_new:
+            return len(ready), None
+        dirty = np.asarray(info.dirty_objs, np.int64)
+        rows = self._subset_rows(dirty)
+        if (
+            len(rows) / self.log.n > self._dirty_fraction_limit()
+            or len(dirty) >= self.log.n_objs
+        ):
+            self._reresolve(dirty)
             self._export_doc_gauges()
+            return len(ready), None
+        self._export_doc_gauges()
         return len(ready), BatchStage(self, rows, dirty)
 
     def pending_changes(self) -> int:
@@ -342,29 +355,40 @@ class DeviceDoc:
         already holds; buffer changes with missing deps. The two halves
         are timed separately (``device.stage.dedup`` /
         ``device.stage.causal_order``) — the drain-cycle profiler's host
-        stage attribution starts here."""
+        stage attribution starts here. The cross-doc host staging path
+        (ops/host_batch.py) calls the two span-free halves directly and
+        wraps each ONCE for a whole multi-document drain."""
         with obs.span("device.stage.dedup", changes=len(changes)):
-            have = self._hash_index
-            pend = self._pending
-            for ch in changes:
-                h = ch.hash
-                if h is None or h in have or h in pend:
-                    continue
-                pend[h] = ch
-        with obs.span("device.stage.causal_order", pending=len(pend)):
-            ready: list = []
-            ready_set: set = set()
-            progress = True
-            while progress and pend:
-                progress = False
-                for h in list(pend):
-                    ch = pend[h]
-                    if all(d in have or d in ready_set
-                           for d in ch.dependencies):
-                        ready.append(ch)
-                        ready_set.add(h)
-                        del pend[h]
-                        progress = True
+            self._dedup_into_pending(changes)
+        with obs.span("device.stage.causal_order",
+                      pending=len(self._pending)):
+            return self._drain_ready_pending()
+
+    def _dedup_into_pending(self, changes: Sequence) -> None:
+        have = self._hash_index
+        pend = self._pending
+        for ch in changes:
+            h = ch.hash
+            if h is None or h in have or h in pend:
+                continue
+            pend[h] = ch
+
+    def _drain_ready_pending(self) -> list:
+        have = self._hash_index
+        pend = self._pending
+        ready: list = []
+        ready_set: set = set()
+        progress = True
+        while progress and pend:
+            progress = False
+            for h in list(pend):
+                ch = pend[h]
+                if all(d in have or d in ready_set
+                       for d in ch.dependencies):
+                    ready.append(ch)
+                    ready_set.add(h)
+                    del pend[h]
+                    progress = True
         if pend:
             obs.count("device.apply_deferred", n=len(pend))
         return ready
@@ -724,6 +748,19 @@ class DeviceDoc:
     # dirty-set re-resolution ------------------------------------------------
 
     def _subset_rows(self, dirty: np.ndarray) -> np.ndarray:
+        base = self._base
+        if len(dirty) == 1 and base is self:
+            # one dirty object — the dominant serve-delta shape: its rows
+            # are one contiguous slice of the maintained object-sorted
+            # index, O(subset) instead of a full-log membership scan.
+            # Rows within an object ascend in _rows_by_obj (stable
+            # construction + ordered merges); the stable integer sort is
+            # a near-free belt-and-braces pass that keeps the ascending
+            # (= Lamport) contract the subset kernel relies on.
+            key = int(self.log.obj_table[int(dirty[0])])
+            lo = np.searchsorted(self._obj_sorted, key, side="left")
+            hi = np.searchsorted(self._obj_sorted, key, side="right")
+            return np.sort(self._rows_by_obj[lo:hi], kind="stable")
         od = np.asarray(self.log.obj_dense)
         idx = np.searchsorted(dirty, od)
         member = (idx < len(dirty)) & (

@@ -68,6 +68,23 @@ _TAG_FOR = {
 }
 
 
+def join_rows(sorted_keys: np.ndarray, keys, missing: int) -> np.ndarray:
+    """Row indices of ``keys`` in the sorted packed-id column
+    ``sorted_keys`` (``missing`` for absent keys) — the one vectorized
+    id->row join shared by log finalize, the incremental append path and
+    the cross-doc host staging (ops/host_batch.py)."""
+    from .. import native
+
+    keys = np.asarray(keys, np.int64)
+    if native.available():
+        return native.join_rows(sorted_keys, keys, missing)
+    n = len(sorted_keys)
+    pos = np.searchsorted(sorted_keys, keys)
+    posc = np.clip(pos, 0, max(n - 1, 0)).astype(np.int32)
+    hit = (sorted_keys[posc] == keys) if n else np.zeros(len(keys), bool)
+    return np.where(hit, posc, np.int32(missing)).astype(np.int32)
+
+
 def pack_id(ctr: int, rank: int) -> int:
     return (int(ctr) << ACTOR_BITS) | int(rank)
 
@@ -112,6 +129,8 @@ class OpLog:
         "mark_name_idx",
         "elem_key",
         "pred_key",
+        "n_miss_elem",
+        "n_miss_pred",
         "_actor_order",
         "_hash_set",
         "_bufs",
@@ -127,6 +146,13 @@ class OpLog:
         self.n_objs = 1
         self.elem_key = None
         self.pred_key = None
+        # unresolved-reference counts (elem_ref == ELEM_MISSING rows /
+        # pred_tgt < 0 edges), maintained across appends: the cross-doc
+        # host staging fast path is only sound when there is nothing to
+        # re-resolve, and a full-column scan per drain to find that out
+        # would cost O(resident) per document
+        self.n_miss_elem = 0
+        self.n_miss_pred = 0
         self._actor_order = None
         self._hash_set = None
         self._bufs = None
@@ -361,17 +387,8 @@ class OpLog:
         inv = np.empty(n, np.int32)  # old row -> new row
         inv[order] = np.arange(n, dtype=np.int32)
 
-        from .. import native
-
-        if native.available():
-            def rows_of(keys: np.ndarray, missing: int) -> np.ndarray:
-                return native.join_rows(log.id_key, keys, missing)
-        else:
-            def rows_of(keys: np.ndarray, missing: int) -> np.ndarray:
-                pos = np.searchsorted(log.id_key, keys)
-                posc = np.clip(pos, 0, max(n - 1, 0)).astype(np.int32)
-                hit = (log.id_key[posc] == keys) if n else np.zeros(len(keys), bool)
-                return np.where(hit, posc, np.int32(missing)).astype(np.int32)
+        def rows_of(keys: np.ndarray, missing: int) -> np.ndarray:
+            return join_rows(log.id_key, keys, missing)
 
         # element references: HEAD=-1, map op=-2, missing=-3
         log.elem_ref = np.where(
@@ -411,6 +428,8 @@ class OpLog:
         # (re-resolving MISSING refs when the referenced op arrives later)
         log.elem_key = elem
         log.pred_key = pred_key
+        log.n_miss_elem = int(np.count_nonzero(log.elem_ref == ELEM_MISSING))
+        log.n_miss_pred = int(np.count_nonzero(log.pred_tgt < 0))
         return log
 
     @classmethod
@@ -768,19 +787,8 @@ class OpLog:
                         np.asarray(a["expand"], np.bool_)[order])
         mark_new = sp("mark_name_idx", self.mark_name_idx, d_mark)
 
-        from .. import native
-
-        if native.available():
-            def rows_of(keys):
-                return native.join_rows(id_new, np.asarray(keys, np.int64),
-                                        ELEM_MISSING)
-        else:
-            def rows_of(keys):
-                keys = np.asarray(keys, np.int64)
-                p = np.searchsorted(id_new, keys)
-                pc = np.clip(p, 0, m - 1).astype(np.int32)
-                hit = id_new[pc] == keys
-                return np.where(hit, pc, np.int32(ELEM_MISSING)).astype(np.int32)
+        def rows_of(keys):
+            return join_rows(id_new, keys, ELEM_MISSING)
 
         # -- element references --------------------------------------------
         old_er = self.elem_ref
@@ -797,10 +805,12 @@ class OpLog:
         er_new = sp("elem_ref", old_er.astype(np.int32, copy=False), d_er)
         # previously-MISSING refs may now resolve (their target arrived)
         rere_rows = np.empty(0, np.int64)
+        n_miss_elem = 0
         miss = np.flatnonzero(er_new == ELEM_MISSING)
         if len(miss):
             res = rows_of(ek_new[miss])
             got = res != ELEM_MISSING
+            n_miss_elem = int(len(miss) - np.count_nonzero(got))
             if np.any(got):
                 er_new[miss[got]] = res[got]
                 rere_rows = miss[got]
@@ -833,10 +843,12 @@ class OpLog:
         pk_new = cat("pred_key", remap_packed(self.pred_key), d_pk)
         # previously-unresolved pred targets may now resolve
         rere_pred = np.empty(0, np.int64)
+        n_miss_pred = 0
         pmiss = np.flatnonzero(pt_new == -1)
         if len(pmiss):
             res = rows_of(pk_new[pmiss])
             got = res != ELEM_MISSING
+            n_miss_pred = int(len(pmiss) - np.count_nonzero(got))
             if np.any(got):
                 pt_new[pmiss[got]] = res[got]
                 rere_pred = pmiss[got]
@@ -896,6 +908,8 @@ class OpLog:
         self.mark_names = mark_names
         self.n = m
         self.n_objs = len(new_table)
+        self.n_miss_elem = n_miss_elem
+        self.n_miss_pred = n_miss_pred
         self.actors = [ActorId(b) for b in all_bytes]
         self._actor_order = None
         self.changes.extend(fresh)

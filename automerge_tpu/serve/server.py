@@ -514,8 +514,8 @@ class SocketRpcServer:
                     # (a drain of 100 docs x 1 frame each is the case
                     # the batcher exists for)
                     if j > i or (
-                        req.get("method") in _COALESCE_METHODS
-                        and self.batcher.active()
+                        self._coalesce_key(req) is not None
+                        and self._coalesce_single(req.get("method"))
                     ):
                         self._run_coalesced(items[i : j + 1], out)
                     else:
@@ -563,33 +563,37 @@ class SocketRpcServer:
             for conn, payloads in grouped.values():
                 conn.send("\n".join(payloads) + "\n")
 
-    @staticmethod
-    def _coalesce_end(items, i) -> int:
-        """Last index of the run starting at ``i`` of coalescable receive
-        frames (length-1 runs return ``i``). ``receiveSyncMessage`` runs
-        on the document (frames from DIFFERENT peers still share one
-        device feed); ``syncSessionReceive`` runs on the session (the
-        run drains through that session's ``receive_many``)."""
-        conn, req = items[i]
+    def _coalesce_key(self, req) -> Optional[tuple]:
+        """Coalescing key for a request, or None when the method never
+        coalesces. ``receiveSyncMessage`` runs on the document (frames
+        from DIFFERENT peers still share one device feed);
+        ``syncSessionReceive`` runs on the session (the run drains
+        through that session's ``receive_many``). The cluster node
+        extends this with the follower's ``replApply`` stream."""
         method = req.get("method")
         if method not in _COALESCE_METHODS:
-            return i
+            return None
         params = req.get("params") or {}
-        hkey = (
+        return (
+            method,
             params.get("session") if method == "syncSessionReceive"
-            else params.get("doc")
+            else params.get("doc"),
         )
+
+    def _coalesce_single(self, method) -> bool:
+        """Whether a LENGTH-1 run of ``method`` still routes through the
+        coalesced path (so its device feed joins the cross-doc
+        batcher)."""
+        return self.batcher.active()
+
+    def _coalesce_end(self, items, i) -> int:
+        """Last index of the run starting at ``i`` of coalescable
+        frames (length-1 runs return ``i``)."""
+        key = self._coalesce_key(items[i][1])
+        if key is None:
+            return i
         j = i
-        while j + 1 < len(items):
-            nreq = items[j + 1][1]
-            nparams = nreq.get("params") or {}
-            nkey = (
-                nparams.get("session")
-                if method == "syncSessionReceive"
-                else nparams.get("doc")
-            )
-            if nreq.get("method") != method or nkey != hkey:
-                break
+        while j + 1 < len(items) and self._coalesce_key(items[j + 1][1]) == key:
             j += 1
         return j
 

@@ -727,14 +727,23 @@ class DurableDocument:
         j = self._journal
         return j.acked_seq, j.append_seq
 
-    def apply_replicated(self, records, cursor: Optional[bytes]) -> int:
+    def apply_replicated(self, records, cursor: Optional[bytes],
+                         *, device_feed=None) -> int:
         """Apply a batch of shipped journal records through the normal
         listener path: changes enter history (journaled locally before
         ack, deduplicated by hash exactly like a re-delivered sync
         frame), replicated meta overwrites latest-wins (so a peer's
         ``sync/<peer>`` shared_heads survive failover), and the cursor
         meta joins the SAME ack scope — one fsync covers the whole batch
-        and the cursor is durable iff the records are."""
+        and the cursor is durable iff the records are.
+
+        ``device_feed(doc, dev, changes)``: when given and a resident
+        device mirror exists, the applied changes are handed to it AFTER
+        the durable apply — the cluster node's batched follower drain
+        collects every drained document's feed into one vectorized
+        cross-doc staging pass (ops/host_batch.py) so the mirror keeps
+        up at super-batch speed. Without the hook the mirror is left
+        alone (the pre-existing serial behavior)."""
         from .change import parse_change
 
         changes = []
@@ -764,6 +773,10 @@ class DurableDocument:
                 self.set_meta(name, blob)
             if cursor is not None:
                 self.set_meta(REPL_CURSOR_KEY, cursor)
+        if changes and device_feed is not None:
+            dev = self.device_doc
+            if dev is not None:
+                device_feed(self, dev, changes)
         return len(changes)
 
     def apply_replicated_snapshot(self, data: bytes,

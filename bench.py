@@ -1622,6 +1622,23 @@ def main():
             obs.counter_values("device.batch_rows", "").get("", 0),
             obs.counter_values("device.batch_padding_rows", "").get("", 0),
         ),
+        # per-change-hash extraction-cache efficacy across the whole run:
+        # the observatory names extract as a dominant host stage, and
+        # this is the knob that decides how much of it is re-decode
+        # (hits/misses from extract.change_cache_hit/miss; None = the
+        # cache was never consulted)
+        "extract_cache": (
+            lambda h, ms: {
+                "hits": h,
+                "misses": ms,
+                "cache_hit_ratio": (
+                    round(h / (h + ms), 4) if (h + ms) else None
+                ),
+            }
+        )(
+            obs.counter_values("extract.change_cache_hit", "").get("", 0),
+            obs.counter_values("extract.change_cache_miss", "").get("", 0),
+        ),
         # span-ring health: how much of the run the flight recorder /
         # Perfetto export can still see (dropped > 0 means the ring
         # wrapped and the phase trace is a suffix, not the whole run)

@@ -567,3 +567,91 @@ def test_follower_replica_hydrates_from_cold_on_apply(tmp_path):
     finally:
         led.stop()
         fol.stop()
+
+
+# -- batched follower apply (host_batch feed point) ---------------------------
+
+
+def test_follower_apply_batching_feeds_device_mirrors(tmp_path):
+    """Shipped records drain through the batched follower path: same-doc
+    runs share an ack scope, the repl_apply_batch_size histogram
+    observes the drains, and every replica's resident device mirror is
+    fed through the vectorized cross-doc staging — mirrors converge to
+    the leader's state without a rebuild."""
+    from automerge_tpu import obs
+
+    fol = start_node(tmp_path, "fb1", role="follower")
+    led = start_node(tmp_path, "lb1", role="leader",
+                     replicate_to=[addr_of(fol)], ack_replicas=1)
+    try:
+        fc = Client(fol.address)
+        fh = {}
+        for name in ("dA", "dB", "dC"):
+            # replicas opened WITH device mirrors on the follower
+            # (openDurable is follower-ok)
+            fh[name] = fc.call("openDurable", name=name, device=True)["doc"]
+        c = Client(led.address)
+        for name in ("dA", "dB", "dC"):
+            d = c.call("openDurable", name=name)["doc"]
+            for i in range(6):
+                c.call("put", doc=d, obj="_root", prop=f"k{i}", value=i)
+                c.call("commit", doc=d)
+        for name in ("dA", "dB", "dC"):
+            doc = fol.rpc._docs[fh[name]]
+
+            def fresh(doc=doc):
+                with doc.lock:
+                    dev = doc.device_doc
+                    if dev is None:
+                        return False
+                    got = dev.hydrate().get("k5")
+                    return got == ("scalar", 5) or got == 5
+            wait_until(fresh, msg=f"device mirror of {name} converged")
+        hist = [e for e in obs.snapshot()
+                if e["name"] == "cluster.repl_apply_batch_size"]
+        assert hist and hist[0]["count"] > 0, hist
+        c.close()
+        fc.close()
+    finally:
+        led.stop()
+        fol.stop()
+
+
+def test_follower_apply_serial_knob_restores_old_path(tmp_path, monkeypatch):
+    """AUTOMERGE_TPU_REPL_BATCH=0 forces the pre-batching serial path:
+    no coalesced drains (mirror stays untouched — the A/B baseline),
+    replication itself still converges."""
+    monkeypatch.setenv("AUTOMERGE_TPU_REPL_BATCH", "0")
+    from automerge_tpu import obs
+
+    before = [e for e in obs.snapshot()
+              if e["name"] == "cluster.repl_apply_batch_size"]
+    n_before = before[0]["count"] if before else 0
+    fol = start_node(tmp_path, "fs1", role="follower")
+    led = start_node(tmp_path, "ls1", role="leader",
+                     replicate_to=[addr_of(fol)], ack_replicas=1)
+    try:
+        fc = Client(fol.address)
+        fh = fc.call("openDurable", name="dS", device=True)["doc"]
+        c = Client(led.address)
+        d = c.call("openDurable", name="dS")["doc"]
+        for i in range(4):
+            c.call("put", doc=d, obj="_root", prop=f"k{i}", value=i)
+            c.call("commit", doc=d)
+        # quorum acks already guarantee the follower holds the records
+        st = fc.call("clusterStatus")
+        assert st["docs"]["dS"]["cursor"]["lsn"] >= 4
+        doc = fol.rpc._docs[fh]
+        with doc.lock:
+            # host state converged, the mirror was NOT fed (old behavior)
+            assert doc.get("_root", "k3") is not None
+            assert doc.device_doc.hydrate() == {}
+        after = [e for e in obs.snapshot()
+                 if e["name"] == "cluster.repl_apply_batch_size"]
+        n_after = after[0]["count"] if after else 0
+        assert n_after == n_before, (n_before, n_after)
+        c.close()
+        fc.close()
+    finally:
+        led.stop()
+        fol.stop()

@@ -231,8 +231,15 @@ def test_batched_drain_cycle_report():
     assert r["launches"] == 1 and r["docs"] == 3
     assert r["useful_rows"] > 0 and r["occupancy"] is not None
     assert 0 < r["occupancy"] <= 1.0
-    for stage in ("splice", "pack", "h2d", "kernel", "readback", "scatter"):
+    for stage in ("pack", "h2d", "kernel", "readback", "scatter"):
         assert r["stages"].get(stage, 0) > 0, (stage, r["stages"])
+    # the host staging half attributes through the vectorized cross-doc
+    # stages (host_pack/host_splice) — or through the scalar splice
+    # stage when AUTOMERGE_TPU_HOST_BATCH=0 forces the per-doc path
+    assert (
+        r["stages"].get("host_splice", 0) > 0
+        or r["stages"].get("splice", 0) > 0
+    ), r["stages"]
     # the pack site's counters fired alongside
     rows = obs.counter_values("device.batch_rows", "").get("", 0)
     pad = obs.counter_values("device.batch_padding_rows", "").get("", 0)
