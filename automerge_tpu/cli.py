@@ -443,7 +443,9 @@ def cmd_perf_report(args) -> int:
     offline from flight-recorder dumps — every finished drain cycle
     lands in the flight ring as a ``drain.cycle_report`` event, so a
     dead process's last dump still answers "where did the drain wall
-    clock go"."""
+    clock go". The text report includes the h2d byte line (actual
+    staged bytes vs dense equivalent and the compress ratio — the
+    compressed-residency win per drain)."""
     import glob
     import os
     import socket

@@ -34,6 +34,7 @@ import contextlib
 import os
 import threading
 import time
+import zlib
 from typing import Optional, Sequence
 
 from .. import obs
@@ -72,6 +73,36 @@ _FOLLOWER_OK = frozenset({
 
 class NotLeader(Exception):
     pass
+
+
+def _wire_blob(data: bytes):
+    """Encode one migration payload for the wire: ``(b64, codec)``.
+
+    With compressed residency on (``AUTOMERGE_TPU_COMPRESSED``), blobs
+    past a floor ship zlib-compressed (level 1 — migration is
+    latency-sensitive; the snapshot format is already columnar-packed,
+    so the cheap level captures most of the win) so cold migration and
+    live handoffs move compressed bytes, not raw journal rows. Byte
+    counters (``cluster.migrate_raw_bytes`` / ``_wire_bytes``) make the
+    saving observable. Returns ``codec=None`` (field omitted by
+    callers) when compression is off or doesn't pay."""
+    from ..ops import compressed as _C
+
+    obs.count("cluster.migrate_raw_bytes", n=len(data))
+    if _C.enabled() and len(data) >= 512:
+        z = zlib.compress(data, 1)
+        if len(z) < len(data):
+            obs.count("cluster.migrate_wire_bytes", n=len(z))
+            return base64.b64encode(z).decode("ascii"), "zlib"
+    obs.count("cluster.migrate_wire_bytes", n=len(data))
+    return base64.b64encode(data).decode("ascii"), None
+
+
+def _unwire_blob(b64s, codec) -> bytes:
+    """Inverse of ``_wire_blob``; raw base64 when ``codec`` is absent
+    (every pre-codec sender, e.g. a replHarvest snapshot)."""
+    raw = base64.b64decode(b64s or "")
+    return zlib.decompress(raw) if codec == "zlib" else raw
 
 
 class ClusterRpcServer(RpcServer):
@@ -331,8 +362,10 @@ class ClusterRpcServer(RpcServer):
             for k, v in doc.meta.items()
             if not k.startswith(REPL_META_PREFIX)
         }
+        snap_b64, codec = _wire_blob(data)
         return {
-            "snapshot": base64.b64encode(data).decode("ascii"),
+            "snapshot": snap_b64,
+            **({"snapshotCodec": codec} if codec else {}),
             "lsn": lsn,
             "stream": self.hub.stream_id,
             "meta": meta,
@@ -377,9 +410,13 @@ class ClusterRpcServer(RpcServer):
                     if not mname.startswith(REPL_META_PREFIX):
                         meta[mname] = base64.b64encode(blob).decode("ascii")
         obs.count("cluster.migrate_cold_source")
+        snap_b64, s_codec = _wire_blob(snap)
+        data_b64, d_codec = _wire_blob(encode_batch(records))
         return {
-            "snapshot": base64.b64encode(snap).decode("ascii"),
-            "data": base64.b64encode(encode_batch(records)).decode("ascii"),
+            "snapshot": snap_b64,
+            **({"snapshotCodec": s_codec} if s_codec else {}),
+            "data": data_b64,
+            **({"dataCodec": d_codec} if d_codec else {}),
             "lsn": -1,  # no live stream to pin; the router skips the tail
             "cold": True,
             "meta": meta,
@@ -392,8 +429,10 @@ class ClusterRpcServer(RpcServer):
         if self.hub is None:
             raise NotLeader("migration source must be a leader")
         records, last, _traces = self.hub.tail_after(p["name"], int(p["since"]))
+        data_b64, codec = _wire_blob(encode_batch(records))
         return {
-            "data": base64.b64encode(encode_batch(records)).decode("ascii"),
+            "data": data_b64,
+            **({"dataCodec": codec} if codec else {}),
             "lsn": last,
         }
 
@@ -405,10 +444,10 @@ class ClusterRpcServer(RpcServer):
         merges any state the promoted leader was missing."""
         name = p["name"]
         doc = self._repl_doc(name)
-        snap = base64.b64decode(p["snapshot"])
+        snap = _unwire_blob(p["snapshot"], p.get("snapshotCodec"))
         if snap:  # a cold source that never compacted ships no snapshot
             doc.apply_replicated_snapshot(snap, None)
-        records = decode_batch(base64.b64decode(p.get("data") or ""))
+        records = decode_batch(_unwire_blob(p.get("data"), p.get("dataCodec")))
         if records:
             doc.apply_replicated(records, None)
         meta = p.get("meta") or {}

@@ -943,7 +943,8 @@ class ClusterRouter:
                 out = self._admin(src.leader, "migrateOut", {"name": name},
                                   timeout=60.0)
             if out.get("cold"):
-                tail = {"data": out.get("data") or "", "lsn": out["lsn"]}
+                tail = {"data": out.get("data") or "", "lsn": out["lsn"],
+                        "dataCodec": out.get("dataCodec")}
             else:
                 try:
                     tail = self._admin(
@@ -955,10 +956,16 @@ class ClusterRouter:
                     out = self._admin(src.leader, "migrateOut",
                                       {"name": name}, timeout=60.0)
                     tail = {"data": out.get("data") or "",
-                            "lsn": out["lsn"]}
+                            "lsn": out["lsn"],
+                            "dataCodec": out.get("dataCodec")}
+            # payload codec fields ride along verbatim: the source node
+            # decides whether each blob shipped compressed (_wire_blob)
+            # and the target's migrateIn decodes by codec tag
             self._admin(dst.leader, "migrateIn", {
                 "name": name, "snapshot": out["snapshot"],
+                "snapshotCodec": out.get("snapshotCodec"),
                 "data": tail.get("data") or "",
+                "dataCodec": tail.get("dataCodec"),
                 "meta": out.get("meta") or {},
             }, timeout=60.0)
             with self._lock:

@@ -192,7 +192,8 @@ def gauge_remove(name: str, labels: Optional[dict] = None) -> bool:
 # would collapse into {overflow=true} — exactly the admission signal
 # the tiered store cannot afford to lose)
 DOC_GAUGES = ("doc.journal_bytes", "doc.last_access_seconds")
-DEVICE_DOC_GAUGES = ("doc.resident_ops", "doc.device_bytes")
+DEVICE_DOC_GAUGES = ("doc.resident_ops", "doc.device_bytes",
+                     "doc.compress_ratio")
 
 
 def remove_doc_gauges(doc_name: Optional[str], *, device_only: bool = False) -> int:

@@ -125,7 +125,8 @@ _HOST_EXCLUSIVE = ("dedup", "causal_order", "splice", "materialize",
                    "delta_resolve", "write", "host_pack", "host_sort",
                    "host_splice")
 
-_NOTE_KEYS = ("useful_rows", "padded_rows", "launches", "docs", "changes")
+_NOTE_KEYS = ("useful_rows", "padded_rows", "launches", "docs", "changes",
+              "h2d_bytes", "h2d_dense_bytes")
 
 
 class _Cycle:
@@ -237,6 +238,10 @@ class _Cycle:
             "occupancy": (
                 useful / (useful + padded) if (useful + padded) else None
             ),
+            # h2d byte accounting (merge._note_h2d): actual bytes staged
+            # vs their dense equivalent — the compressed-residency win
+            "h2d_bytes": n["h2d_bytes"],
+            "h2d_dense_bytes": n["h2d_dense_bytes"],
             "doc_costs": dict(self.doc_costs),
         }
 
@@ -318,6 +323,8 @@ class CycleProfiler:
             self.launches = 0
             self.docs = 0
             self.changes = 0
+            self.h2d_bytes = 0
+            self.h2d_dense_bytes = 0
             self._doc_costs: Dict[str, float] = {}
 
     def record(self, report: dict) -> None:
@@ -337,6 +344,8 @@ class CycleProfiler:
             self.launches += report["launches"]
             self.docs += report["docs"]
             self.changes += report["changes"]
+            self.h2d_bytes += report.get("h2d_bytes", 0)
+            self.h2d_dense_bytes += report.get("h2d_dense_bytes", 0)
             for d, s in report["doc_costs"].items():
                 self._doc_costs[d] = self._doc_costs.get(d, 0.0) + s
             # bounded: past 4x the table prunes to the K most expensive
@@ -368,6 +377,8 @@ class CycleProfiler:
             "launches": report["launches"],
             "useful_rows": report["useful_rows"],
             "padded_rows": report["padded_rows"],
+            "h2d_bytes": report.get("h2d_bytes", 0),
+            "h2d_dense_bytes": report.get("h2d_dense_bytes", 0),
         }
         for k, v in report["stages"].items():
             ev[f"stage_{k}_s"] = round(v, 6)
@@ -400,6 +411,8 @@ class CycleProfiler:
                 "launches": self.launches,
                 "docs": self.docs,
                 "changes": self.changes,
+                "h2d_bytes": self.h2d_bytes,
+                "h2d_dense_bytes": self.h2d_dense_bytes,
             }
         out = summarize(agg)
         out["enabled"] = self.enabled
@@ -467,6 +480,14 @@ def summarize(agg: dict) -> dict:
         ),
         "useful_rows": useful,
         "padded_rows": padded,
+        # h2d byte accounting across the cycles: actual staged bytes vs
+        # dense equivalent — the compressed-residency h2d win as a ratio
+        "h2d_bytes": agg.get("h2d_bytes", 0),
+        "h2d_dense_bytes": agg.get("h2d_dense_bytes", 0),
+        "h2d_compress_ratio": (
+            round(agg.get("h2d_dense_bytes", 0) / agg["h2d_bytes"], 2)
+            if agg.get("h2d_bytes") else None
+        ),
         "launches": agg["launches"],
         "docs": agg["docs"],
         "changes": agg["changes"],
@@ -519,13 +540,15 @@ def summarize_reports(reports: List[dict]) -> dict:
         "cycles": 0, "wall_s": 0.0, "attributed_s": 0.0, "host_s": 0.0,
         "device_s": 0.0, "fsync_s": 0.0, "stages": {}, "useful_rows": 0,
         "padded_rows": 0, "launches": 0, "docs": 0, "changes": 0,
+        "h2d_bytes": 0, "h2d_dense_bytes": 0,
     }
     for r in reports:
         agg["cycles"] += 1
         for k in ("wall_s", "attributed_s", "host_s", "device_s", "fsync_s"):
             agg[k] += r[k]
-        for k in ("useful_rows", "padded_rows", "launches", "docs", "changes"):
-            agg[k] += r[k]
+        for k in ("useful_rows", "padded_rows", "launches", "docs", "changes",
+                  "h2d_bytes", "h2d_dense_bytes"):
+            agg[k] += r.get(k, 0)
         for k, v in r["stages"].items():
             agg["stages"][k] = agg["stages"].get(k, 0.0) + v
     return summarize(agg)
@@ -564,6 +587,8 @@ def summarize_flight_events(events: List[dict]) -> dict:
             "launches": int(num("launches")),
             "docs": int(num("docs")),
             "changes": int(num("changes")),
+            "h2d_bytes": int(num("h2d_bytes")),
+            "h2d_dense_bytes": int(num("h2d_dense_bytes")),
         })
     out = summarize_reports(reports)
     out["source"] = "flight"
@@ -610,6 +635,16 @@ def render_text(summary: dict, top: Optional[int] = None) -> str:
         lines.append(
             f"extract cache: {100.0 * ec['cache_hit_ratio']:.1f}% hits "
             f"({ec.get('hits', 0)}/{ec.get('hits', 0) + ec.get('misses', 0)})"
+        )
+    # h2d byte accounting: what the compressed staging actually moved vs
+    # its dense equivalent (ops/compressed.py / merge.stage_cols_device)
+    hb = summary.get("h2d_bytes", 0)
+    if hb:
+        ratio = summary.get("h2d_compress_ratio")
+        lines.append(
+            f"h2d: {hb} bytes staged "
+            f"(dense equivalent {summary.get('h2d_dense_bytes', 0)}, "
+            f"compress ratio {ratio if ratio is not None else 1.0}x)"
         )
     if stages:
         lines.append(f"  {'stage':<14} {'seconds':>10} {'% wall':>8}")

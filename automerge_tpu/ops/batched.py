@@ -159,10 +159,9 @@ def _launch_packed(cols, n_objs: int, n_props: int):
     """One kernel launch over the padded super-batch; element order is
     ranked host-side overlapped with the kernel, exactly like the
     per-doc dispatch (DeviceDoc._dispatch_async)."""
-    import jax.numpy as jnp
-
     from .merge import (
         merge_kernel_core, scatter_geometry_ok, scatter_kernel_core,
+        stage_cols_device,
     )
     from .oplog import host_linearize, pad_columns
 
@@ -179,8 +178,10 @@ def _launch_packed(cols, n_objs: int, n_props: int):
     _prof.note("padded_rows", P - useful)
     _prof.note("launches")
     obs.count("device.kernel_launches", labels={"path": "batched"})
-    with obs.span("device.h2d", rows=P):
-        cols_dev = {k: jnp.asarray(v) for k, v in cols.items()}
+    # the super-batch ships compressed: runs are packed under the same
+    # _capacity buckets as the rows (merge.stage_cols_device), so jit
+    # caches stay warm and device_put moves run tables, not dense rows
+    cols_dev = stage_cols_device(cols)
     fn = (
         scatter_kernel_core(n_objs, n_props)
         if scatter_geometry_ok(P, n_objs, n_props)

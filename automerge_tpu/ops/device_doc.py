@@ -415,14 +415,66 @@ class DeviceDoc:
     # document; None (the default) keeps the export path a no-op
     obs_name = None
 
+    # last resident_nbytes() figure, stamped by the OWNING thread (the
+    # apply path under the document lock). Cross-thread readers — the
+    # DocStore evict sweeper's admission estimate — read this cache
+    # instead of calling resident_nbytes(), because computing it syncs
+    # the log's compressed image (a mutation) and must never race an
+    # in-flight append. None until first computed.
+    _resident_cache = None
+
+    def resident_nbytes(self) -> int:
+        """True device-path resident footprint of this document: the
+        column image a drain ships/holds (compressed runs where the
+        ratio gate admits them — ops/compressed.py; dense-equivalent
+        with ``AUTOMERGE_TPU_COMPRESSED=0``) plus the per-row resolution
+        readbacks. The number the DocStore admission policy budgets.
+
+        Syncs the compressed image — call only from the thread that
+        owns the document (apply paths, gauge export, bench); lock-free
+        observers use ``resident_nbytes_estimate``."""
+        n = self.log.resident_column_nbytes() + sum(
+            a.nbytes for a in self.res.values()
+        )
+        self._resident_cache = n
+        return n
+
+    def resident_nbytes_estimate(self) -> int:
+        """Read-only resident estimate for cross-thread observers: the
+        owner-stamped cache when available, else the dense arithmetic
+        (pure reads — never touches the compressed image)."""
+        n = self._resident_cache
+        if n is not None:
+            return n
+        return self.log.dense_column_nbytes() + sum(
+            a.nbytes for a in self.res.values()
+        )
+
+    def dense_nbytes(self) -> int:
+        """What the same residency costs fully decompressed — the
+        pre-compression accounting, kept as the ratio denominator."""
+        return self.log.dense_column_nbytes() + sum(
+            a.nbytes for a in self.res.values()
+        )
+
+    def compress_ratio(self) -> float:
+        r = self.resident_nbytes()
+        return (self.dense_nbytes() / r) if r else 1.0
+
     def _export_doc_gauges(self) -> None:
         if self.obs_name is None:
             return
         labels = {"doc": self.obs_name}
         obs.gauge_set("doc.resident_ops", self.log.n, labels=labels)
+        # TRUE resident bytes (the compressed image a drain actually
+        # ships), not the dense-equivalent array bytes — the admission
+        # policy must see real footprint; the ratio gauge rides along so
+        # dashboards can see how hard each doc compresses
+        resident = self.resident_nbytes()
+        obs.gauge_set("doc.device_bytes", resident, labels=labels)
         obs.gauge_set(
-            "doc.device_bytes",
-            sum(a.nbytes for a in self.res.values()),
+            "doc.compress_ratio",
+            round(self.dense_nbytes() / resident, 4) if resident else 1.0,
             labels=labels,
         )
 
@@ -910,10 +962,9 @@ class DeviceDoc:
         is nothing to resolve, or ``{"fallback": True}`` when the dirty
         fraction demands a synchronous full re-resolution (which the caller
         runs AFTER draining any in-flight batch)."""
-        import jax.numpy as jnp
-
         from .merge import (
             merge_kernel_core, scatter_geometry_ok, scatter_kernel_core,
+            stage_cols_device,
         )
         from .oplog import host_linearize, pad_columns
 
@@ -929,8 +980,9 @@ class DeviceDoc:
         D = len(dirty)
         cols_np = pad_columns(self._subset_cols(rows, dirty), D)
         P = len(cols_np["action"])
-        with obs.span("device.h2d", rows=P):
-            cols_dev = {k: jnp.asarray(v) for k, v in cols_np.items()}
+        # compressed staging: device_put moves run tables, expansion
+        # happens on device (merge.stage_cols_device)
+        cols_dev = stage_cols_device(cols_np)
         n_props = len(log.props)
         fn = (
             scatter_kernel_core(D, n_props)

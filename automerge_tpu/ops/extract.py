@@ -571,6 +571,16 @@ class LazyValues:
     def __len__(self) -> int:
         return len(self.code)
 
+    @property
+    def nbytes(self) -> int:
+        """Resident footprint of the heap (per-row index columns + the
+        raw byte buffer) — the values side of the per-doc residency
+        accounting (ops/compressed.py covers the op columns)."""
+        return (
+            self.code.nbytes + self.off.nbytes + self.ln.nbytes
+            + len(self.raw)
+        )
+
     def __getitem__(self, row: int) -> ScalarValue:
         v = self.cache.get(row)
         if v is None:

@@ -90,7 +90,15 @@ def _tail_write(bufs: dict, name: str, old: np.ndarray, new, mm: int):
     buffer check and a slice assignment per column, nothing else.
     ``new`` is the appended values (or a scalar fill). Capacity
     bucketing and buffer reuse match ``OpLog._splice_col`` exactly, so
-    the scalar path can keep splicing the same buffers afterwards."""
+    the scalar path can keep splicing the same buffers afterwards.
+
+    Compressed-residency contract: a tail write never moves the
+    resident prefix, so the log's compressed column image
+    (ops/compressed.py) stays valid across it — each encoded column's
+    covered-row cursor lags and the next sync extends the LAST RUN with
+    the appended slice instead of re-encoding (StrideRuns.extend_tail);
+    the id_key runs are even extended eagerly in ``_splice_doc`` so the
+    reference joins run offset-value-coded."""
     n = len(old)
     buf = bufs.get(name)
     if buf is not None and old.base is buf and len(buf) >= mm:
@@ -617,6 +625,21 @@ def _splice_doc(p: _DocPlan, g):
         return ("scalar",)
     m = n + k
 
+    # -- compressed residency: extend the id_key runs with the delta so
+    # the reference joins below run offset-value-coded (searchsorted
+    # over R run heads + stride arithmetic) instead of over all m rows;
+    # the rest of the compressed image extends lazily on next sync — a
+    # tail append never moves the resident prefix (ops/compressed.py)
+    from . import compressed as _C
+
+    idruns = None
+    if _C.enabled() and not p.actors_changed:
+        comp = log._comp
+        if comp is None:
+            comp = log._comp = _C.CompressedOpColumns()
+        comp._sync_col("id_key", "delta", log.id_key, n)
+        idruns = comp.extend_id(d_id)
+
     # -- packed-key and payload columns (tail writes only) ----------------
     if log._bufs is None:
         log._bufs = {}
@@ -664,13 +687,19 @@ def _splice_doc(p: _DocPlan, g):
     mark_new = tw(bufs, "mark_name_idx", log.mark_name_idx, d_mark, m)
 
     # -- row-reference columns (resolve through the shared id join) -------
+    def _id_join(keys):
+        if idruns is not None:
+            obs.count("oplog.ovc_join", n=len(keys))
+            return idruns.join(keys, ELEM_MISSING)
+        return join_rows(id_new, keys, ELEM_MISSING)
+
     d_ek = g["elem_s"][sl]
     d_er = np.where(
         d_ek == -1,
         np.int32(ELEM_MAP),
         np.where(
             d_ek == 0, np.int32(ELEM_HEAD),
-            join_rows(id_new, d_ek, ELEM_MISSING),
+            _id_join(d_ek),
         ),
     ).astype(np.int32)
     er_new = tw(bufs, "elem_ref", log.elem_ref, d_er, m)
@@ -682,7 +711,7 @@ def _splice_doc(p: _DocPlan, g):
     if len(src_g):
         d_ps = (n + (g["inv_g"][src_g] - p.r0)).astype(np.int32)
         d_pk = g["pk_t"][p0:p1]
-        d_pt = join_rows(id_new, d_pk, ELEM_MISSING)
+        d_pt = _id_join(d_pk)
         d_pt = np.where(
             d_pt == ELEM_MISSING, np.int32(-1), d_pt
         ).astype(np.int32)
@@ -770,6 +799,7 @@ def _splice_doc(p: _DocPlan, g):
         from ..types import ActorId
 
         log.actors = [ActorId(b) for b in p.all_bytes]
+        log._comp = None  # every resident packed key was rank-remapped
     log._actor_order = None
     log.changes.extend(ready)
     log.hashes().update(ch.hash for ch in ready)

@@ -750,6 +750,121 @@ def _slope_rle(x: np.ndarray):
     return w.astype(np.int32), cum.astype(np.int32), s
 
 
+def _note_h2d(actual: int, dense: int) -> None:
+    """Byte accounting every H2D site shares: the counter pair the
+    perf-report ratio line reads, plus the cycle profiler notes."""
+    from ..obs import prof as _prof
+
+    obs.count("device.h2d_bytes", n=actual)
+    obs.count("device.h2d_dense_bytes", n=dense)
+    _prof.note("h2d_bytes", actual)
+    _prof.note("h2d_dense_bytes", dense)
+
+
+def stage_cols_device(cols_np):
+    """Compressed H2D staging for the dict-path launch sites.
+
+    Per column: slope-RLE runs (the resident format's device image) are
+    ``device_put`` as (w, cum) run tables padded to run-capacity buckets
+    — so ``device_put`` moves compressed bytes, not dense int32 rows —
+    and expanded ON device with one vectorized searchsorted gather per
+    column (the ops/merge.py packed-transport ``_expand`` rule, run
+    eagerly so the jit kernel caches never churn on data-dependent run
+    shapes). A column whose run structure degenerates past the
+    ``_slope_rle`` gate ships dense (counted via
+    ``oplog.compress_fallback{column,reason=h2d}``).
+
+    Records actual bytes moved as ``bytes=`` on the ``device.h2d`` span
+    and on the ``device.h2d_bytes`` counter (dense-equivalent bytes ride
+    on ``device.h2d_dense_bytes`` so compression wins are a ratio, not a
+    guess). ``AUTOMERGE_TPU_COMPRESSED=0`` restores the plain dense
+    upload everywhere.
+    """
+    from . import compressed as _C
+
+    cols_np = {k: np.asarray(v) for k, v in cols_np.items()}
+    P = len(cols_np["action"])
+    dense_bytes = sum(v.nbytes for v in cols_np.values())
+    if not _C.enabled():
+        with obs.span("device.h2d", rows=P, bytes=dense_bytes):
+            dev = {k: jnp.asarray(v) for k, v in cols_np.items()}
+        _note_h2d(dense_bytes, dense_bytes)
+        return dev
+    dense = {}
+    groups = {}  # column length -> [(name, (w, cum, slope), is_bool)]
+    h2d_bytes = 0
+    for k, v in cols_np.items():
+        n = len(v)
+        enc = None
+        if n >= 32 and v.dtype in (np.int32, np.bool_):
+            enc = _slope_rle(v if v.dtype == np.int32 else v.astype(np.int32))
+            if enc is None:
+                obs.count("oplog.compress_fallback",
+                          labels={"column": k, "reason": "h2d"})
+        if enc is None:
+            dense[k] = v
+            h2d_bytes += v.nbytes
+        else:
+            groups.setdefault(n, []).append((k, enc, v.dtype == np.bool_))
+    # one stacked run table per column length (rows vs pred edges), so
+    # the whole expansion is ONE fused jit dispatch per group — eager
+    # per-column ops would pay ~50 dispatch overheads per launch
+    stacks = []
+    for n, cols in groups.items():
+        rcap = _capacity(max(len(w) for _, (w, _, _), _ in cols), 16)
+        K = len(cols)
+        W = np.zeros((K, rcap), np.int32)
+        C = np.full((K, rcap), np.int32(n), np.int32)
+        S = np.empty(K, np.int32)
+        for idx, (_, (w, cum, s), _) in enumerate(cols):
+            W[idx, : len(w)] = w
+            C[idx, : len(cum)] = cum
+            S[idx] = s
+        stacks.append((n, rcap, cols, W, C, S))
+        h2d_bytes += W.nbytes + C.nbytes + S.nbytes
+    with obs.span("device.h2d", rows=P, bytes=h2d_bytes):
+        out = {k: jnp.asarray(v) for k, v in dense.items()}
+        for n, rcap, cols, W, C, S in stacks:
+            bools = tuple(b for _, _, b in cols)
+            expanded = _expander(n, rcap, bools)(
+                jnp.asarray(W), jnp.asarray(C), jnp.asarray(S)
+            )
+            for (k, _, _), col in zip(cols, expanded):
+                out[k] = col
+    _note_h2d(h2d_bytes, dense_bytes)
+    return out
+
+
+_EXPAND_CACHE = {}
+
+
+def _expander(n, rcap, bools):
+    """Jit'd stacked run expansion: (K, rcap) run tables -> K dense
+    (n,) columns in one dispatch. Cache key is (bucketed) shapes plus
+    which outputs cast back to bool — slopes are dynamic inputs, so
+    data-dependent slope choices never churn the jit cache."""
+    key = (n, rcap, bools)
+    fn = _EXPAND_CACHE.get(key)
+    if fn is None:
+        def f(W, C, S):
+            i = jnp.arange(n, dtype=jnp.int32)
+
+            def one(w, c, s):
+                j = jnp.clip(
+                    jnp.searchsorted(c, i, side="right"), 0, rcap - 1
+                ).astype(jnp.int32)
+                return w[j] + s * i
+
+            cols = jax.vmap(one)(W, C, S)
+            return tuple(
+                cols[k].astype(jnp.bool_) if b else cols[k]
+                for k, b in enumerate(bools)
+            )
+
+        fn = _EXPAND_CACHE[key] = jax.jit(f)
+    return fn
+
+
 def encode_transport(cols) -> tuple:
     """Choose per column between slope-RLE runs and plain transfer.
 
@@ -951,8 +1066,13 @@ def _packed_merge(cols_np, fetch, n_objs, n_props=None):
         fn = _packed_cache[key] = _runs_fn(
             dev_fetch, obj_cap, static_key, P, Q, scatter_geom
         )
-    with obs.span("device.h2d", rows=P):
+    # the packed transport is already run-encoded (encode_transport);
+    # record the bytes it actually moves so compression wins surface in
+    # perf-report alongside the dict-path staging
+    pk_bytes = sum(a.nbytes for a in arrays.values())
+    with obs.span("device.h2d", rows=P, bytes=pk_bytes):
         arrays_dev = {k: jnp.asarray(v) for k, v in arrays.items()}
+    _note_h2d(pk_bytes, sum(np.asarray(v).nbytes for v in cols_np.values()))
     with obs.span("device.kernel", rows=P):
         flat_dev = fn(arrays_dev)  # async dispatch
     elem_index = host_linearize(cols_np) if host_elem else None
@@ -1090,8 +1210,7 @@ def merge_columns(cols_np, linearize: str = "auto", fetch=None, n_objs=None,
             n_props,
         )
 
-    with obs.span("device.h2d", rows=len(cols_np["action"])):
-        cols = {k: jnp.asarray(v) for k, v in cols_np.items()}
+    cols = stage_cols_device(cols_np)
     if linearize == "auto":
         linearize = "native" if native.preorder_available() else "device"
     need = set(fetch) if fetch is not None else set(ALL_OUTPUTS)

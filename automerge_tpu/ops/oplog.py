@@ -134,6 +134,7 @@ class OpLog:
         "_actor_order",
         "_hash_set",
         "_bufs",
+        "_comp",
     )
 
     def __init__(self):
@@ -156,6 +157,9 @@ class OpLog:
         self._actor_order = None
         self._hash_set = None
         self._bufs = None
+        # the incrementally-maintained compressed column image
+        # (ops/compressed.py); None = stale/absent, rebuilt lazily
+        self._comp = None
 
     # -- construction --------------------------------------------------
 
@@ -563,6 +567,58 @@ class OpLog:
         rank = (self.id_key & ACTOR_MASK).astype(np.int64)
         return ctr <= np.asarray(clock_max_op, np.int64)[rank]
 
+    # -- compressed residency (ops/compressed.py) ---------------------------
+
+    def compressed(self, sync: bool = True):
+        """The compressed image of the resident columns, or None when
+        ``AUTOMERGE_TPU_COMPRESSED=0``. Maintained incrementally: tail
+        appends extend the last runs; prefix rewrites invalidate and the
+        next call re-encodes lazily."""
+        from . import compressed as C
+
+        if not C.enabled():
+            return None
+        if self._comp is None:
+            self._comp = C.CompressedOpColumns()
+        if sync:
+            self._comp.sync(self)
+        return self._comp
+
+    def dense_column_nbytes(self) -> int:
+        """Dense-equivalent footprint of the resident column set (what
+        the pre-compression representation held per doc). Columns not
+        materialized yet (``elem_key``/``pred_key`` on assembler-built
+        logs) count zero on BOTH sides of the ratio — phantom bytes in
+        the numerator would inflate ``compress_ratio`` and overcharge
+        the dense-mode admission estimate."""
+        from . import compressed as C
+
+        q = len(self.pred_src)
+        return sum(
+            self.n * item
+            for name, _, item in C.ROW_SPEC
+            if getattr(self, name) is not None
+        ) + sum(
+            q * item
+            for name, _, item in C.EDGE_SPEC
+            if getattr(self, name) is not None
+        )
+
+    def resident_column_nbytes(self) -> int:
+        """True resident bytes of the column set under the active mode
+        (compressed runs where the ratio gate admits them, dense
+        otherwise)."""
+        comp = self.compressed()
+        if comp is None:
+            return self.dense_column_nbytes()
+        return comp.nbytes(self)
+
+    def compress_ratio(self) -> float:
+        comp = self.compressed()
+        if comp is None:
+            return 1.0
+        return comp.ratio(self)
+
     # -- host-side id helpers ---------------------------------------------
 
     def export_id(self, key: int) -> str:
@@ -748,6 +804,21 @@ class OpLog:
                 return None
         tail = n == 0 or pos[0] == n
         m = n + k
+        # offset-value-coded id join: the compressed id_key runs (delta+
+        # RLE over the packed (counter, actor) composites), extended
+        # eagerly with the delta, answer every reference join below over
+        # R run heads + stride arithmetic instead of a searchsorted over
+        # all N resident keys (ops/compressed.py StrideRuns.join)
+        idruns = None
+        if tail and not actors_changed and n:
+            from . import compressed as C
+
+            if C.enabled():
+                comp = self._comp
+                if comp is None:
+                    comp = self._comp = C.CompressedOpColumns()
+                comp._sync_col("id_key", "delta", self.id_key, n)
+                idruns = comp.extend_id(d_id)
         new_rows = pos + np.arange(k, dtype=np.int64)
         if tail:
             row_map = None
@@ -788,6 +859,9 @@ class OpLog:
         mark_new = sp("mark_name_idx", self.mark_name_idx, d_mark)
 
         def rows_of(keys):
+            if idruns is not None:
+                obs.count("oplog.ovc_join", n=len(keys))
+                return idruns.join(keys, ELEM_MISSING)
             return join_rows(id_new, keys, ELEM_MISSING)
 
         # -- element references --------------------------------------------
@@ -912,6 +986,13 @@ class OpLog:
         self.n_miss_pred = n_miss_pred
         self.actors = [ActorId(b) for b in all_bytes]
         self._actor_order = None
+        # the compressed image survives only the pure tail append: actor
+        # remaps rewrite every packed key, non-tail splices move the
+        # prefix, and re-resolved MISSING references mutate elem_ref /
+        # pred_tgt in place — all invalidate; the next consumer
+        # re-encodes lazily
+        if not tail or actors_changed or len(rere_rows) or len(rere_pred):
+            self._comp = None
         self.changes.extend(fresh)
         known.update(batch_seen)
         obs.count("oplog.append_rows", n=k)
@@ -933,6 +1014,7 @@ class OpLog:
         self.obj_table = remap_packed(self.obj_table)
         self.actors = [ActorId(b) for b in all_bytes]
         self._actor_order = None
+        self._comp = None  # every packed key was rank-remapped
         # remapped arrays no longer alias the backing buffers
         self._bufs = {}
 

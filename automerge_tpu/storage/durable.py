@@ -465,6 +465,11 @@ class DurableDocument:
             dev.obs_name = self.obs_name
             self.device_doc = dev
             dev._export_doc_gauges()
+            # the promotion shipped the compressed image (the resolve's
+            # H2D staging moves run tables, merge.stage_cols_device);
+            # record what warm->hot residency actually costs
+            obs.count("store.promote_resident_bytes",
+                      n=dev.resident_nbytes())
         return dev
 
     def __enter__(self):

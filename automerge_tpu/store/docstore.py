@@ -55,6 +55,7 @@ from .policy import (
     DocStats,
     StoreBudgets,
     current_rss_bytes,
+    device_resident_bytes,
     pick_demotions,
 )
 
@@ -494,8 +495,8 @@ def _resident_bytes(dd) -> int:
         return 0
     dev = getattr(dd, "device_doc", None)
     if dev is not None:
-        try:
-            n += sum(a.nbytes for a in dev.res.values())
-        except Exception:
-            pass
+        # TRUE device-path bytes (compressed resident columns +
+        # readbacks), so a hot doc whose history compresses 10x is 10x
+        # cheaper to the hot budget than one that doesn't
+        n += device_resident_bytes(dev)
     return n

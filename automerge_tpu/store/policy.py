@@ -127,6 +127,27 @@ class Demotion:
     reason: str
 
 
+def device_resident_bytes(dev) -> int:
+    """Device-path footprint of one resident ``DeviceDoc`` mirror, as
+    the admission/demotion policy should see it: TRUE resident bytes —
+    the compressed column image a drain actually ships plus the
+    resolution readbacks — not the dense-equivalent array bytes the
+    estimate used to report. Reads the owner-stamped cache
+    (``DeviceDoc.resident_nbytes_estimate``): the evict sweeper runs
+    off-thread, and computing the figure fresh would sync the log's
+    compressed image under a concurrent append. With
+    ``AUTOMERGE_TPU_COMPRESSED=0`` the two modes coincide."""
+    try:
+        return int(dev.resident_nbytes_estimate())
+    except Exception:
+        # a mirror mid-teardown (or a foreign duck-type): fall back to
+        # whatever readback arrays are still reachable
+        try:
+            return sum(a.nbytes for a in dev.res.values())
+        except Exception:
+            return 0
+
+
 def current_rss_bytes() -> int:
     """This process's current resident set size. Linux reads
     ``/proc/self/statm`` (current, not peak); elsewhere falls back to

@@ -1584,6 +1584,134 @@ def main():
     results["tiered"] = tiered_cfg
     note(f"tiered: {results['tiered']}")
 
+    # ---- config: compressed (compute-on-compressed resident columns) -------
+    # The same synthetic text+counter workload drained through the
+    # cross-doc batched path TWICE in one process: compressed residency
+    # (AUTOMERGE_TPU_COMPRESSED=1, the default) vs dense (=0, the
+    # fallback/oracle mode). Asserted inside the config: bit-identical
+    # materialized documents and op columns across modes. Reported: true
+    # resident column bytes per doc and h2d bytes per drain under each
+    # mode (the device.h2d_bytes counter the staging sites feed), their
+    # ratios, and resident-docs-per-GiB — the "5-10x more resident docs
+    # per chip" claim as a measured number.
+    comp_cfg = {}
+    try:
+        if env_flag("BENCH_COMPRESSED", "1") != "0":
+            from automerge_tpu.ops.batched import apply_cross_doc
+            from automerge_tpu.types import ObjType as _OT
+            from automerge_tpu.types import ScalarValue
+
+            cp_docs = env_int("BENCH_CP_DOCS", 8)
+            cp_cycles = env_int("BENCH_CP_CYCLES", 6)
+            cp_ops = env_int("BENCH_CP_OPS", 40)
+
+            wl = []
+            for i in range(cp_docs):
+                cbase = AutoDoc(actor=ActorId(bytes([41]) * 16))
+                live = cbase.put_object("_root", "live", _OT.TEXT)
+                cbase.splice_text(live, 0, 0, f"seed text for doc {i} ")
+                cbase.put("_root", "ctr", ScalarValue("counter", 0))
+                cbase.commit()
+                chs = [a.stored for a in cbase.doc.history]
+                ed = cbase.fork(actor=ActorId(
+                    bytes([51]) + bytes([i % 250]) + bytes(14)))
+                seen = {c.hash for c in chs}
+                cyc = []
+                for c in range(cp_cycles):
+                    ln = ed.length(live)
+                    for j in range(cp_ops):
+                        ed.splice_text(
+                            live, (i + c * cp_ops + j) % max(ln + j, 1),
+                            0, "ab"[j % 2],
+                        )
+                    ed.increment("_root", "ctr", 1)
+                    ed.commit()
+                    delta = [
+                        a.stored for a in ed.doc.history
+                        if a.stored.hash not in seen
+                    ]
+                    seen.update(ch.hash for ch in delta)
+                    cyc.append(delta)
+                wl.append((chs, cyc))
+
+            def cp_run(mode, work):
+                prev = os.environ.get("AUTOMERGE_TPU_COMPRESSED")
+                os.environ["AUTOMERGE_TPU_COMPRESSED"] = mode
+                try:
+                    devs = [
+                        DeviceDoc.resolve(OpLog.from_changes(chs))
+                        for chs, _ in work
+                    ]
+                    h0 = obs.counter_values(
+                        "device.h2d_bytes", "").get("", 0)
+                    t0 = time.perf_counter()
+                    for c in range(cp_cycles):
+                        apply_cross_doc(
+                            [(devs[i], [work[i][1][c]])
+                             for i in range(len(work))]
+                        )
+                    dt = time.perf_counter() - t0
+                    h1 = obs.counter_values(
+                        "device.h2d_bytes", "").get("", 0)
+                    col = sum(d.log.resident_column_nbytes() for d in devs)
+                    res = sum(d.resident_nbytes() for d in devs)
+                    return devs, h1 - h0, col, res, dt
+                finally:
+                    if prev is None:
+                        os.environ.pop("AUTOMERGE_TPU_COMPRESSED", None)
+                    else:
+                        os.environ["AUTOMERGE_TPU_COMPRESSED"] = prev
+
+            # warm both mode shapes (jit compile + page-in) on a
+            # throwaway prefix so the reported seconds compare staging,
+            # not first-launch compile
+            warm = wl[: max(cp_docs // 2, 1)]
+            cp_run("1", warm)
+            cp_run("0", warm)
+            devs_c, h2d_c, col_c, res_c, t_c = cp_run("1", wl)
+            devs_d, h2d_d, col_d, res_d, t_d = cp_run("0", wl)
+            # bit-identical materialized documents AND op columns
+            for i in (0, cp_docs // 2, cp_docs - 1):
+                assert devs_c[i].hydrate() == devs_d[i].hydrate(), i
+                for colname in ("id_key", "action", "elem_ref",
+                                "obj_dense", "value_int"):
+                    assert np.array_equal(
+                        np.asarray(getattr(devs_c[i].log, colname)),
+                        np.asarray(getattr(devs_d[i].log, colname)),
+                    ), (i, colname)
+            gib = 1 << 30
+            per_doc_c = max(res_c // cp_docs, 1)
+            per_doc_d = max(res_d // cp_docs, 1)
+            comp_cfg = {
+                "docs": cp_docs,
+                "cycles": cp_cycles,
+                "ops_per_delta": cp_ops,
+                "resident_ops": int(devs_c[0].log.n),
+                "identical_docs": True,
+                "resident_column_bytes_per_doc": col_c // cp_docs,
+                "resident_column_bytes_per_doc_dense": col_d // cp_docs,
+                "resident_compress_ratio": round(col_d / max(col_c, 1), 2),
+                "device_bytes_per_doc": int(per_doc_c),
+                "device_bytes_per_doc_dense": int(per_doc_d),
+                "h2d_bytes_per_drain": h2d_c // cp_cycles,
+                "h2d_bytes_per_drain_dense": h2d_d // cp_cycles,
+                "h2d_compress_ratio": round(h2d_d / max(h2d_c, 1), 2),
+                "resident_docs_per_gib": int(gib // per_doc_c),
+                "resident_docs_per_gib_dense": int(gib // per_doc_d),
+                "seconds_compressed": round(t_c, 4),
+                "seconds_dense": round(t_d, 4),
+            }
+            del devs_c, devs_d, wl
+    except Exception as e:  # noqa: BLE001 — degrade, record, continue
+        import traceback
+
+        tb = traceback.format_exc()
+        comp_cfg = {"compressed_error": repr(e)[:500]}
+        print(f"compressed config failed:\n{tb}", file=sys.stderr,
+              flush=True)
+    results["compressed"] = comp_cfg
+    note(f"compressed: {results['compressed']}")
+
     out = {
         "metric": "edit_trace_fanin_merge_ops_per_sec",
         "value": results["fanin"]["ops_per_sec"],
