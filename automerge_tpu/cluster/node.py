@@ -198,6 +198,12 @@ class ClusterRpcServer(RpcServer):
             out["followers"] = self.hub.followers()
         if self.leader_hint:
             out["leader"] = self.leader_hint
+        # overload advertisement: the serving layer's admission
+        # controller (installed by SocketRpcServer) rides the heartbeat
+        # so the router stops routing sheddable classes at this node
+        adm = getattr(self, "admission", None)
+        if adm is not None:
+            out["admission"] = adm.advertisement()
         return out
 
     # -- replication receive path (follower) ---------------------------------

@@ -181,6 +181,23 @@ class ReplicationHub:
         self._streams: Dict[str, _DocStream] = {}
         self._links: Dict[str, _FollowerLink] = {}
         self._closed = False
+        # circuit breaker on the ack gate: repeated ReplicationTimeouts
+        # (a partitioned/stalled follower set) trip the gate OPEN —
+        # writes ack on leader durability alone (follower-degraded
+        # quorum, loudly counted) instead of every ack stalling out the
+        # full timeout. After a cooldown one half-open probe waits for
+        # real acks again; success re-closes the breaker.
+        self.breaker_enabled = (
+            os.environ.get("AUTOMERGE_TPU_REPL_BREAKER", "1") != "0")
+        self.breaker_threshold = max(1, int(_env_float(
+            "AUTOMERGE_TPU_REPL_BREAKER_THRESHOLD", 3)))
+        self.breaker_cooldown = _env_float(
+            "AUTOMERGE_TPU_REPL_BREAKER_COOLDOWN", 5.0)
+        self._breaker_lock = threading.Lock()
+        self._breaker_state = "closed"
+        self._breaker_failures = 0
+        self._breaker_opened_at = 0.0
+        self._breaker_gauges()
 
     # -- document attachment -------------------------------------------------
 
@@ -305,7 +322,66 @@ class ReplicationHub:
 
     # -- the ack gate --------------------------------------------------------
 
+    def _breaker_gauges(self) -> None:
+        for s in ("closed", "open", "half_open"):
+            obs.gauge_set("repl.breaker",
+                          1.0 if s == self._breaker_state else 0.0,
+                          labels={"state": s})
+
+    def _breaker_transition_locked(self, to: str) -> None:
+        frm, self._breaker_state = self._breaker_state, to
+        self._breaker_gauges()
+        obs.count("repl.breaker_transitions", labels={"to": to})
+        if to == "open":
+            obs.count("repl.breaker_trips")
+        obs.event("repl.breaker", frm=frm, to=to,
+                  failures=self._breaker_failures)
+
+    def breaker_state(self) -> str:
+        with self._breaker_lock:
+            return self._breaker_state
+
     def wait_acked(self, name: str) -> None:
+        """The ack gate, behind the circuit breaker: closed -> wait for
+        real follower acks; open -> ack on leader durability alone until
+        the cooldown elapses (every bypass counted as
+        ``repl.breaker_bypass``); half-open -> one probe waits for real
+        acks while concurrent callers keep bypassing."""
+        probe = False
+        if self.breaker_enabled:
+            with self._breaker_lock:
+                if self._breaker_state == "open":
+                    if (time.monotonic() - self._breaker_opened_at
+                            < self.breaker_cooldown):
+                        obs.count("repl.breaker_bypass")
+                        return
+                    self._breaker_transition_locked("half_open")
+                    probe = True
+                elif self._breaker_state == "half_open":
+                    # a probe is already in flight; stacking more callers
+                    # onto full ack timeouts is the stall being prevented
+                    obs.count("repl.breaker_bypass")
+                    return
+        try:
+            self._wait_acked(name)
+        except ReplicationTimeout:
+            if self.breaker_enabled:
+                with self._breaker_lock:
+                    self._breaker_failures += 1
+                    if (self._breaker_state != "open"
+                            and (probe or self._breaker_failures
+                                 >= self.breaker_threshold)):
+                        self._breaker_opened_at = time.monotonic()
+                        self._breaker_transition_locked("open")
+            raise
+        else:
+            if self.breaker_enabled:
+                with self._breaker_lock:
+                    self._breaker_failures = 0
+                    if self._breaker_state != "closed":
+                        self._breaker_transition_locked("closed")
+
+    def _wait_acked(self, name: str) -> None:
         """Block until >= ack_replicas followers hold this document's
         current locally-durable LSN on their own disks. Raises
         ``ReplicationTimeout`` after ``ack_timeout`` — an un-replicated

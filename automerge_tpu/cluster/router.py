@@ -45,6 +45,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from .. import obs
+from ..serve.admission import priority_class
 from .hashring import HashRing
 from .replication import _env_float
 
@@ -99,6 +100,12 @@ class _Group:
         self.up = threading.Event()
         self.up.set()
         self.failing = False  # a failover for this group is in flight
+        # overload advertisement from the leader's heartbeat: lowest
+        # priority rank it is shedding (5 = nothing), its backoff hint,
+        # and when the advertisement was read (stale ones are ignored)
+        self.shed_class = 5
+        self.shed_retry_ms = 0
+        self.shed_ts = 0.0
 
 
 class _DataConn:
@@ -343,8 +350,14 @@ class ClusterRouter:
                         raise ValueError("request must be a JSON object")
                 except Exception as e:
                     reply({"id": None, "error": {
-                        "type": "ParseError", "message": str(e)}})
+                        "type": "ParseError", "message": str(e),
+                        "retriable": False}})
                     continue
+                # deadline propagation: note when the budget-carrying
+                # request entered the router, so the forwarded deadline
+                # can be rewritten net of router queueing/waits
+                if "deadlineMs" in req:
+                    req["_arrival"] = obs.now()
                 try:
                     self._route(cid, conn, req)
                 except _RouteError as e:
@@ -436,6 +449,28 @@ class ClusterRouter:
             raise _RouteError(
                 "Unavailable", f"group {group.idx} has no leader")
 
+        # 2b. shed-mode: the leader's heartbeat advertised it is
+        # refusing this priority class — answer Overloaded here instead
+        # of burning a round trip on a guaranteed refusal. Stale
+        # advertisements (no heartbeat for ~3 periods) are ignored.
+        if group.shed_class < 5 and (
+            obs.now() - group.shed_ts <= max(self.heartbeat * 3, 3.0)
+        ):
+            rank, cls = priority_class(method if isinstance(method, str)
+                                       else "")
+            if rank >= group.shed_class:
+                obs.count("router.shed", labels={"class": cls})
+                err = {
+                    "type": "Overloaded",
+                    "message": f"leader {group.leader} is shedding "
+                               f"{cls} work",
+                    "retriable": True,
+                }
+                if group.shed_retry_ms > 0:
+                    err["retryAfterMs"] = group.shed_retry_ms
+                conn[2]({"id": rid, "error": err})
+                return
+
         # 3. re-resolve stale virtual handles (post-failover lazily)
         self._refresh_handles(params)
 
@@ -465,9 +500,36 @@ class ClusterRouter:
                    "syncSessionFree": "session"}[method]
             ctx = ("free", (req.get("params") or {}).get(fld))
 
+        # 5b. deadline rewrite: forward the budget net of the time this
+        # request spent inside the router (parse, migration/availability
+        # waits). A budget that burned away entirely answers
+        # DeadlineExceeded here — shipping it would only make the node
+        # refuse it after a queue slot and a round trip.
+        fwd_deadline = None
+        dl = req.get("deadlineMs")
+        if (isinstance(dl, (int, float)) and not isinstance(dl, bool)
+                and dl > 0):
+            arrival = req.get("_arrival")
+            elapsed_ms = (
+                (obs.now() - arrival) * 1000.0
+                if isinstance(arrival, (int, float)) else 0.0
+            )
+            remaining = float(dl) - elapsed_ms
+            if remaining <= 0:
+                obs.count("router.deadline_expired")
+                conn[2]({"id": rid, "error": {
+                    "type": "DeadlineExceeded",
+                    "message": "client deadline expired in the router",
+                    "retriable": True,
+                }})
+                return
+            fwd_deadline = max(1, int(remaining))
+
         # 6. ship on the leader's pooled connection
         try:
             out = {"method": method, "params": params}
+            if fwd_deadline is not None:
+                out["deadlineMs"] = fwd_deadline
             if trace is not None:
                 out["trace"] = trace
             dconn = self._data_conn(group.leader, affinity)
@@ -654,6 +716,18 @@ class ClusterRouter:
                         obs.flight.note_clock_sync(
                             st.get("nodeId") or g.leader, t0, t1, peer_now)
                     g.stream = st.get("stream") or g.stream
+                    # shed-mode advertisement: stop routing sheddable
+                    # classes at a leader that would only refuse them
+                    adm = st.get("admission")
+                    if isinstance(adm, dict):
+                        try:
+                            g.shed_class = int(adm.get("shedClass", 5))
+                            g.shed_retry_ms = int(adm.get("retryAfterMs", 0))
+                        except (TypeError, ValueError):
+                            g.shed_class, g.shed_retry_ms = 5, 0
+                    else:
+                        g.shed_class, g.shed_retry_ms = 5, 0
+                    g.shed_ts = t1
                     misses[g.idx] = 0
                     continue
                 except Exception:

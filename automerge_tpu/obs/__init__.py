@@ -194,13 +194,21 @@ def gauge_remove(name: str, labels: Optional[dict] = None) -> bool:
 DOC_GAUGES = ("doc.journal_bytes", "doc.last_access_seconds")
 DEVICE_DOC_GAUGES = ("doc.resident_ops", "doc.device_bytes",
                      "doc.compress_ratio")
+# per-queue gauges keyed by the serving layer's shard key (the integer
+# doc HANDLE, not the durable name) — removed via ``queue_key``
+QUEUE_GAUGES = ("rpc.queue_depth",)
 
 
-def remove_doc_gauges(doc_name: Optional[str], *, device_only: bool = False) -> int:
-    if not doc_name:
-        return 0
-    names = DEVICE_DOC_GAUGES if device_only else DOC_GAUGES + DEVICE_DOC_GAUGES
+def remove_doc_gauges(doc_name: Optional[str], *, device_only: bool = False,
+                      queue_key=None) -> int:
     n = 0
+    if queue_key is not None:
+        for fam in QUEUE_GAUGES:
+            n += registry.remove_labels(
+                fam, {"doc": str(queue_key)}, type_="gauge")
+    if not doc_name:
+        return n
+    names = DEVICE_DOC_GAUGES if device_only else DOC_GAUGES + DEVICE_DOC_GAUGES
     for fam in names:
         n += registry.remove_labels(fam, {"doc": doc_name}, type_="gauge")
     return n

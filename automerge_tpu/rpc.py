@@ -65,6 +65,7 @@ from typing import Dict, Optional
 
 from . import obs
 from .api import AutoDoc
+from .degrade import brownout_active
 from .sync import SessionConfig, SyncSession, SyncState
 from .types import ActorId, ObjType, ScalarValue
 
@@ -161,6 +162,36 @@ class _StoreOps:
         return self._rpc._store_build_device(name)
 
 
+class DeadlineExceeded(Exception):
+    """The client's ``deadlineMs`` budget expired before the server
+    reached this stage — the request was answered WITHOUT executing the
+    mutation (the client already gave up; doing the work anyway only
+    deepens the overload). Always retriable: the client may still want
+    the operation under a fresh budget."""
+
+    retriable = True
+
+
+def request_expired(req: dict) -> bool:
+    """True when the request carried ``deadlineMs`` and its stamped
+    local expiry (see ``_parse_line``) has passed."""
+    dl = req.get("_deadline_ts")
+    return dl is not None and obs.now() >= dl
+
+
+def deadline_response(rid, method: str, stage: str) -> dict:
+    """The ``DeadlineExceeded`` answer for one expired request, counted
+    per enforcement stage (``serve.deadline_expired{stage}``)."""
+    obs.count("serve.deadline_expired", labels={"stage": stage})
+    obs.count("rpc.errors", labels={"method": method or "unknown",
+                                    "type": "DeadlineExceeded"})
+    return {"id": rid, "error": {
+        "type": "DeadlineExceeded",
+        "message": f"client deadline expired before {stage}",
+        "retriable": True,
+    }}
+
+
 class RpcServer:
     """One frontend session: documents + sync states by integer handle."""
 
@@ -218,6 +249,14 @@ class RpcServer:
         # default) make it pure bookkeeping — nothing is ever demoted.
         self.store = None
         self._handle_names: Dict[int, str] = {}  # doc handle -> durable name
+        # overload resilience: deadline enforcement shares the admission
+        # master switch (AUTOMERGE_TPU_ADMISSION=0 is the uncontrolled
+        # baseline the overload bench compares against). The serving
+        # layer installs its AdmissionController here so cluster status
+        # can advertise shed-mode.
+        self.deadlines_enabled = (
+            os.environ.get("AUTOMERGE_TPU_ADMISSION", "1") != "0")
+        self.admission = None
         if durable_dir is not None:
             from .store import DocStore
 
@@ -241,10 +280,13 @@ class RpcServer:
             # this doc's ordered queue) before serving the request
             doc = self._ensure_resident(p["doc"])
         touch = getattr(doc, "touch", None)
-        if touch is not None:
+        if touch is not None and not brownout_active():
             # read-path recency: without this a read-hot document looks
             # idle to the store's LRU policy (writes refresh at ack exit,
-            # reads previously refreshed nothing)
+            # reads previously refreshed nothing). In brownout the skip
+            # is deliberate: reads and generateSyncMessage serve from
+            # the resident image without recency churn — LRU precision
+            # is what the degraded mode trades for capacity.
             touch()
             if self.store is not None:
                 self.store.touch(self._handle_names.get(p["doc"], ""))
@@ -343,6 +385,11 @@ class RpcServer:
             if self.store is not None and name is not None:
                 self.store.forget(name)
             doc.close()
+        # cardinality hygiene: the shard pool keys this doc's queue by
+        # its integer handle — drop the rpc.queue_depth{doc=<handle>}
+        # series along with the per-doc gauges (handles are unbounded
+        # over a server's life; the gauge table must not be)
+        obs.remove_doc_gauges(name, queue_key=p.get("doc"))
         return None
 
     # -- durable documents (--durable DIR mode) -----------------------------
@@ -637,7 +684,10 @@ class RpcServer:
                 if k[0] != h
             }
             self._docs[h] = ref
-        return ref  # dd.close() above already removed the per-doc gauges
+        # dd.close() above already removed the per-doc gauges; the shard
+        # queue's depth series is keyed by handle and needs its own drop
+        obs.remove_doc_gauges(None, queue_key=h)
+        return ref
 
     def _store_drop_device(self, name: str) -> None:
         """Demote hot -> warm: release the device mirror and detach it
@@ -1095,7 +1145,14 @@ class RpcServer:
             obs.count("rpc.errors",
                       labels={"method": "unknown", "type": "UnknownMethod"})
             return {"id": rid, "error": {"type": "UnknownMethod",
-                                         "message": str(method)}}
+                                         "message": str(method),
+                                         "retriable": False}}
+        # last deadline gate: in the concurrent server this runs inside
+        # the ack scope, just before the mutation would join the fsync
+        # batch — the final point where an expired request can still be
+        # refused without having executed anything
+        if self.deadlines_enabled and request_expired(req):
+            return deadline_response(rid, method, "pre_fsync")
         # optional cross-process trace context: {"trace": {"t": <trace
         # id>, "s": <parent span id>}} on the request parents this
         # process's spans into the caller's chain (router -> node, client
@@ -1118,13 +1175,18 @@ class RpcServer:
                 obs.count("rpc.errors", labels={"method": method,
                                                 "type": type(e).__name__})
                 err = {"type": type(e).__name__, "message": str(e)}
+                # every error answer carries an EXPLICIT retriable flag:
                 # exceptions that know their retry semantics (a poisoned
-                # journal, a replication-gate timeout) surface it so the
-                # client retry loop can distinguish "back off and retry"
-                # from "permanently rejected"
+                # journal, a replication-gate timeout) surface it; every
+                # other exception is explicitly non-retriable, so clients
+                # never have to guess from the type name
                 retriable = getattr(e, "retriable", None)
-                if retriable is not None:
-                    err["retriable"] = bool(retriable)
+                err["retriable"] = (
+                    bool(retriable) if retriable is not None else False)
+                # a shedding node's backoff hint (Overloaded) rides along
+                ra = getattr(e, "retry_after_ms", None)
+                if ra is not None:
+                    err["retryAfterMs"] = int(ra)
                 return {"id": rid, "error": err}
 
     @staticmethod
@@ -1142,7 +1204,8 @@ class RpcServer:
         except Exception as e:
             return json.dumps({
                 "id": resp.get("id"),
-                "error": {"type": "EncodeError", "message": str(e)},
+                "error": {"type": "EncodeError", "message": str(e),
+                          "retriable": False},
             })
 
     def _parse_line(self, line: str) -> tuple[Optional[dict], Optional[dict]]:
@@ -1167,20 +1230,32 @@ class RpcServer:
             return None, {"id": None, "error": {
                 "type": "RequestTooLarge",
                 "message": f"request of {nbytes} bytes exceeds limit "
-                           f"of {self.max_request_bytes}"}}
+                           f"of {self.max_request_bytes}",
+                "retriable": False}}
         try:
             req = json.loads(line)
         except json.JSONDecodeError as e:
             obs.count("rpc.errors", labels={"method": "unknown",
                                             "type": "ParseError"})
             return None, {"id": None,
-                          "error": {"type": "ParseError", "message": str(e)}}
+                          "error": {"type": "ParseError", "message": str(e),
+                                    "retriable": False}}
         if not isinstance(req, dict):
             obs.count("rpc.errors", labels={"method": "unknown",
                                             "type": "ParseError"})
             return None, {"id": None, "error": {
                 "type": "ParseError",
-                "message": "request must be a JSON object"}}
+                "message": "request must be a JSON object",
+                "retriable": False}}
+        # deadline propagation: an optional top-level ``deadlineMs``
+        # (remaining budget at send time, like ``trace``) is stamped to
+        # an absolute LOCAL expiry here — every later enforcement stage
+        # (admission, dequeue, pre-fsync) compares against the same
+        # monotonic clock, immune to cross-host clock skew
+        dl = req.get("deadlineMs")
+        if (isinstance(dl, (int, float)) and not isinstance(dl, bool)
+                and dl > 0):
+            req["_deadline_ts"] = obs.now() + float(dl) / 1000.0
         return req, None
 
     def _handle_line(self, line: str) -> tuple[Optional[dict], bool]:
@@ -1197,9 +1272,12 @@ class RpcServer:
         try:
             return self.handle(req), False
         except Exception as e:  # belt and braces: handle() already catches
+            retriable = getattr(e, "retriable", None)
             return {"id": None,
                     "error": {"type": type(e).__name__,
-                              "message": str(e)}}, False
+                              "message": str(e),
+                              "retriable": bool(retriable)
+                              if retriable is not None else False}}, False
 
     def serve(self, stdin=None, stdout=None) -> None:
         stdin = stdin or sys.stdin

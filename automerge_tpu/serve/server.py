@@ -56,7 +56,8 @@ from typing import Dict, List, Optional, Tuple
 from .. import obs
 from ..obs import prof
 from ..ops.batched import CrossDocBatcher
-from ..rpc import RpcServer
+from ..rpc import RpcServer, deadline_response, request_expired
+from .admission import AdmissionController, Overloaded
 from .shards import QueueFull, ShardPool
 
 _OPEN_DURABLE_KEY = "__open_durable__"  # serializes name-cache races
@@ -195,6 +196,17 @@ class SocketRpcServer:
                 _env_int("AUTOMERGE_TPU_BATCH_DOCS", 32), n_workers
             )
         )
+        # overload resilience: one per-node admission controller scores
+        # load from the pool's dequeue waits + utilization, the store's
+        # hydration/RSS pressure, sheds by priority class past the soft
+        # limits, and runs the brownout state machine (which widens the
+        # batcher window under sustained pressure). The rpc backref lets
+        # clusterStatus advertise shed-mode on the heartbeat.
+        self.admission = AdmissionController(
+            pool=self.pool, store=self.rpc.store, batcher=self.batcher
+        )
+        self.pool.wait_observer = self.admission.note_wait
+        self.rpc.admission = self.admission
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -409,7 +421,32 @@ class SocketRpcServer:
                 return sd
         return None
 
+    def _bounded_method(self, req: dict) -> str:
+        """The request's method if it is in the allowlist, else
+        "unknown" — keeps error-counter labels bounded."""
+        m = req.get("method")
+        return m if isinstance(m, str) and m in self.rpc.METHODS else "unknown"
+
     def _route(self, conn: _Conn, req: dict) -> None:
+        # admission-stage deadline gate: a request that arrived already
+        # expired (or aged out in the accept path) is refused before it
+        # consumes a queue slot
+        if self.rpc.deadlines_enabled and request_expired(req):
+            conn.send(self.rpc._encode_response(deadline_response(
+                req.get("id"), self._bounded_method(req), "admission")) + "\n")
+            return
+        # admission control: shed the lowest-priority classes first once
+        # the load score crosses their thresholds
+        try:
+            self.admission.admit(req.get("method") or "")
+        except Overloaded as e:
+            err = {"type": "Overloaded", "message": str(e),
+                   "retriable": True}
+            if e.retry_after_ms is not None:
+                err["retryAfterMs"] = int(e.retry_after_ms)
+            conn.send(self.rpc._encode_response(
+                {"id": req.get("id"), "error": err}) + "\n")
+            return
         key = self._affinity(req)
         if key is None:
             # affinity-free: handle tables only, safe on this thread
@@ -482,7 +519,22 @@ class SocketRpcServer:
 
     def _execute_batch_inner(self, key, items) -> None:
         rpc = self.rpc
-        doc = rpc._docs.get(key) if isinstance(key, int) else None
+        out: List[Tuple[_Conn, dict]] = []
+        if rpc.deadlines_enabled:
+            # dequeue-stage deadline gate: requests whose budget burned
+            # away in the shard queue are answered without hydrating,
+            # locking, or opening an ack scope for them
+            live = []
+            for conn, req in items:
+                if request_expired(req):
+                    out.append((conn, deadline_response(
+                        req.get("id"), self._bounded_method(req), "dequeue")))
+                else:
+                    live.append((conn, req))
+            items = live
+        doc = (
+            rpc._docs.get(key) if isinstance(key, int) and items else None
+        )
         if doc is not None and getattr(doc, "_closed", False):
             # cold-demoted document: hydrate once, here, inside this
             # doc's ordered drain — the whole batch then runs against
@@ -500,7 +552,6 @@ class SocketRpcServer:
                 except Exception:
                     doc = None
         scope = getattr(doc, "ack_scope", None)
-        out: List[Tuple[_Conn, dict]] = []
         try:
             with scope() if scope is not None else contextlib.nullcontext():
                 i = 0
@@ -545,8 +596,7 @@ class SocketRpcServer:
             retriable = getattr(e, "retriable", None)
             if retriable is None and isinstance(e, OSError):
                 retriable = True
-            if retriable is not None:
-                err["retriable"] = bool(retriable)
+            err["retriable"] = bool(retriable) if retriable is not None else False
             out = [
                 (c, r if "error" in r else {
                     "id": r.get("id"), "error": dict(err)})
@@ -619,6 +669,12 @@ class SocketRpcServer:
         frames, live = [], []
         for conn, req in run:
             p = req.get("params") or {}
+            # the coalesced path bypasses rpc.handle: enforce the final
+            # deadline stage per frame here
+            if rpc.deadlines_enabled and request_expired(req):
+                out.append((conn, deadline_response(
+                    req.get("id"), "syncSessionReceive", "pre_fsync")))
+                continue
             try:
                 sess = rpc._session(p)
                 frames.append(base64.b64decode(p["data"]))
@@ -627,7 +683,8 @@ class SocketRpcServer:
                 obs.count("rpc.errors", labels={
                     "method": "syncSessionReceive", "type": type(e).__name__})
                 out.append((conn, {"id": req.get("id"), "error": {
-                    "type": type(e).__name__, "message": str(e)}}))
+                    "type": type(e).__name__, "message": str(e),
+                    "retriable": bool(getattr(e, "retriable", False))}}))
         if not live:
             return
         sess = live[0][2]
@@ -658,6 +715,10 @@ class SocketRpcServer:
                       labels={"method": "receiveSyncMessage"}):
             for conn, req in run:
                 p = req.get("params") or {}
+                if rpc.deadlines_enabled and request_expired(req):
+                    out.append((conn, deadline_response(
+                        req.get("id"), "receiveSyncMessage", "pre_fsync")))
+                    continue
                 try:
                     doc = rpc._doc(p)
                     msg = Message.decode(base64.b64decode(p["data"]))
@@ -670,7 +731,8 @@ class SocketRpcServer:
                         "method": "receiveSyncMessage",
                         "type": type(e).__name__})
                     out.append((conn, {"id": req.get("id"), "error": {
-                        "type": type(e).__name__, "message": str(e)}}))
+                        "type": type(e).__name__, "message": str(e),
+                        "retriable": bool(getattr(e, "retriable", False))}}))
         dev = getattr(doc, "device_doc", None)
         if dev is not None and changes_batches:
             try:

@@ -73,6 +73,12 @@ class ShardPool:
         self._ready: deque = deque()  # keys with work and no owning worker
         self._stopping = False
         self._busy = 0
+        self._svc_ewma = 0.0  # per-item execution seconds, EWMA
+        # optional hook fed each drain's dequeue wait (seconds); the
+        # admission controller installs itself here to keep a *recent*
+        # wait estimate (the all-time histogram percentile cannot decay,
+        # so it would pin the load score high forever after one burst)
+        self.wait_observer: Optional[Callable[[float], None]] = None
         self.workers = [
             threading.Thread(
                 target=self._worker, name=f"{name}-{i}", daemon=True
@@ -112,6 +118,32 @@ class ShardPool:
             q = self._queues.get(key)
             return len(q.items) if q is not None else 0
 
+    def utilization(self) -> float:
+        """Fraction of workers currently executing (0.0..1.0) — one of
+        the admission controller's load signals."""
+        with self._lock:
+            return self._busy / (len(self.workers) or 1)
+
+    def backlog(self) -> int:
+        """Total queued items across every per-document queue."""
+        with self._lock:
+            return sum(len(q.items) for q in self._queues.values())
+
+    def expected_wait(self) -> float:
+        """Expected dequeue wait of the deepest queue RIGHT NOW: its
+        depth times the recent per-item service time. Per-doc ordering
+        means a doc's queue drains serially, so depth x service time is
+        what a request arriving behind it will actually wait. This is
+        the admission controller's *present-tense* congestion signal —
+        the EWMA of past dequeue waits lags a flood on the way up and
+        keeps shedding after the drain on the way down."""
+        with self._lock:
+            if not self._queues or self._svc_ewma <= 0.0:
+                return 0.0
+            deepest = max(
+                (len(q.items) for q in self._queues.values()), default=0)
+            return deepest * self._svc_ewma
+
     # -- the workers ---------------------------------------------------------
 
     def _worker(self) -> None:
@@ -139,14 +171,25 @@ class ShardPool:
                 # dequeue latency: how long the oldest request of this
                 # drain sat queued before a worker picked the doc up
                 obs.observe("serve.queue_wait", waited)
+                if self.wait_observer is not None:
+                    self.wait_observer(waited)
             obs.gauge_set("rpc.queue_depth", depth, labels={"doc": str(key)})
             obs.gauge_set("rpc.pool_busy", busy)
             obs.gauge_set("rpc.pool_utilization", busy / n_workers)
+            t0 = _monotonic()
             try:
                 if batch:
                     self._execute(key, batch)
             finally:
+                dt = _monotonic() - t0
+                popped = False
                 with self._lock:
+                    if batch:
+                        per = dt / len(batch)
+                        self._svc_ewma = (
+                            per if self._svc_ewma <= 0.0
+                            else self._svc_ewma + 0.3 * (per - self._svc_ewma)
+                        )
                     self._busy -= 1
                     if q.items:
                         # still work: stay scheduled, go back in line so
@@ -158,6 +201,13 @@ class ShardPool:
                         # drop the empty queue: handles are unbounded over
                         # a server's life, the queue table must not be
                         self._queues.pop(key, None)
+                        popped = True
+                if popped:
+                    # same hygiene for the gauge: the registry's label
+                    # table is as unbounded as the queue table was. A
+                    # racing submit may have re-created the queue already;
+                    # its next drain simply re-creates the series.
+                    obs.gauge_remove("rpc.queue_depth", {"doc": str(key)})
 
     # -- shutdown ------------------------------------------------------------
 

@@ -50,6 +50,7 @@ import threading
 from typing import Dict, List, Optional
 
 from .. import obs
+from ..degrade import brownout_active
 from ..utils.leb128 import decode_uleb, encode_uleb
 from .change import parse_change
 from .journal import (
@@ -614,6 +615,12 @@ class DurableDocument:
             and j.size_bytes < self.compact_cost_ratio * self._last_snapshot_bytes
         ):
             obs.count("compact.deferred_by_cost")
+            return False
+        if brownout_active():
+            # brownout: background compaction is exactly the churn a
+            # degraded node defers — the journal keeps growing (bounded
+            # by disk, not RSS) and compacts once pressure lifts
+            obs.count("compact.deferred_brownout")
             return False
         if self._background:
             self._schedule_compact()

@@ -48,6 +48,7 @@ import threading
 from typing import Dict, List, Optional
 
 from .. import obs
+from ..degrade import brownout_active
 from .policy import (
     TIER_COLD,
     TIER_HOT,
@@ -361,6 +362,15 @@ class DocStore:
         victims, apply them. Returns the number of demotions applied.
         Cheap no-op when no budget is configured."""
         if self._closed or not self.budgets.active:
+            return 0
+        if brownout_active() and not (
+            self.budgets.max_rss_bytes
+            and current_rss_bytes() > self.budgets.max_rss_bytes
+        ):
+            # brownout: cold-demotion churn (close/compact/re-hydrate
+            # cycles) defers — EXCEPT when RSS is actually over budget;
+            # the memory watermark is a hard promise, degraded or not
+            obs.count("store.evict_deferred_brownout")
             return 0
         now = obs.now()
         with self._lock:

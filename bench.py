@@ -1712,6 +1712,369 @@ def main():
     results["compressed"] = comp_cfg
     note(f"compressed: {results['compressed']}")
 
+    # ---- config: overload (admission control + deadline propagation) -------
+    # Drive a concurrent durable server far past its saturation point
+    # with per-request deadlines and measure GOODPUT: responses that
+    # succeed within their own deadline. Clients are the reference
+    # cooperating kind — an AIMD in-flight window that halves on
+    # Overloaded/DeadlineExceeded and grows on success — so the
+    # admission layer's shed answers act as the congestion signal that
+    # parks the system at its efficient operating point. The SAME drive
+    # against an admission-disabled control server shows the classic
+    # overload collapse: no shed signal, queues to the configured
+    # bound, every response late. Also verified in-config: zero
+    # acked-write loss (every acked put covered by an acked commit is
+    # present at readback) and zero deadlocked clients. Each phase
+    # writes fresh documents: doc/journal growth across phases would
+    # otherwise confound capacity vs overdrive service times.
+    ol_cfg = {}
+    try:
+        if env_flag("BENCH_OVERLOAD", "1") != "0":
+            import re
+            import shutil
+            import socket as socketmod
+            import subprocess
+            import tempfile
+            import threading
+
+            ol_docs = env_int("BENCH_OL_DOCS", 3)
+            # capacity is measured at a healthy queue depth (waits well
+            # inside every shed band); overdrive offers OVERDRIVE x
+            # that in-flight demand per client
+            ol_cap_window = env_int("BENCH_OL_CAP_WINDOW", 16)
+            ol_overdrive = env_int("BENCH_OL_OVERDRIVE", 12)
+            ol_window = ol_cap_window * ol_overdrive
+            ol_cap_ops = env_int("BENCH_OL_CAP_OPS", 3000)
+            ol_ops = env_int("BENCH_OL_OPS", 4800)  # overdrive reqs/client
+            ol_deadline_ms = env_int("BENCH_OL_DEADLINE_MS", 200)
+            ol_env = dict(
+                os.environ,
+                JAX_PLATFORMS="cpu",
+                # deep queues: the point is admission/deadline shedding,
+                # not the per-doc QueueFull backstop masking it (same
+                # depth for control and treatment — only admission
+                # differs between the two servers)
+                AUTOMERGE_TPU_SERVE_QUEUE_DEPTH="8192",
+                # the operator contract: admission target wait tracks
+                # the latency SLO. Proportional shedding then settles
+                # the admitted queue near band center (2-4x target),
+                # comfortably inside the client deadline.
+                AUTOMERGE_TPU_ADMISSION_TARGET_WAIT_S=str(
+                    ol_deadline_ms / 8.0 / 1000.0),
+                # resample the load score often enough that a window
+                # burst cannot slip past a stale-low cached score
+                AUTOMERGE_TPU_ADMISSION_SAMPLE_S="0.01",
+            )
+
+            def ol_spawn(tmpdir, admission):
+                env = dict(ol_env, AUTOMERGE_TPU_ADMISSION=admission)
+                proc = subprocess.Popen(
+                    [sys.executable, "-m", "automerge_tpu.rpc",
+                     "--socket", "127.0.0.1:0", "--durable", tmpdir],
+                    stderr=subprocess.PIPE, text=True, env=env,
+                )
+                port = int(re.search(
+                    r"(\d+)\)", proc.stderr.readline()).group(1))
+                threading.Thread(
+                    target=lambda: [None for _ in proc.stderr],
+                    daemon=True,
+                ).start()
+                return proc, port
+
+            def ol_ask(sock, f, method, params):
+                """Serial control-path request, retried through shed
+                windows (openDurable is rank-1 and can itself be shed
+                under full overload — a real client retries it)."""
+                for _ in range(400):
+                    sock.sendall((json.dumps(
+                        {"id": 0, "method": method, "params": params})
+                        + "\n").encode())
+                    while True:
+                        resp = json.loads(f.readline())
+                        if resp.get("id") == 0:
+                            break
+                    if "error" not in resp:
+                        return resp
+                    time.sleep(0.025)
+                return resp
+
+            def ol_shutdown(proc, port):
+                sock = socketmod.create_connection(("127.0.0.1", port))
+                sock.sendall(b'{"id":1,"method":"shutdown"}\n')
+                sock.makefile("r").readline()
+                sock.close()
+                proc.wait(timeout=60)
+
+            def ol_server_stats(port):
+                """Overload counters off the live server (metrics RPC):
+                shed per class, deadline expiries per stage, brownout
+                transitions, the queue-wait histogram."""
+                sock = socketmod.create_connection(("127.0.0.1", port))
+                f = sock.makefile("r")
+                sock.sendall(
+                    b'{"id":1,"method":"metrics",'
+                    b'"params":{"format":"json"}}\n')
+                snap = json.loads(f.readline())["result"]["metrics"]
+                sock.close()
+                out = {"shed": {}, "deadline_expired": {},
+                       "brownout_transitions": {}}
+                for it in snap:
+                    name, labels = it.get("name"), it.get("labels", {})
+                    if name == "serve.shed":
+                        out["shed"][labels.get("class")] = it["value"]
+                    elif name == "serve.deadline_expired":
+                        out["deadline_expired"][
+                            labels.get("stage")] = it["value"]
+                    elif name == "cluster.brownout_transitions":
+                        out["brownout_transitions"][
+                            labels.get("to")] = it["value"]
+                    elif name == "serve.load_score":
+                        out["load_score"] = round(it["value"], 3)
+                    elif name == "serve.queue_wait":
+                        out["queue_wait_p95_s"] = round(
+                            it.get("p95", 0.0), 6)
+                return out
+
+            class _OlStats:
+                __slots__ = ("goodput", "late", "shed", "other",
+                             "lats", "acked_keys", "done")
+
+                def __init__(self):
+                    self.goodput = 0  # success within its own deadline
+                    self.late = 0  # success past the deadline
+                    self.shed = 0  # DeadlineExceeded/Overloaded/Backpressure
+                    self.other = 0
+                    self.lats = []  # accepted-request latencies
+                    self.acked_keys = []  # put keys covered by acked commit
+                    self.done = False
+
+            _SHED_TYPES = {"DeadlineExceeded", "Overloaded", "Backpressure"}
+
+            def ol_client(port, doc_name, tag, n_ops, deadline_ms, window,
+                          stats):
+                """One driver: pipelined requests under an AIMD
+                in-flight window (halve on shed, grow on success),
+                7 puts then a commit, each stamped with its own deadline
+                when ``deadline_ms`` is set. Ends with an undeadlined
+                flush commit so every acked put is commit-covered for
+                the readback audit."""
+                sock = socketmod.create_connection(("127.0.0.1", port))
+                sock.setsockopt(socketmod.IPPROTO_TCP,
+                                socketmod.TCP_NODELAY, 1)
+                sock.settimeout(120.0)
+                f = sock.makefile("r")
+                r = ol_ask(sock, f, "openDurable", {"name": doc_name})
+                dh = r["result"]["doc"]
+                sent = {}  # id -> (t_send, kind, key)
+                acked_puts = {}  # id -> key (awaiting a covering commit)
+                nid = [0]
+                cwnd = [16.0]
+                last_cut = [0.0]
+
+                def send_one(i):
+                    nid[0] += 1
+                    if i % 8 == 7:
+                        req = {"id": nid[0], "method": "commit",
+                               "params": {"doc": dh}}
+                        kind, key = "commit", None
+                    else:
+                        key = f"{tag}_{i:06}"
+                        req = {"id": nid[0], "method": "put",
+                               "params": {"doc": dh, "obj": "_root",
+                                          "prop": key, "value": i}}
+                        kind = "put"
+                    if deadline_ms:
+                        req["deadlineMs"] = deadline_ms
+                    sent[nid[0]] = (time.perf_counter(), kind, key)
+                    sock.sendall((json.dumps(req) + "\n").encode())
+
+                def read_one():
+                    resp = json.loads(f.readline())
+                    rid = resp.get("id")
+                    t0, kind, key = sent.pop(rid)
+                    lat = time.perf_counter() - t0
+                    if "error" in resp:
+                        etype = resp["error"].get("type")
+                        if etype in _SHED_TYPES:
+                            stats.shed += 1
+                            nw = time.perf_counter()
+                            if nw - last_cut[0] > 0.1:
+                                cwnd[0] = max(8.0, cwnd[0] * 0.6)
+                                last_cut[0] = nw
+                        else:
+                            stats.other += 1
+                        return
+                    cwnd[0] = min(float(window), cwnd[0] + 0.5)
+                    stats.lats.append(lat)
+                    if deadline_ms and lat > deadline_ms / 1000.0:
+                        stats.late += 1
+                    else:
+                        stats.goodput += 1
+                    if kind == "put":
+                        acked_puts[rid] = key
+                    else:  # an acked commit covers every earlier ack
+                        for pid in [p for p in acked_puts if p < rid]:
+                            stats.acked_keys.append(acked_puts.pop(pid))
+
+                i = 0
+                while i < n_ops or sent:
+                    while i < n_ops and len(sent) < min(window,
+                                                        int(cwnd[0])):
+                        send_one(i)
+                        i += 1
+                    if sent:
+                        read_one()
+                # flush: one undeadlined commit (retried through shed
+                # windows) so surviving acked puts are commit-covered
+                resp = ol_ask(sock, f, "commit", {"doc": dh})
+                if "error" not in resp:
+                    stats.acked_keys.extend(acked_puts.values())
+                    acked_puts.clear()
+                sock.close()
+                stats.done = True
+
+            def ol_drive(port, phase, n_ops, deadline_ms, window):
+                """One phase: one client thread per doc against a
+                phase-specific document set; returns (stats list, wall
+                seconds, all joined)."""
+                stats = []
+                ts = []
+                barrier = threading.Barrier(ol_docs + 1)
+
+                def run(st, dname, tag):
+                    barrier.wait()
+                    ol_client(port, dname, tag, n_ops, deadline_ms,
+                              window, st)
+
+                for d in range(ol_docs):
+                    st = _OlStats()
+                    stats.append(st)
+                    ts.append(threading.Thread(
+                        target=run,
+                        args=(st, f"{phase}{d}", f"{phase}_d{d}"),
+                        daemon=True))
+                for t in ts:
+                    t.start()
+                barrier.wait()
+                t0 = time.perf_counter()
+                for t in ts:
+                    t.join(timeout=300.0)
+                dt = time.perf_counter() - t0
+                return stats, dt, all(st.done for st in stats)
+
+            def ol_readback(port, phase):
+                """{doc name: set of present keys} straight off the
+                server — the acked-write-loss audit's ground truth."""
+                sock = socketmod.create_connection(("127.0.0.1", port))
+                f = sock.makefile("r")
+                present = {}
+                for d in range(ol_docs):
+                    name = f"{phase}{d}"
+                    r = ol_ask(sock, f, "openDurable", {"name": name})
+                    dh = r["result"]["doc"]
+                    r = ol_ask(sock, f, "keys", {"doc": dh,
+                                                 "obj": "_root"})
+                    present[name] = set(r["result"])
+                sock.close()
+                return present
+
+            def ol_phase_summary(stats, dt):
+                offered = sum(
+                    st.goodput + st.late + st.shed + st.other
+                    for st in stats)
+                goodput = sum(st.goodput for st in stats)
+                shed = sum(st.shed for st in stats)
+                return {
+                    "offered": offered,
+                    "goodput": goodput,
+                    "goodput_rps": round(goodput / dt, 1),
+                    "late": sum(st.late for st in stats),
+                    "shed": shed,
+                    "shed_rate": round(shed / max(offered, 1), 4),
+                    "errors_other": sum(st.other for st in stats),
+                    "seconds": round(dt, 3),
+                }
+
+            tmp_ctl = tempfile.mkdtemp(prefix="amtpu_bench_ol_ctl_")
+            tmp_un = tempfile.mkdtemp(prefix="amtpu_bench_ol_un_")
+            ctl_proc = un_proc = None
+            try:
+                # -- controlled server: capacity, then overdrive --------
+                ctl_proc, ctl_port = ol_spawn(tmp_ctl, "1")
+                ol_drive(ctl_port, "wm", 256, 0, ol_cap_window)
+                cap_stats, cap_dt, cap_ok = ol_drive(
+                    ctl_port, "cap", ol_cap_ops, 0, ol_cap_window)
+                capacity_rps = sum(
+                    st.goodput for st in cap_stats) / cap_dt
+                od_stats, od_dt, od_ok = ol_drive(
+                    ctl_port, "od", ol_ops, ol_deadline_ms, ol_window)
+                # zero acked-write loss: every put acked AND covered by
+                # an acked commit must be present at readback
+                acked = {f"od{d}": set() for d in range(ol_docs)}
+                for st in od_stats:
+                    for k in st.acked_keys:
+                        acked[f"od{k.split('_d', 1)[1].split('_', 1)[0]}"
+                              ].add(k)
+                present = ol_readback(ctl_port, "od")
+                lost = {
+                    d: sorted(acked[d] - present[d])[:5]
+                    for d in acked if acked[d] - present[d]
+                }
+                server_stats = ol_server_stats(ctl_port)
+                ol_shutdown(ctl_proc, ctl_port)
+
+                # -- control server: same overdrive, admission off ------
+                un_proc, un_port = ol_spawn(tmp_un, "0")
+                ol_drive(un_port, "wm", 256, 0, ol_cap_window)
+                un_od_stats, un_od_dt, un_ok = ol_drive(
+                    un_port, "od", ol_ops, ol_deadline_ms, ol_window)
+                ol_shutdown(un_proc, un_port)
+            finally:
+                for p_ in (ctl_proc, un_proc):
+                    if p_ is not None and p_.poll() is None:
+                        p_.kill()
+                        p_.wait(timeout=10)
+                shutil.rmtree(tmp_ctl, ignore_errors=True)
+                shutil.rmtree(tmp_un, ignore_errors=True)
+
+            od = ol_phase_summary(od_stats, od_dt)
+            un = ol_phase_summary(un_od_stats, un_od_dt)
+            ol_cfg = {
+                "docs": ol_docs,
+                "overdrive": ol_overdrive,
+                "ops_per_client": ol_ops,
+                "window": ol_window,
+                "cap_window": ol_cap_window,
+                "deadline_ms": ol_deadline_ms,
+                "capacity_rps": round(capacity_rps, 1),
+                **od,
+                "goodput_ratio": round(
+                    od["goodput_rps"] / max(capacity_rps, 1e-9), 3),
+                "acked_write_loss": sum(len(v) for v in lost.values()),
+                "lost_sample": lost,
+                "deadlocked": not (cap_ok and od_ok and un_ok),
+                "server": server_stats,
+                **_latency_percentiles(
+                    "bench.overload.accepted_latency",
+                    [x for st in od_stats for x in st.lats]),
+                "control": {
+                    **un,
+                    "goodput_ratio": round(
+                        un["goodput_rps"] / max(capacity_rps, 1e-9), 3),
+                    **_latency_percentiles(
+                        "bench.overload.control_latency",
+                        [x for st in un_od_stats for x in st.lats]),
+                },
+            }
+    except Exception as e:  # noqa: BLE001 — degrade, record, continue
+        import traceback
+
+        tb = traceback.format_exc()
+        ol_cfg = {"overload_error": repr(e)[:500]}
+        print(f"overload config failed:\n{tb}", file=sys.stderr, flush=True)
+    results["overload"] = ol_cfg
+    note(f"overload: {results['overload']}")
+
     out = {
         "metric": "edit_trace_fanin_merge_ops_per_sec",
         "value": results["fanin"]["ops_per_sec"],

@@ -46,6 +46,7 @@ from typing import Any, Dict, List, Optional, Tuple
 RETRIABLE_TYPES = frozenset({
     "Unavailable", "NotLeader", "Backpressure", "RouterError",
     "ReplicationTimeout", "JournalPoisoned",
+    "DeadlineExceeded", "Overloaded",
 })
 
 
@@ -157,7 +158,8 @@ class RetryingClient:
 
     def request(self, method: str, params: Optional[dict] = None,
                 trace: Optional[dict] = None,
-                timeout: Optional[float] = None) -> Dict[str, Any]:
+                timeout: Optional[float] = None,
+                deadline_ms: Optional[float] = None) -> Dict[str, Any]:
         """One request, one raw response dict — no retry. Raises OSError
         on transport death OR a garbled frame (both are the retry loop's
         signal to drop the connection and redial — after either, the
@@ -173,6 +175,12 @@ class RetryingClient:
             "id": self._rid, "method": method, "params": params or {}}
         if trace is not None:
             req["trace"] = trace
+        if deadline_ms is not None and deadline_ms > 0:
+            # deadline propagation: the remaining per-call budget rides
+            # as a top-level field (like "trace"); router and nodes
+            # refuse the request once it expires instead of executing
+            # work this client already gave up on
+            req["deadlineMs"] = int(deadline_ms)
         try:
             self._sock.sendall((json.dumps(req) + "\n").encode("utf-8"))
             while True:
@@ -215,10 +223,14 @@ class RetryingClient:
                 # each attempt is bounded by what is left of the budget:
                 # a peer that receives but never answers (the asymmetric
                 # partition) times the attempt out instead of hanging
-                # the whole call past its deadline
+                # the whole call past its deadline. The same remaining
+                # budget ships as deadlineMs, so server and client agree
+                # on who gave up.
                 attempt_budget = deadline - time.monotonic()
                 resp = self.request(method, params, trace=trace,
-                                    timeout=max(attempt_budget, 0.05))
+                                    timeout=max(attempt_budget, 0.05),
+                                    deadline_ms=max(attempt_budget, 0.05)
+                                    * 1000.0)
                 if "error" not in resp:
                     if t_first_fail is not None:
                         stats.blocked_s = time.monotonic() - t_first_fail
@@ -236,8 +248,18 @@ class RetryingClient:
             if remaining <= 0:
                 stats.blocked_s = time.monotonic() - t_first_fail
                 raise Deadline(err, stats.blocked_s, stats.attempts)
-            # capped exponential backoff with seeded jitter, clamped to
-            # the remaining budget so the last sleep cannot overshoot
-            sleep = min(backoff * (0.5 + self.rng.random()), remaining)
+            # a server retryAfterMs hint (a shedding node pacing its
+            # retries) overrides the exponential schedule: jittered
+            # 0.75-1.25x so a shed wave does not re-arrive in lockstep,
+            # still capped by max_backoff_s and the remaining budget
+            ra = err.get("retryAfterMs")
+            if isinstance(ra, (int, float)) and ra > 0:
+                hinted = (ra / 1000.0) * (0.75 + 0.5 * self.rng.random())
+                sleep = min(hinted, self.max_backoff_s, remaining)
+            else:
+                # capped exponential backoff with seeded jitter, clamped
+                # to the remaining budget so the last sleep cannot
+                # overshoot
+                sleep = min(backoff * (0.5 + self.rng.random()), remaining)
+                backoff = min(backoff * 2, self.max_backoff_s)
             time.sleep(sleep)
-            backoff = min(backoff * 2, self.max_backoff_s)

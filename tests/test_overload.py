@@ -1,0 +1,500 @@
+"""Overload resilience unit coverage: admission control (priority
+classes, proportional shedding, brownout hysteresis), deadline
+enforcement on the RPC surface, the replication ack-gate circuit
+breaker, shard-pool load signals, queue-gauge hygiene, the
+retriable-flag contract audit, and the reference client's retryAfterMs
+pacing. Everything here is wall-clock injectable or event-driven — no
+load generation, no sleeps longer than a breaker cooldown."""
+
+import json
+import socket
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from automerge_tpu import obs
+from automerge_tpu.cluster.replication import ReplicationHub, ReplicationTimeout
+from automerge_tpu.rpc import RpcServer
+from automerge_tpu.serve.admission import (
+    NO_SHED_RANK,
+    AdmissionController,
+    Overloaded,
+    priority_class,
+)
+from automerge_tpu.serve.shards import ShardPool
+
+
+def call(srv, method, **params):
+    resp = srv.handle({"id": 1, "method": method, "params": params})
+    assert "error" not in resp, resp
+    return resp["result"]
+
+
+def _counter_total(name):
+    return sum(
+        e["value"] for e in obs.snapshot()
+        if e["type"] == "counter" and e["name"] == name
+    )
+
+
+# -- priority classes ---------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "method,rank,cls",
+    [
+        ("replApply", 0, "replication"),
+        ("clusterStatus", 0, "replication"),
+        ("metrics", 0, "replication"),
+        ("put", 1, "mutation"),
+        ("someBrandNewMethod", 1, "mutation"),  # unknown defaults protected
+        ("generateSyncMessage", 2, "sync"),
+        ("get", 3, "read"),
+        ("save", 3, "read"),
+        ("durableCompact", 4, "background"),
+        ("storeDemote", 4, "background"),
+    ],
+)
+def test_priority_class_mapping(method, rank, cls):
+    assert priority_class(method) == (rank, cls)
+
+
+# -- proportional shedding math -----------------------------------------------
+
+
+def test_shed_fraction_band_and_shed_rank():
+    ac = AdmissionController(enabled=True)
+    try:
+        soft, hard = ac.soft, ac.hard
+        # rank 0 is never shed, at any score
+        assert ac.shed_fraction(0, 1e9) == 0.0
+        # background sheds across [soft, 2*soft]: 0 below, linear inside
+        assert ac.shed_fraction(4, soft * 0.99) == 0.0
+        assert ac.shed_fraction(4, soft * 1.5) == pytest.approx(0.5)
+        assert ac.shed_fraction(4, soft * 2.0) == pytest.approx(1.0)
+        assert ac.shed_fraction(4, soft * 9.0) == 1.0
+        # interactive mutations hold out until the hard threshold
+        assert ac.shed_fraction(1, hard * 0.99) == 0.0
+        assert ac.shed_fraction(1, hard * 1.5) == pytest.approx(0.5)
+        # full-shed advertisement: nothing at low score, background first,
+        # everything sheddable at twice the hard limit
+        assert ac.shed_rank(score=soft * 0.5) == NO_SHED_RANK
+        assert ac.shed_rank(score=soft * 2.0) == 4
+        assert ac.shed_rank(score=hard * 2.0) == 1
+    finally:
+        ac.reset()
+
+
+def test_admit_sheds_by_class_and_overloaded_contract():
+    ac = AdmissionController(enabled=True)
+    try:
+        # pin the score past background full-shed but below the mutation
+        # threshold: background is refused deterministically, mutations
+        # pass, and replication passes no matter what
+        ac.load_score = lambda now=None: 1.6
+        assert ac.hard > 1.6 >= 2.0 * ac._shed_threshold(4)
+        before = obs.counter_values("serve.shed", "class").get("background", 0)
+        with pytest.raises(Overloaded) as ei:
+            ac.admit("durableCompact")
+        err = ei.value
+        assert err.retriable is True
+        assert err.shed_class == "background"
+        assert 50 <= err.retry_after_ms <= 5000
+        after = obs.counter_values("serve.shed", "class").get("background", 0)
+        assert after == before + 1
+        ac.admit("put")  # mutation admitted at this score
+        ac.load_score = lambda now=None: 100.0
+        ac.admit("replApply")  # replication is NEVER shed
+        ac.admit("metrics")
+    finally:
+        ac.reset()
+
+
+def test_admit_disabled_is_a_noop():
+    ac = AdmissionController(enabled=False)
+    try:
+        ac.load_score = lambda now=None: 100.0
+        ac.admit("durableCompact")
+        ac.admit("put")
+        assert ac.advertisement(now=1.0)["shedClass"] == NO_SHED_RANK
+    finally:
+        ac.reset()
+
+
+# -- brownout hysteresis ------------------------------------------------------
+
+
+class _FakePool:
+    def __init__(self):
+        self.util = 0.0
+
+    def utilization(self):
+        return self.util
+
+    def backlog(self):
+        return 0
+
+    def expected_wait(self):
+        return 0.0
+
+
+def test_brownout_hysteresis_and_batcher_widen():
+    from automerge_tpu.degrade import BROWNOUT, brownout_active
+
+    fp = _FakePool()
+    batcher = SimpleNamespace(window=8.0)
+    ac = AdmissionController(pool=fp, batcher=batcher, enabled=True)
+    try:
+        step = ac.sample_s + 0.01
+        t = 100.0
+        # sustained pressure above enter, but shorter than the hold: no flip
+        fp.util = ac.brownout_enter + 1.0
+        assert ac.load_score(now=t) == pytest.approx(fp.util)
+        assert not brownout_active()
+        t += ac.enter_hold_s / 2
+        ac.load_score(now=t)
+        assert not brownout_active()
+        # past the hold: enter, exactly once, and the batch window widens
+        t += ac.enter_hold_s
+        ac.load_score(now=t)
+        assert brownout_active()
+        assert ac.transitions == {"on": 1, "off": 0}
+        assert batcher.window == pytest.approx(8.0 * ac.window_widen)
+        # a dip below exit shorter than the exit hold does not flap out
+        fp.util = 0.0
+        t += step
+        ac.load_score(now=t)
+        t += ac.exit_hold_s / 2
+        # a spike back above exit resets the exit clock
+        fp.util = ac.brownout_exit + 0.2
+        ac.load_score(now=t)
+        fp.util = 0.0
+        t += step
+        ac.load_score(now=t)
+        t += ac.exit_hold_s / 2
+        ac.load_score(now=t)
+        assert brownout_active()  # exit clock was reset by the spike
+        # sustained calm past the full exit hold: exit, window restored
+        t += ac.exit_hold_s
+        ac.load_score(now=t)
+        assert not brownout_active()
+        assert ac.transitions == {"on": 1, "off": 1}
+        assert batcher.window == pytest.approx(8.0)
+        assert not BROWNOUT.is_set()
+    finally:
+        ac.reset()
+
+
+# -- shard-pool load signals --------------------------------------------------
+
+
+def test_shard_pool_expected_wait_and_gauge_hygiene():
+    started = threading.Event()
+    release = threading.Event()
+
+    def execute(key, items):
+        for it in items:
+            if it == "block":
+                started.set()
+                release.wait(10)
+
+    waits = []
+    pool = ShardPool(execute, workers=1, max_queue=8, max_batch=1, name="ol")
+    pool.wait_observer = waits.append
+    try:
+        pool.submit("k", "block")
+        assert started.wait(10)
+        # the single worker is pinned inside execute: utilization is 1.0
+        # and anything submitted behind it waits depth x service time
+        with pool._lock:
+            pool._svc_ewma = 0.1
+        pool.submit("k", "a")
+        pool.submit("k", "b")
+        assert pool.utilization() == 1.0
+        assert pool.backlog() == 2
+        assert pool.depth("k") == 2
+        assert pool.expected_wait() == pytest.approx(0.2)
+        release.set()
+        deadline = time.monotonic() + 10
+        while pool.backlog() > 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert pool.backlog() == 0
+        assert pool.expected_wait() == 0.0  # empty pool: no stale signal
+        assert pool._svc_ewma > 0.0
+        assert waits and all(w >= 0.0 for w in waits)
+        # drained queues drop their rpc.queue_depth series (the registry's
+        # label table must not grow with every doc handle ever served)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            series = [
+                e for e in obs.snapshot()
+                if e["name"] == "rpc.queue_depth"
+                and e["labels"].get("doc") == "k"
+            ]
+            if not series:
+                break
+            time.sleep(0.01)
+        assert not series, series
+    finally:
+        release.set()
+        pool.stop()
+
+
+def test_remove_doc_gauges_queue_key():
+    obs.gauge_set("rpc.queue_depth", 3.0, labels={"doc": "gone-42"})
+    assert any(
+        e["name"] == "rpc.queue_depth" and e["labels"].get("doc") == "gone-42"
+        for e in obs.snapshot()
+    )
+    n = obs.remove_doc_gauges(None, queue_key="gone-42")
+    assert n >= 1
+    assert not any(
+        e["name"] == "rpc.queue_depth" and e["labels"].get("doc") == "gone-42"
+        for e in obs.snapshot()
+    )
+
+
+# -- deadline enforcement on the RPC surface ----------------------------------
+
+
+def test_expired_deadline_refused_without_executing():
+    srv = RpcServer()
+    d = call(srv, "create", actor="07" * 16)["doc"]
+    call(srv, "put", doc=d, obj="_root", prop="k", value=1)
+    call(srv, "commit", doc=d)
+    heads0 = call(srv, "heads", doc=d)
+    before = obs.counter_values(
+        "serve.deadline_expired", "stage").get("pre_fsync", 0)
+    req = {"id": 5, "method": "put",
+           "params": {"doc": d, "obj": "_root", "prop": "x", "value": 2},
+           "_deadline_ts": obs.now() - 1.0}
+    resp = srv.handle(req)
+    err = resp["error"]
+    assert err["type"] == "DeadlineExceeded"
+    assert err["retriable"] is True
+    after = obs.counter_values(
+        "serve.deadline_expired", "stage").get("pre_fsync", 0)
+    assert after == before + 1
+    # differential: the mutation did NOT execute
+    assert call(srv, "heads", doc=d) == heads0
+    assert call(srv, "keys", doc=d, obj="_root") == ["k"]
+    # a live deadline executes normally
+    live = {"id": 6, "method": "put",
+            "params": {"doc": d, "obj": "_root", "prop": "x", "value": 2},
+            "_deadline_ts": obs.now() + 60.0}
+    assert "error" not in srv.handle(live)
+    assert call(srv, "get", doc=d, obj="_root", prop="x") == 2
+
+
+def test_expired_deadline_executes_when_admission_disabled(monkeypatch):
+    monkeypatch.setenv("AUTOMERGE_TPU_ADMISSION", "0")
+    srv = RpcServer()
+    assert srv.deadlines_enabled is False
+    d = call(srv, "create", actor="08" * 16)["doc"]
+    req = {"id": 2, "method": "put",
+           "params": {"doc": d, "obj": "_root", "prop": "x", "value": 7},
+           "_deadline_ts": obs.now() - 1.0}
+    assert "error" not in srv.handle(req)  # uncontrolled baseline executes
+    assert call(srv, "get", doc=d, obj="_root", prop="x") == 7
+
+
+def test_parse_line_stamps_deadline():
+    srv = RpcServer()
+    line = json.dumps({"id": 1, "method": "heads",
+                       "params": {}, "deadlineMs": 1500})
+    req, early = srv._parse_line(line)
+    assert early is None
+    t0 = obs.now()
+    assert t0 < req["_deadline_ts"] <= t0 + 1.6
+    # zero, negative, and boolean deadlineMs never stamp
+    for bad in (0, -5, True, "100"):
+        req, early = srv._parse_line(
+            json.dumps({"id": 1, "method": "heads", "params": {},
+                        "deadlineMs": bad}))
+        assert early is None and "_deadline_ts" not in req
+
+
+# -- the replication ack-gate circuit breaker ---------------------------------
+
+
+def test_replication_breaker_trips_bypasses_and_recovers():
+    hub = ReplicationHub("t-breaker", ack_replicas=1)
+    try:
+        hub.breaker_enabled = True
+        hub.breaker_threshold = 3
+        hub.breaker_cooldown = 0.05
+        hub._wait_acked = lambda name: (_ for _ in ()).throw(
+            ReplicationTimeout("follower set stalled"))
+        trips0 = _counter_total("repl.breaker_trips")
+        # repeated timeouts surface to the callers AND count toward the trip
+        for _ in range(hub.breaker_threshold):
+            with pytest.raises(ReplicationTimeout):
+                hub.wait_acked("doc")
+        assert hub.breaker_state() == "open"
+        assert _counter_total("repl.breaker_trips") == trips0 + 1
+        # open within cooldown: ack on leader durability alone, loudly
+        bypass0 = _counter_total("repl.breaker_bypass")
+        hub.wait_acked("doc")  # does not raise
+        assert _counter_total("repl.breaker_bypass") == bypass0 + 1
+        assert hub.breaker_state() == "open"
+        # after cooldown a half-open probe waits for real acks; success
+        # re-closes the breaker
+        time.sleep(hub.breaker_cooldown + 0.02)
+        hub._wait_acked = lambda name: None
+        hub.wait_acked("doc")
+        assert hub.breaker_state() == "closed"
+        # a failed probe re-opens on a single strike
+        hub._wait_acked = lambda name: (_ for _ in ()).throw(
+            ReplicationTimeout("still stalled"))
+        for _ in range(hub.breaker_threshold):
+            with pytest.raises(ReplicationTimeout):
+                hub.wait_acked("doc")
+        assert hub.breaker_state() == "open"
+        time.sleep(hub.breaker_cooldown + 0.02)
+        with pytest.raises(ReplicationTimeout):
+            hub.wait_acked("doc")  # the probe itself
+        assert hub.breaker_state() == "open"
+    finally:
+        hub.close()
+
+
+def test_replication_breaker_disabled_passes_timeouts_through():
+    hub = ReplicationHub("t-nobreaker", ack_replicas=1)
+    try:
+        hub.breaker_enabled = False
+        hub._wait_acked = lambda name: (_ for _ in ()).throw(
+            ReplicationTimeout("stalled"))
+        for _ in range(10):
+            with pytest.raises(ReplicationTimeout):
+                hub.wait_acked("doc")
+        assert hub.breaker_state() == "closed"
+    finally:
+        hub.close()
+
+
+# -- the retriable-flag contract audit ----------------------------------------
+
+
+def _audit_server():
+    srv = RpcServer()
+    d = call(srv, "create", actor="0a" * 16)["doc"]
+    return srv, d
+
+
+@pytest.mark.parametrize(
+    "case",
+    [
+        "unknown_method", "bad_doc", "bad_params", "bad_changes",
+        "open_durable_unsupported", "bad_sync_state", "expired_deadline",
+    ],
+)
+def test_every_error_answer_carries_an_explicit_retriable_flag(case):
+    """The client retry loop keys on ``retriable``; every error envelope
+    the dispatch surface produces must carry it as an explicit bool —
+    a missing flag silently falls back to the legacy type list."""
+    srv, d = _audit_server()
+    reqs = {
+        "unknown_method": {"method": "nope", "params": {}},
+        "bad_doc": {"method": "get",
+                    "params": {"doc": 999, "obj": "_root", "prop": "x"}},
+        "bad_params": {"method": "put", "params": {"doc": d}},
+        "bad_changes": {"method": "applyChanges",
+                        "params": {"doc": d, "changes": ["!!not-b64!!"]}},
+        "open_durable_unsupported": {"method": "openDurable",
+                                     "params": {"name": "x"}},
+        "bad_sync_state": {"method": "receiveSyncMessage",
+                           "params": {"doc": d, "state": "@@@",
+                                      "message": "@@@"}},
+        "expired_deadline": {"method": "put",
+                             "params": {"doc": d, "obj": "_root",
+                                        "prop": "x", "value": 1},
+                             "_deadline_ts": obs.now() - 1.0},
+    }
+    req = dict(reqs[case])
+    req["id"] = 1
+    resp = srv.handle(req)
+    assert "error" in resp, (case, resp)
+    err = resp["error"]
+    assert isinstance(err.get("retriable"), bool), (case, err)
+    if case == "expired_deadline":
+        assert err["type"] == "DeadlineExceeded" and err["retriable"] is True
+    if case == "unknown_method":
+        assert err["type"] == "UnknownMethod" and err["retriable"] is False
+
+
+def test_frame_level_errors_carry_explicit_retriable():
+    srv = RpcServer()
+    resp, stop = srv._handle_line("{definitely not json\n")
+    assert not stop
+    assert resp["error"]["type"] == "ParseError"
+    assert resp["error"]["retriable"] is False
+    resp, stop = srv._handle_line("[1, 2, 3]\n")
+    assert resp["error"]["type"] == "ParseError"
+    assert resp["error"]["retriable"] is False
+    big = json.dumps({"id": 1, "method": "put",
+                      "params": {"pad": "x" * (srv.max_request_bytes + 64)}})
+    resp, stop = srv._handle_line(big)
+    assert resp["error"]["type"] == "RequestTooLarge"
+    assert resp["error"]["retriable"] is False
+
+
+# -- the reference client honors retryAfterMs ---------------------------------
+
+
+def _client_mod():
+    import importlib.util
+    import pathlib
+
+    path = (pathlib.Path(__file__).parent.parent / "clients" / "python"
+            / "amtpu_client.py")
+    spec = importlib.util.spec_from_file_location("amtpu_client", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_retry_client_paces_itself_on_retry_after_hint():
+    """A shedding node's retryAfterMs hint overrides the exponential
+    schedule: the retry lands ~0.75-1.25x the hint later, not after the
+    (deliberately tiny) default backoff."""
+    amtpu = _client_mod()
+    ls = socket.socket()
+    ls.bind(("127.0.0.1", 0))
+    ls.listen(4)
+    gaps = []
+
+    def serve():
+        c, _ = ls.accept()
+        f = c.makefile("r")
+        req = json.loads(f.readline())
+        c.sendall((json.dumps({"id": req["id"], "error": {
+            "type": "Overloaded", "retriable": True,
+            "retryAfterMs": 400,
+            "message": "shedding mutation work"}}) + "\n").encode())
+        t_err = time.monotonic()
+        req = json.loads(f.readline())  # the paced retry, same connection
+        gaps.append(time.monotonic() - t_err)
+        c.sendall((json.dumps(
+            {"id": req["id"], "result": "done"}) + "\n").encode())
+        c.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    c = amtpu.RetryingClient(
+        "127.0.0.1:%d" % ls.getsockname()[1],
+        deadline_s=10, backoff_s=0.001, seed=3)
+    try:
+        assert c.call("put") == "done"
+        t.join(5)
+        assert not t.is_alive()
+        assert c.last.attempts == 2
+        assert c.last.errors == ["Overloaded"]
+        # jittered hint band is [0.3, 0.5]s; generous upper slack for a
+        # loaded CI box, but far above what backoff_s=1ms would produce
+        assert gaps and 0.25 <= gaps[0] <= 1.5, gaps
+        assert c.last.blocked_s >= 0.25
+    finally:
+        c.close()
+        ls.close()
