@@ -91,6 +91,11 @@ STAGES: Dict[str, tuple] = {
     "device.extract": ("extract", "host"),
     "device.pack": ("pack", "device"),
     "device.h2d": ("h2d", "device"),
+    # the eager run-table expansion dispatch (merge.stage_cols_device's
+    # _expander) — its own row so the run-native kernels' win (expansion
+    # fused INTO the kernel, this stage -> 0) is visible, not folded
+    # into h2d
+    "device.expand": ("expand", "device"),
     "device.kernel": ("kernel", "device"),
     "device.linearize": ("linearize", "device"),
     "device.readback": ("readback", "device"),
@@ -126,7 +131,7 @@ _HOST_EXCLUSIVE = ("dedup", "causal_order", "splice", "materialize",
                    "host_splice")
 
 _NOTE_KEYS = ("useful_rows", "padded_rows", "launches", "docs", "changes",
-              "h2d_bytes", "h2d_dense_bytes")
+              "h2d_bytes", "h2d_dense_bytes", "overlap_s")
 
 
 class _Cycle:
@@ -242,6 +247,14 @@ class _Cycle:
             # vs their dense equivalent — the compressed-residency win
             "h2d_bytes": n["h2d_bytes"],
             "h2d_dense_bytes": n["h2d_dense_bytes"],
+            # host seconds spent while a dispatched device launch was
+            # still in flight (the double-buffered drain pipeline notes
+            # them at its dispatch/collect seam) — wall hidden behind
+            # the kernel rather than serialized after it
+            "overlap_s": n["overlap_s"],
+            "overlap_frac": (
+                min(n["overlap_s"] / wall, 1.0) if wall > 0 else 0.0
+            ),
             "doc_costs": dict(self.doc_costs),
         }
 
@@ -325,6 +338,7 @@ class CycleProfiler:
             self.changes = 0
             self.h2d_bytes = 0
             self.h2d_dense_bytes = 0
+            self.overlap_s = 0.0
             self._doc_costs: Dict[str, float] = {}
 
     def record(self, report: dict) -> None:
@@ -346,6 +360,7 @@ class CycleProfiler:
             self.changes += report["changes"]
             self.h2d_bytes += report.get("h2d_bytes", 0)
             self.h2d_dense_bytes += report.get("h2d_dense_bytes", 0)
+            self.overlap_s += report.get("overlap_s", 0.0)
             for d, s in report["doc_costs"].items():
                 self._doc_costs[d] = self._doc_costs.get(d, 0.0) + s
             # bounded: past 4x the table prunes to the K most expensive
@@ -357,6 +372,7 @@ class CycleProfiler:
                 )[: self.top_k]
                 self._doc_costs = dict(keep)
         _obs.observe("drain.attributed_fraction", report["attributed_frac"])
+        _obs.observe("drain.overlap_fraction", report.get("overlap_frac", 0.0))
         for k, v in report["stages"].items():
             _obs.observe("drain.stage_seconds", v, labels={"stage": k})
         if report["occupancy"] is not None:
@@ -379,6 +395,7 @@ class CycleProfiler:
             "padded_rows": report["padded_rows"],
             "h2d_bytes": report.get("h2d_bytes", 0),
             "h2d_dense_bytes": report.get("h2d_dense_bytes", 0),
+            "overlap_s": round(report.get("overlap_s", 0.0), 6),
         }
         for k, v in report["stages"].items():
             ev[f"stage_{k}_s"] = round(v, 6)
@@ -413,6 +430,7 @@ class CycleProfiler:
                 "changes": self.changes,
                 "h2d_bytes": self.h2d_bytes,
                 "h2d_dense_bytes": self.h2d_dense_bytes,
+                "overlap_s": self.overlap_s,
             }
         out = summarize(agg)
         out["enabled"] = self.enabled
@@ -488,6 +506,14 @@ def summarize(agg: dict) -> dict:
             round(agg.get("h2d_dense_bytes", 0) / agg["h2d_bytes"], 2)
             if agg.get("h2d_bytes") else None
         ),
+        # pipelined-drain overlap: host seconds that ran while a device
+        # launch was in flight, as a fraction of the drain wall (0 = the
+        # two halves serialized, -> 1 = wall collapsed to max(host, device))
+        "overlap_s": round(agg.get("overlap_s", 0.0), 6),
+        "overlap_fraction": (
+            round(min(agg.get("overlap_s", 0.0) / wall, 1.0), 4)
+            if wall > 0 else 0.0
+        ),
         "launches": agg["launches"],
         "docs": agg["docs"],
         "changes": agg["changes"],
@@ -540,14 +566,14 @@ def summarize_reports(reports: List[dict]) -> dict:
         "cycles": 0, "wall_s": 0.0, "attributed_s": 0.0, "host_s": 0.0,
         "device_s": 0.0, "fsync_s": 0.0, "stages": {}, "useful_rows": 0,
         "padded_rows": 0, "launches": 0, "docs": 0, "changes": 0,
-        "h2d_bytes": 0, "h2d_dense_bytes": 0,
+        "h2d_bytes": 0, "h2d_dense_bytes": 0, "overlap_s": 0.0,
     }
     for r in reports:
         agg["cycles"] += 1
         for k in ("wall_s", "attributed_s", "host_s", "device_s", "fsync_s"):
             agg[k] += r[k]
         for k in ("useful_rows", "padded_rows", "launches", "docs", "changes",
-                  "h2d_bytes", "h2d_dense_bytes"):
+                  "h2d_bytes", "h2d_dense_bytes", "overlap_s"):
             agg[k] += r.get(k, 0)
         for k, v in r["stages"].items():
             agg["stages"][k] = agg["stages"].get(k, 0.0) + v
@@ -589,6 +615,7 @@ def summarize_flight_events(events: List[dict]) -> dict:
             "changes": int(num("changes")),
             "h2d_bytes": int(num("h2d_bytes")),
             "h2d_dense_bytes": int(num("h2d_dense_bytes")),
+            "overlap_s": num("overlap_s"),
         })
     out = summarize_reports(reports)
     out["source"] = "flight"
@@ -629,6 +656,12 @@ def render_text(summary: dict, top: Optional[int] = None) -> str:
             f"device {100.0 * ds / wall:.1f}%   "
             f"(host staging: vectorized {100.0 * vec / wall:.1f}%, "
             f"scalar {100.0 * sca / wall:.1f}%)"
+        )
+    ov = summary.get("overlap_s", 0.0)
+    if ov:
+        lines.append(
+            f"pipeline overlap: {100.0 * summary.get('overlap_fraction', 0.0):.1f}% "
+            f"of wall ({ov:.4f}s host work under in-flight launches)"
         )
     ec = summary.get("extract_cache") or {}
     if ec.get("cache_hit_ratio") is not None:

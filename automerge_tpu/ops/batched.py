@@ -155,14 +155,14 @@ def _pack(stages: Sequence[BatchStage]):
     return cols, metas, row_off, obj_off
 
 
-def _launch_packed(cols, n_objs: int, n_props: int):
-    """One kernel launch over the padded super-batch; element order is
-    ranked host-side overlapped with the kernel, exactly like the
-    per-doc dispatch (DeviceDoc._dispatch_async)."""
-    from .merge import (
-        merge_kernel_core, scatter_geometry_ok, scatter_kernel_core,
-        stage_cols_device,
-    )
+def _dispatch_packed(cols, n_objs: int, n_props: int):
+    """The host + dispatch half of a packed launch: pad, stage
+    (run-native run tables or the eager-expand staging), dispatch the
+    kernel WITHOUT reading back, and rank element order host-side while
+    it flies — exactly like the per-doc dispatch
+    (DeviceDoc._dispatch_async). Returns an in-flight handle for
+    ``_collect_packed``."""
+    from .merge import prepare_resolution
     from .oplog import host_linearize, pad_columns
 
     useful = len(cols["action"])
@@ -179,27 +179,34 @@ def _launch_packed(cols, n_objs: int, n_props: int):
     _prof.note("launches")
     obs.count("device.kernel_launches", labels={"path": "batched"})
     # the super-batch ships compressed: runs are packed under the same
-    # _capacity buckets as the rows (merge.stage_cols_device), so jit
-    # caches stay warm and device_put moves run tables, not dense rows
-    cols_dev = stage_cols_device(cols)
-    fn = (
-        scatter_kernel_core(n_objs, n_props)
-        if scatter_geometry_ok(P, n_objs, n_props)
-        else merge_kernel_core
-    )
+    # _capacity buckets as the rows, so jit caches stay warm and
+    # device_put moves run tables, not dense rows; with run-native
+    # kernels the tables are the kernel's input itself
+    dispatch = prepare_resolution(cols, n_objs, n_props)
     with obs.span("device.kernel", rows=P), \
             _prof.annotate("amtpu.batched_launch"):
-        out = fn(cols_dev)  # async dispatch
+        out = dispatch()  # async dispatch
     with obs.span("device.linearize", rows=P):
         ei = host_linearize(cols)
-    with obs.span("device.readback", rows=P):
+    return {"out": out, "ei": ei, "P": P}
+
+
+def _collect_packed(handle):
+    """The blocking half of a packed launch: read the resolution back."""
+    with obs.span("device.readback", rows=handle["P"]):
         res = {
-            k: np.asarray(out[k])
+            k: np.asarray(handle["out"][k])
             for k in ("visible", "winner", "conflicts",
                       "obj_vis_len", "obj_text_width")
         }
-    res["elem_index"] = ei
+    res["elem_index"] = handle["ei"]
     return res
+
+
+def _launch_packed(cols, n_objs: int, n_props: int):
+    """One kernel launch over the padded super-batch; element order is
+    ranked host-side overlapped with the kernel."""
+    return _collect_packed(_dispatch_packed(cols, n_objs, n_props))
 
 
 def _scatter(metas, res) -> None:
@@ -219,15 +226,20 @@ def _scatter(metas, res) -> None:
         st.doc._scatter_subset(st.rows, st.dirty, res_sub)
 
 
-def resolve_stages(
+def dispatch_stages(
     stages: Sequence[BatchStage], fallback_ratio: Optional[float] = None
 ) -> dict:
-    """Resolve staged documents: whales per-doc, the rest in ONE packed
-    launch. Returns {"batched": n_docs, "fallback": n_docs}."""
+    """The dispatch half of ``resolve_stages``: whales resolve per-doc
+    immediately (they never pipeline), the rest pack into ONE kernel
+    launch that is dispatched but NOT collected. The returned handle
+    feeds ``collect_stages`` — possibly after the caller has staged more
+    host work under the in-flight launch (the drain pipeline)."""
     batch, whales = plan_stages(stages, fallback_ratio)
     for w in whales:
         obs.count("device.batched_fallback")
         w.doc._reresolve(w.dirty)
+    handle = None
+    metas = None
     if batch:
         links = [st.trace for st in batch if st.trace is not None]
         with obs.span("device.batched", links=links, docs=len(batch)):
@@ -237,10 +249,40 @@ def resolve_stages(
             n_props = max(
                 (len(st.doc.log.props) for st in batch), default=1
             )
-            res = _launch_packed(cols, n_objs, max(n_props, 1))
-            with obs.span("device.scatter", docs=len(batch)):
-                _scatter(metas, res)
-    return {"batched": len(batch), "fallback": len(whales)}
+            handle = _dispatch_packed(cols, n_objs, max(n_props, 1))
+    return {
+        "batched": len(batch),
+        "fallback": len(whales),
+        "handle": handle,
+        "metas": metas,
+    }
+
+
+def collect_stages(disp: dict) -> dict:
+    """The blocking half of ``resolve_stages``: read the packed launch
+    back and scatter the results into each document."""
+    if disp["handle"] is not None:
+        with obs.span("device.batched", docs=disp["batched"]):
+            res = _collect_packed(disp["handle"])
+            with obs.span("device.scatter", docs=disp["batched"]):
+                _scatter(disp["metas"], res)
+    return {"batched": disp["batched"], "fallback": disp["fallback"]}
+
+
+def resolve_stages(
+    stages: Sequence[BatchStage], fallback_ratio: Optional[float] = None
+) -> dict:
+    """Resolve staged documents: whales per-doc, the rest in ONE packed
+    launch. Returns {"batched": n_docs, "fallback": n_docs}."""
+    return collect_stages(dispatch_stages(stages, fallback_ratio))
+
+
+def pipeline_enabled() -> bool:
+    """Whether the drain double-buffers: chunk N's packed kernel flies
+    while chunk N+1 runs its host pack/sort/splice. Host seconds spent
+    under an in-flight launch are noted as ``overlap_s`` and surface as
+    ``drain.overlap_fraction``."""
+    return os.environ.get("AUTOMERGE_TPU_DRAIN_PIPELINE", "1") != "0"
 
 
 def apply_cross_doc(
@@ -248,6 +290,7 @@ def apply_cross_doc(
     *,
     fallback_ratio: Optional[float] = None,
     max_docs_per_launch: Optional[int] = None,
+    pipeline: Optional[bool] = None,
 ) -> dict:
     """Synchronous multi-document apply: ``work`` is an iterable of
     ``(device_doc, batches)`` pairs (``batches`` = a sequence of change
@@ -257,6 +300,15 @@ def apply_cross_doc(
 
     Returns {"applied": total changes, "batched": docs resolved in
     packed launches, "fallback": docs resolved per-doc}.
+
+    When ``max_docs_per_launch`` splits the drain into several launches
+    and the pipeline is enabled (``pipeline`` kwarg, defaulting to
+    ``AUTOMERGE_TPU_DRAIN_PIPELINE`` which is on), the chunks
+    double-buffer: chunk N's packed kernel stays in
+    flight while chunk N+1 runs its host staging (dedup / causal-order /
+    pack / Lamport-sort / splice), and only then is chunk N collected.
+    Host seconds spent under an in-flight launch are noted as
+    ``overlap_s`` → ``drain.overlap_fraction``.
     """
     # the same DeviceDoc may appear several times in ``work``; its
     # batches must merge into ONE staging — a later append splices the
@@ -272,43 +324,84 @@ def apply_cross_doc(
         else:
             merged[k] = (dev, list(batches))
             order.append(k)
-    applied = 0
-    stages: List[BatchStage] = []
+
     from . import host_batch
 
-    if host_batch.enabled():
-        # the vectorized cross-doc staging: dedup/causal-order/extract/
-        # Lamport-sort/splice run as shared columnar passes with per-doc
-        # offset ranges; ineligible documents stage through the scalar
-        # path inside (host_batch.stage_docs merges duplicates itself,
-        # but the merge above also backs the scalar branch below)
-        stages, results = host_batch.stage_docs(
-            [merged[k] for k in order]
-        )
-        for r in results.values():
-            if r.error is not None:
-                raise r.error
-            applied += r.applied
-    else:
-        for i, k in enumerate(order):
-            dev, batches = merged[k]
-            t0 = time.perf_counter()
-            n, st = dev.stage_batches(batches)
-            _prof.note_doc(
-                getattr(dev, "obs_name", None) or f"doc{i}",
-                time.perf_counter() - t0,
+    def _stage_chunk(keys, idx0):
+        """Stage one chunk of documents host-side; returns
+        (stages, applied). Self-contained per call — host_batch.stage_docs
+        dedups within the call and the chunks are disjoint documents."""
+        applied = 0
+        stages: List[BatchStage] = []
+        if host_batch.enabled():
+            # the vectorized cross-doc staging: dedup/causal-order/
+            # extract/Lamport-sort/splice run as shared columnar passes
+            # with per-doc offset ranges; ineligible documents stage
+            # through the scalar path inside (host_batch.stage_docs
+            # merges duplicates itself, but the merge above also backs
+            # the scalar branch below)
+            stages, results = host_batch.stage_docs(
+                [merged[k] for k in keys]
             )
-            applied += n
-            if st is not None:
-                stages.append(st)
-    _prof.note("docs", len(order))
-    _prof.note("changes", applied)
-    out = {"applied": applied, "batched": 0, "fallback": 0}
-    step = max_docs_per_launch or len(stages) or 1
-    for lo in range(0, len(stages), step):
-        r = resolve_stages(stages[lo : lo + step], fallback_ratio)
+            for r in results.values():
+                if r.error is not None:
+                    raise r.error
+                applied += r.applied
+        else:
+            for i, k in enumerate(keys):
+                dev, batches = merged[k]
+                t0 = time.perf_counter()
+                n, st = dev.stage_batches(batches)
+                _prof.note_doc(
+                    getattr(dev, "obs_name", None) or f"doc{idx0 + i}",
+                    time.perf_counter() - t0,
+                )
+                applied += n
+                if st is not None:
+                    stages.append(st)
+        return stages, applied
+
+    out = {"applied": 0, "batched": 0, "fallback": 0}
+
+    def _account(r):
         out["batched"] += r["batched"]
         out["fallback"] += r["fallback"]
+
+    if pipeline is None:
+        pipeline = pipeline_enabled()
+    step = max_docs_per_launch or len(order) or 1
+    if pipeline and len(order) > step:
+        # double-buffered drain: chunk the WORK (not the stages) so each
+        # chunk's host staging runs while the previous chunk's packed
+        # kernel is in flight
+        pending = None
+        try:
+            for lo in range(0, len(order), step):
+                t0 = time.perf_counter()
+                stages, n = _stage_chunk(order[lo : lo + step], lo)
+                out["applied"] += n
+                d = dispatch_stages(stages, fallback_ratio)
+                if pending is not None:
+                    # everything since the loop top ran under pending's
+                    # in-flight launch — the pipeline's measurable win
+                    _prof.note("overlap_s", time.perf_counter() - t0)
+                    _account(collect_stages(pending))
+                pending = d
+        except BaseException:
+            if pending is not None:
+                p, pending = pending, None
+                collect_stages(p)
+            raise
+        if pending is not None:
+            _account(collect_stages(pending))
+    else:
+        stages, n = _stage_chunk(order, 0)
+        out["applied"] += n
+        sstep = max_docs_per_launch or len(stages) or 1
+        for lo in range(0, len(stages), sstep):
+            _account(resolve_stages(stages[lo : lo + sstep], fallback_ratio))
+    _prof.note("docs", len(order))
+    _prof.note("changes", out["applied"])
     return out
 
 
@@ -379,6 +472,14 @@ class CrossDocBatcher:
             mode
             if mode is not None
             else os.environ.get("AUTOMERGE_TPU_SERVE_BATCHED", "auto")
+        )
+        # generations at least this many docs wide flush as TWO
+        # half-launches so the second half's pack/linearize runs under
+        # the first half's in-flight kernel (the drain pipeline); small
+        # generations keep the single launch — splitting them would
+        # trade kernel occupancy for overlap that can't cover the cost
+        self.pipeline_min_docs = int(
+            _env_float("AUTOMERGE_TPU_PIPELINE_MIN_DOCS", 16)
         )
         self._cv = threading.Condition(threading.Lock())
         self._gen = _Generation()
@@ -512,7 +613,28 @@ class CrossDocBatcher:
                 _prof.note("docs", len(gen.subs))
                 _prof.note("changes", n_changes)
                 stages.extend(more)
-            resolve_stages(stages, self.fallback_ratio)
+            if (
+                pipeline_enabled()
+                and len(stages) >= self.pipeline_min_docs
+            ):
+                # wide generation: flush as two half-launches so the
+                # second half's pack/linearize runs under the first
+                # half's in-flight kernel (drain.overlap_fraction)
+                mid = len(stages) // 2
+                d1 = dispatch_stages(stages[:mid], self.fallback_ratio)
+                try:
+                    t0 = time.perf_counter()
+                    d2 = dispatch_stages(
+                        stages[mid:], self.fallback_ratio
+                    )
+                    _prof.note("overlap_s", time.perf_counter() - t0)
+                except BaseException:
+                    collect_stages(d1)
+                    raise
+                collect_stages(d1)
+                collect_stages(d2)
+            else:
+                resolve_stages(stages, self.fallback_ratio)
         except BaseException as e:  # noqa: BLE001 — degrade per doc
             obs.count("device.batched_error")
             recovered = True

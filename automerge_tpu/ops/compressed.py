@@ -60,6 +60,15 @@ def gate_ratio() -> float:
         return 0.5
 
 
+def run_gate(n_runs: int, n_rows: int) -> bool:
+    """True when a run table is degenerate enough that a column must ship
+    (and compute) dense — the same two-axis demotion rule the resident
+    bundle applies: the run count crosses ``gate_ratio()`` of the rows,
+    or the run table wouldn't even undercut the dense int32 image (one
+    (w, cum) int32 pair per run vs one int32 per row)."""
+    return n_runs > gate_ratio() * n_rows or 2 * n_runs >= n_rows
+
+
 class StrideRuns:
     """One column as arithmetic-sequence runs.
 

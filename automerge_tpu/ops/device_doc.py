@@ -18,6 +18,7 @@ materialized views / ``apply_patches`` without a host re-apply
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -239,7 +240,18 @@ class DeviceDoc:
             return sum(self.apply_changes(b) for b in batches)
         total = 0
         inflight = None
+        t_buf = 0.0  # host work start while a handle was in flight
+
+        def collect_inflight():
+            # host seconds since the loop-top while this handle's kernel
+            # was in flight are pipeline overlap — the drain's measurable
+            # double-buffering win (prof: drain.overlap_fraction)
+            _prof.note("overlap_s", time.perf_counter() - t_buf)
+            self._collect_async(inflight)
+
         for chs in batches:
+            if inflight is not None:
+                t_buf = time.perf_counter()
             ready = self._take_ready(chs)
             if not ready:
                 continue
@@ -250,7 +262,7 @@ class DeviceDoc:
                 info = None
             if info is None:
                 if inflight is not None:
-                    self._collect_async(inflight)
+                    collect_inflight()
                     inflight = None
                 obs.count("device.apply_rebuild")
                 self._rebuild(list(self.log.changes) + ready)
@@ -270,12 +282,12 @@ class DeviceDoc:
                     # CURRENT log — anything still in flight was computed
                     # from an older snapshot and must land first
                     if inflight is not None:
-                        self._collect_async(inflight)
+                        collect_inflight()
                         inflight = None
                     self._reresolve(info.dirty_objs)
                 else:
                     if inflight is not None:
-                        self._collect_async(inflight)
+                        collect_inflight()
                     inflight = handle
             total += len(ready)
         if inflight is not None:
@@ -962,10 +974,7 @@ class DeviceDoc:
         is nothing to resolve, or ``{"fallback": True}`` when the dirty
         fraction demands a synchronous full re-resolution (which the caller
         runs AFTER draining any in-flight batch)."""
-        from .merge import (
-            merge_kernel_core, scatter_geometry_ok, scatter_kernel_core,
-            stage_cols_device,
-        )
+        from .merge import prepare_resolution
         from .oplog import host_linearize, pad_columns
 
         log = self.log
@@ -980,20 +989,15 @@ class DeviceDoc:
         D = len(dirty)
         cols_np = pad_columns(self._subset_cols(rows, dirty), D)
         P = len(cols_np["action"])
-        # compressed staging: device_put moves run tables, expansion
-        # happens on device (merge.stage_cols_device)
-        cols_dev = stage_cols_device(cols_np)
-        n_props = len(log.props)
-        fn = (
-            scatter_kernel_core(D, n_props)
-            if scatter_geometry_ok(P, D, n_props)
-            else merge_kernel_core
-        )
+        # staging: run-native mode hands the kernel the run tables
+        # themselves; otherwise device_put moves run tables and the
+        # expansion dispatch runs eagerly (merge.stage_cols_device)
+        dispatch = prepare_resolution(cols_np, D, len(log.props))
         obs.count("device.kernel_launches", labels={"path": "per_doc"})
         _prof.note("launches")
         with obs.span("device.kernel", rows=P), \
                 _prof.annotate("amtpu.dispatch_async"):
-            out = fn(cols_dev)  # async dispatch
+            out = dispatch()  # async dispatch
         # element order overlaps the kernel — it needs only the columns
         with obs.span("device.linearize", rows=P):
             ei = host_linearize(cols_np)

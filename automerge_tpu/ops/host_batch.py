@@ -251,6 +251,13 @@ def stage_docs(work) -> Tuple[List, Dict[int, DocResult]]:
     ``id(device_doc)`` (applied count, error, which path ran). Documents
     failing a fast-path assumption stage through the scalar
     ``DeviceDoc.stage_ready`` — bit-identical by construction.
+
+    Each call is self-contained (dedup, the union actor table, and all
+    offset ranges are per call), which is what lets the double-buffered
+    drain (``apply_cross_doc`` with ``AUTOMERGE_TPU_DRAIN_PIPELINE``)
+    run THIS staging for chunk N+1 while chunk N's packed kernel is
+    still in flight — the host seconds spent here under a live launch
+    are the drain's ``overlap_s``.
     """
     from .batched import BatchStage
 

@@ -33,6 +33,7 @@ gathers and segmented reductions — shapes XLA maps well onto the VPU.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -824,13 +825,20 @@ def stage_cols_device(cols_np):
         h2d_bytes += W.nbytes + C.nbytes + S.nbytes
     with obs.span("device.h2d", rows=P, bytes=h2d_bytes):
         out = {k: jnp.asarray(v) for k, v in dense.items()}
-        for n, rcap, cols, W, C, S in stacks:
-            bools = tuple(b for _, _, b in cols)
-            expanded = _expander(n, rcap, bools)(
-                jnp.asarray(W), jnp.asarray(C), jnp.asarray(S)
-            )
-            for (k, _, _), col in zip(cols, expanded):
-                out[k] = col
+        dev_stacks = [
+            (n, rcap, cols, jnp.asarray(W), jnp.asarray(C), jnp.asarray(S))
+            for n, rcap, cols, W, C, S in stacks
+        ]
+    # the eager run->dense expansion dispatch is its own profiler stage
+    # (device.expand): it is the exact work the run-native kernels fuse
+    # away, so the split must show it apart from the device_put h2d
+    if dev_stacks:
+        with obs.span("device.expand", rows=P, stacks=len(dev_stacks)):
+            for n, rcap, cols, W, C, S in dev_stacks:
+                bools = tuple(b for _, _, b in cols)
+                expanded = _expander(n, rcap, bools)(W, C, S)
+                for (k, _, _), col in zip(cols, expanded):
+                    out[k] = col
     _note_h2d(h2d_bytes, dense_bytes)
     return out
 
@@ -863,6 +871,212 @@ def _expander(n, rcap, bools):
 
         fn = _EXPAND_CACHE[key] = jax.jit(f)
     return fn
+
+
+# -- run-native resolution ----------------------------------------------------
+#
+# stage_cols_device ships run tables but expands them to dense columns
+# EAGERLY (the device.expand dispatch) before the resolution kernel runs,
+# so kernel input bandwidth is dense again the moment resolution starts.
+# Run-native mode keeps the run tables as the KERNEL's input: the
+# expansion gathers (searchsorted over R run heads + stride arithmetic —
+# the StrideRuns.join trick, on device) move INSIDE the kernel jit, where
+# XLA fuses them into their consumers, so device input traffic for
+# run-eligible columns scales with run count, not history size (the
+# LSM-OPD compute-on-compressed argument, arXiv:2508.11862). Kernels are
+# specialized per column-encoding signature via control-flow duplication
+# (arXiv:2302.10098): pure-RLE stacks (every stride 0) expand as a plain
+# run gather w[j], delta+RLE stacks add the dynamic stride term
+# w[j] + s*i, and a column whose run structure degenerates past the
+# resident ratio gate (compressed.run_gate) ships dense, counted per
+# column on device.run_native_fallback{column,reason}.
+
+
+def run_native_enabled() -> bool:
+    """Whether resolution kernels consume run tables directly (default
+    on wherever compressed residency is). ``AUTOMERGE_TPU_RUN_NATIVE=0``
+    restores the eager-expansion staging; ``AUTOMERGE_TPU_COMPRESSED=0``
+    restores the fully dense differential oracle."""
+    from . import compressed as _C
+
+    return (
+        _C.enabled()
+        and os.environ.get("AUTOMERGE_TPU_RUN_NATIVE", "1") != "0"
+    )
+
+
+def stage_cols_run_native(cols_np):
+    """Run-native H2D staging: per column, slope-RLE run tables are
+    ``device_put`` padded to run-capacity buckets and STAY the kernel
+    input (no eager expansion dispatch). Returns ``(dense, stacks,
+    plan)``:
+
+    * ``dense`` — {name: device array} for pass-through columns,
+    * ``stacks`` — one tuple of device arrays per stack: ``(W, C)`` for
+      a pure-RLE stack, ``(W, C, S)`` for a delta stack,
+    * ``plan`` — static metadata, one ``(n, rcap, enc, names, bools)``
+      entry per stack (``enc``: "rle" | "delta"), the specialization
+      key ``run_native_kernel`` compiles against.
+
+    Bytes staged here are exactly the resolution kernel's input; they
+    ride the ``device.kernel_input_bytes`` counter next to their dense
+    equivalent so the input-bandwidth win is a ratio, not a guess.
+    """
+    from . import compressed as _C
+
+    cols_np = {k: np.asarray(v) for k, v in cols_np.items()}
+    P = len(cols_np["action"])
+    dense_bytes = sum(v.nbytes for v in cols_np.values())
+    dense = {}
+    groups = {}  # (length, enc class) -> [(name, (w, cum, slope), is_bool)]
+    h2d_bytes = 0
+    for k, v in cols_np.items():
+        n = len(v)
+        enc = None
+        reason = None
+        if n < 32:
+            reason = "short"
+        elif v.dtype not in (np.int32, np.bool_):
+            reason = "dtype"
+        else:
+            enc = _slope_rle(v if v.dtype == np.int32 else v.astype(np.int32))
+            if enc is not None and _C.run_gate(len(enc[0]), n):
+                enc = None
+            if enc is None:
+                reason = "ratio"
+                obs.count("oplog.compress_fallback",
+                          labels={"column": k, "reason": "h2d"})
+        if enc is None:
+            obs.count("device.run_native_fallback",
+                      labels={"column": k, "reason": reason})
+            dense[k] = v
+            h2d_bytes += v.nbytes
+        else:
+            cls = "rle" if enc[2] == 0 else "delta"
+            groups.setdefault((n, cls), []).append(
+                (k, enc, v.dtype == np.bool_)
+            )
+    plan = []
+    host_stacks = []
+    for (n, cls), cols in sorted(groups.items(), key=lambda kv: kv[0]):
+        rcap = _capacity(max(len(w) for _, (w, _, _), _ in cols), 16)
+        K = len(cols)
+        W = np.zeros((K, rcap), np.int32)
+        C = np.full((K, rcap), np.int32(n), np.int32)
+        S = np.empty(K, np.int32)
+        for idx, (_, (w, cum, s), _) in enumerate(cols):
+            W[idx, : len(w)] = w
+            C[idx, : len(cum)] = cum
+            S[idx] = s
+        plan.append((
+            n, rcap, cls,
+            tuple(k for k, _, _ in cols),
+            tuple(b for _, _, b in cols),
+        ))
+        arrs = (W, C) if cls == "rle" else (W, C, S)
+        host_stacks.append(arrs)
+        h2d_bytes += sum(a.nbytes for a in arrs)
+    with obs.span("device.h2d", rows=P, bytes=h2d_bytes):
+        dense_dev = {k: jnp.asarray(v) for k, v in dense.items()}
+        stacks = tuple(
+            tuple(jnp.asarray(a) for a in arrs) for arrs in host_stacks
+        )
+    _note_h2d(h2d_bytes, dense_bytes)
+    obs.count("device.kernel_input_bytes", n=h2d_bytes)
+    obs.count("device.kernel_input_dense_bytes", n=dense_bytes)
+    return dense_dev, stacks, tuple(plan)
+
+
+_RUN_NATIVE_CACHE = {}
+
+
+def run_native_kernel(plan, geom):
+    """The jit'd run-native resolution kernel for one encoding plan.
+
+    ``geom`` selects the resolution body: ``("core",)`` = the sort-based
+    merge_kernel_core, ``("scatter", n_objs, n_props)`` = the
+    geometry-specialized scatter-max winner kernel, ``("full",)`` =
+    merge_kernel with on-device linearization. One compiled variant
+    exists per (plan, geom) — the control-flow-duplication axis: every
+    distinct per-column encoding signature compiles its own kernel whose
+    in-jit expansion is specialized to the encoding class (pure-RLE:
+    ``w[j]``; delta+RLE: ``w[j] + s*i`` with dynamic slopes), and XLA
+    fuses those gathers into the resolution consumers."""
+    key = (plan, geom)
+    fn = _RUN_NATIVE_CACHE.get(key)
+    if fn is None:
+        if geom[0] == "scatter":
+            core = scatter_kernel_core(geom[1], geom[2])
+        elif geom[0] == "full":
+            core = merge_kernel
+        else:
+            core = merge_kernel_core
+
+        def f(dense, stacks):
+            c = dict(dense)
+            for (n, rcap, cls, names, bools), arrs in zip(plan, stacks):
+                i = jnp.arange(n, dtype=jnp.int32)
+
+                def gather(w, cum, _i=i, _rcap=rcap):
+                    j = jnp.clip(
+                        jnp.searchsorted(cum, _i, side="right"), 0, _rcap - 1
+                    ).astype(jnp.int32)
+                    return w[j]
+
+                if cls == "rle":
+                    colv = jax.vmap(gather)(arrs[0], arrs[1])
+                else:
+                    colv = jax.vmap(
+                        lambda w, cum, s, _g=gather, _i=i: _g(w, cum) + s * _i
+                    )(arrs[0], arrs[1], arrs[2])
+                for idx, (name, b) in enumerate(zip(names, bools)):
+                    c[name] = colv[idx].astype(jnp.bool_) if b else colv[idx]
+            return core(c)
+
+        fn = _RUN_NATIVE_CACHE[key] = jax.jit(f)
+    return fn
+
+
+def prepare_resolution(cols_np, n_objs=None, n_props=None, full=False):
+    """Stage bucket-padded dict columns for one resolution launch and
+    return a zero-arg dispatch closure (callers wrap the call in their
+    own ``device.kernel`` span / trace annotation — staging spans
+    ``device.h2d``/``device.expand`` land here, before it).
+
+    Chooses the run-native staging (run tables stay the kernel input,
+    counted as a ``path=run_native`` launch) when enabled and at least
+    one column run-encodes, the eager-expansion staging otherwise. The
+    kernel body is the scatter-max winner kernel when the geometry gate
+    allows, the sort-based core otherwise; ``full=True`` pins the
+    everything-on-device merge_kernel (on-chip linearization)."""
+    P = len(cols_np["action"])
+    if full:
+        geom = ("full",)
+    elif (
+        n_objs is not None
+        and n_props is not None
+        and scatter_geometry_ok(P, n_objs, n_props)
+    ):
+        geom = ("scatter", n_objs, n_props)
+    else:
+        geom = ("core",)
+    if run_native_enabled():
+        dense, stacks, plan = stage_cols_run_native(cols_np)
+        if plan:
+            obs.count("device.kernel_launches",
+                      labels={"path": "run_native"})
+            fn = run_native_kernel(plan, geom)
+            return lambda: fn(dense, stacks)
+        cols_dev = dense  # nothing run-eligible: plain dense launch
+    else:
+        cols_dev = stage_cols_device(cols_np)
+    if geom[0] == "scatter":
+        core = scatter_kernel_core(geom[1], geom[2])
+    elif geom[0] == "full":
+        core = merge_kernel
+    else:
+        core = merge_kernel_core
+    return lambda: core(cols_dev)
 
 
 def encode_transport(cols) -> tuple:
@@ -1119,8 +1333,6 @@ def merge_columns(cols_np, linearize: str = "auto", fetch=None, n_objs=None,
     conflicted flag (consumers compare ``> 1``), not the exact
     visible-op count the dict path returns.
     """
-    import os
-
     from .. import native
 
     # pure-linearization calls never need a device at all (element order is
@@ -1210,7 +1422,6 @@ def merge_columns(cols_np, linearize: str = "auto", fetch=None, n_objs=None,
             n_props,
         )
 
-    cols = stage_cols_device(cols_np)
     if linearize == "auto":
         linearize = "native" if native.preorder_available() else "device"
     need = set(fetch) if fetch is not None else set(ALL_OUTPUTS)
@@ -1227,20 +1438,17 @@ def merge_columns(cols_np, linearize: str = "auto", fetch=None, n_objs=None,
 
     if linearize == "native":
         P = len(cols_np["action"])
+        # staging (run-native or eager-expand) happens here, outside the
+        # kernel span; the closure dispatches the specialized kernel
+        dispatch = prepare_resolution(cols_np, n_objs, n_props)
         with obs.span("device.kernel", rows=P):
-            if (
-                n_objs is not None
-                and n_props is not None
-                and scatter_geometry_ok(P, n_objs, n_props)
-            ):
-                out = scatter_kernel_core(n_objs, n_props)(cols)
-            else:
-                out = merge_kernel_core(cols)
+            out = dispatch()
         host = pull(out, need - {"elem_index"})
         if "elem_index" in need:
             # ranked from the host-resident columns — zero device traffic
             host["elem_index"] = host_linearize(cols_np)
         return host
+    dispatch = prepare_resolution(cols_np, full=True)
     with obs.span("device.kernel", rows=len(cols_np["action"])):
-        out = merge_kernel(cols)
+        out = dispatch()
     return pull(out, need)

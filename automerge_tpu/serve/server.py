@@ -189,7 +189,10 @@ class SocketRpcServer:
         # threshold is capped at the POOL SIZE: at most `workers` docs can
         # ever be draining at once, so a full complement of submitters
         # wakes the flush leader immediately instead of every drain
-        # sleeping out the whole batch window
+        # sleeping out the whole batch window. Generations at least
+        # AUTOMERGE_TPU_PIPELINE_MIN_DOCS wide flush as two overlapped
+        # half-launches (the drain pipeline; see batched.CrossDocBatcher)
+        # — submitters still block until their half is collected
         n_workers = len(self.pool.workers)
         self.batcher = CrossDocBatcher(
             max_docs=min(

@@ -1062,6 +1062,17 @@ def main():
             def sb_launches():
                 return obs.counter_values("device.kernel_launches", "path")
 
+            def sb_input_bytes():
+                """(kernel input bytes, dense-equivalent bytes) — the
+                run-native staging's counters; input = what device_put
+                actually moved and the expand+resolve jit consumed."""
+                return (
+                    obs.counter_values(
+                        "device.kernel_input_bytes", "").get("", 0),
+                    obs.counter_values(
+                        "device.kernel_input_dense_bytes", "").get("", 0),
+                )
+
             def sb_workload(tag):
                 """Per doc: (base changes, [delta per cycle]) — one
                 editing replica typing into the live object each cycle."""
@@ -1095,15 +1106,19 @@ def main():
                     wl.append((chs, cycles))
                 return wl
 
-            def sb_run(wl, max_per_launch, reports=None):
+            def sb_run(wl, max_per_launch, reports=None, pipeline=None):
                 """``reports`` (a list, if given) collects one profiler
                 cycle report per drain cycle — the observatory's
-                attribution for exactly these drains."""
+                attribution for exactly these drains. ``pipeline``
+                forces the drain pipeline on/off (None = env default);
+                the per-doc baseline runs with it off so its timing
+                keeps the serial per-doc-launch semantics."""
                 devs = [
                     DeviceDoc.resolve(OpLog.from_changes(chs))
                     for chs, _ in wl
                 ]
                 l0 = sb_launches()
+                b0 = sb_input_bytes()
                 t0 = time.perf_counter()
                 for c in range(sb_cycles):
                     with prof.cycle(kind="bench_drain") as cyc:
@@ -1111,41 +1126,57 @@ def main():
                             [(devs[i], [wl[i][1][c]])
                              for i in range(sb_docs)],
                             max_docs_per_launch=max_per_launch,
+                            pipeline=pipeline,
                         )
                     if reports is not None and cyc.report is not None:
                         reports.append(cyc.report)
                 dt = time.perf_counter() - t0
                 l1 = sb_launches()
+                b1 = sb_input_bytes()
                 dl = {
                     k: l1.get(k, 0) - l0.get(k, 0)
                     for k in set(l0) | set(l1)
                     if l1.get(k, 0) != l0.get(k, 0)
                 }
-                return devs, dt, dl
+                bts = (b1[0] - b0[0], b1[1] - b0[1])
+                return devs, dt, dl, bts
 
             wl = sb_workload(0)
             delta_ops = sum(
                 len(c.ops) for _, cycles in wl for b in cycles for c in b
             )
-            # warm both mode shapes (jit compile per capacity bucket)
-            sb_run(sb_workload(1), 1)
+            sb_half = max(sb_docs // 2, 1)
+            # warm all three mode shapes (jit compile per capacity bucket)
+            sb_run(sb_workload(1), 1, pipeline=False)
             sb_run(sb_workload(1), None)
-            t_per = t_bat = float("inf")
+            sb_run(sb_workload(1), sb_half, pipeline=True)
+            t_per = t_bat = t_pipe = float("inf")
             cycle_reports = []
+            pipe_reports = []
+            rn_bytes = (0, 0)
             for _ in range(max(reps, 1)):
-                devs_p, dt_p, l_per = sb_run(wl, 1)
-                devs_b, dt_b, l_bat = sb_run(
+                devs_p, dt_p, l_per, _ = sb_run(wl, 1, pipeline=False)
+                devs_b, dt_b, l_bat, bts = sb_run(
                     wl, None, reports=cycle_reports
+                )
+                # pipelined mode: two half-drain launches per cycle so
+                # chunk 2's host staging runs under chunk 1's kernel
+                devs_pl, dt_pl, l_pipe, _ = sb_run(
+                    wl, sb_half, reports=pipe_reports, pipeline=True
                 )
                 t_per = min(t_per, dt_p)
                 t_bat = min(t_bat, dt_b)
+                t_pipe = min(t_pipe, dt_pl)
+                rn_bytes = (rn_bytes[0] + bts[0], rn_bytes[1] + bts[1])
             # the observatory's view of the batched drains: >=90% of the
             # measured drain wall clock attributed to named stages, with
             # the host/device split and the pack-site occupancy figure
             cycle_report = prof.summarize_reports(cycle_reports)
-            # both modes must materialize identical documents
+            pipe_report = prof.summarize_reports(pipe_reports)
+            # all modes must materialize identical documents
             for i in (0, sb_docs // 2, sb_docs - 1):
                 assert devs_p[i].hydrate() == devs_b[i].hydrate(), i
+                assert devs_pl[i].hydrate() == devs_b[i].hydrate(), i
             sb_cfg = {
                 "docs": sb_docs,
                 "cycles": sb_cycles,
@@ -1167,8 +1198,34 @@ def main():
                 "uplift_vs_per_doc": round(t_per / t_bat, 2),
                 "occupancy": cycle_report["occupancy"],
                 "cycle_report": cycle_report,
+                # run-native staging: what the batched drains actually
+                # shipped to (and computed on) the device vs the dense
+                # image those rows would have been
+                "run_native": {
+                    "kernel_input_bytes": int(rn_bytes[0]),
+                    "kernel_input_dense_bytes": int(rn_bytes[1]),
+                    "input_compress_ratio": round(
+                        rn_bytes[1] / rn_bytes[0], 2
+                    ) if rn_bytes[0] else 0.0,
+                },
+                # the double-buffered drain: two half-launches per
+                # cycle, second half's host staging under the first
+                # half's in-flight kernel
+                "pipeline": {
+                    "seconds": round(t_pipe, 4),
+                    "ops_per_sec": round(delta_ops / t_pipe, 1),
+                    "launches_per_drain": round(
+                        l_pipe.get("batched", 0) / sb_cycles, 2
+                    ),
+                    "overlap_s": pipe_report.get("overlap_s", 0.0),
+                    "overlap_fraction": pipe_report.get(
+                        "overlap_fraction", 0.0
+                    ),
+                    "uplift_vs_per_doc": round(t_per / t_pipe, 2),
+                    "vs_single_launch": round(t_bat / t_pipe, 2),
+                },
             }
-            del devs_p, devs_b, wl
+            del devs_p, devs_b, devs_pl, wl
     except Exception as e:  # noqa: BLE001 — degrade, record, continue
         import traceback
 
@@ -2104,6 +2161,18 @@ def main():
         "kernel_launches": obs.counter_values(
             "device.kernel_launches", "path"
         ),
+        # run-native demotions over the whole run: which columns shipped
+        # dense anyway and why (ratio = run table degenerate past the
+        # gate, dtype = not int32/bool, short = below the run-encode
+        # floor) — the per-column view of the ratio-gate dense fallback
+        "run_native_fallback": {
+            "by_reason": obs.counter_values(
+                "device.run_native_fallback", "reason"
+            ),
+            "by_column": obs.counter_values(
+                "device.run_native_fallback", "column"
+            ),
+        },
         # pack-site occupancy across every batched launch of the run:
         # useful rows / (useful + padded) from the device.batch_rows /
         # device.batch_padding_rows counters (None = nothing packed)

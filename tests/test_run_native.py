@@ -1,0 +1,228 @@
+"""Differential suite for run-native resolution kernels (ops/merge.py)
+and the double-buffered drain pipeline (ops/batched.py).
+
+The fully dense path (``AUTOMERGE_TPU_COMPRESSED=0``) is the oracle:
+the same multi-seed workloads resolved through run-native kernels (run
+tables as the kernel's input, expansion gathers fused in-jit) and
+through the eager-expansion staging (``AUTOMERGE_TPU_RUN_NATIVE=0``)
+must leave every document bit-identical — column-level OpLog equality,
+full DeviceDoc state, identical ``at(heads)`` views — across the
+stage_docs + packed-launch path, the per-doc async dispatch, the
+pipelined (double-buffered) drain with out-of-order/duplicate delivery,
+and ratio-gate-demoted mixed encodings. Plus staging-level properties:
+the run-table expansion decodes exactly, degenerate columns demote
+dense through ``compressed.run_gate`` with per-column fallback
+counters, and kernel input bytes genuinely undercut the dense image.
+"""
+
+import numpy as np
+import pytest
+
+from automerge_tpu import obs
+from automerge_tpu.ops import host_batch, merge
+from automerge_tpu.ops.batched import apply_cross_doc, resolve_stages
+from automerge_tpu.ops.device_doc import DeviceDoc
+from automerge_tpu.ops.oplog import OpLog
+
+from .test_host_batch import assert_identical, build_workload
+
+
+def _drive_staged(docs, deltas, cycles):
+    """The stage_docs + shared packed launch path (the serve drain)."""
+    devs = [DeviceDoc.resolve(OpLog.from_documents([d])) for d in docs]
+    for c in range(cycles):
+        stages, results = host_batch.stage_docs(
+            [(devs[i], [deltas[i][c]]) for i in range(len(docs))]
+        )
+        for r in results.values():
+            assert r.error is None, repr(r.error)
+        if stages:
+            resolve_stages(stages)
+    return devs
+
+
+def _drive_pipelined(docs, deltas, cycles, step):
+    """The double-buffered drain: chunked apply_cross_doc with chunk
+    N+1's host staging under chunk N's in-flight packed kernel."""
+    devs = [DeviceDoc.resolve(OpLog.from_documents([d])) for d in docs]
+    for c in range(cycles):
+        apply_cross_doc(
+            [(devs[i], [deltas[i][c]]) for i in range(len(docs))],
+            max_docs_per_launch=step,
+            pipeline=True,
+        )
+    return devs
+
+
+def _check_same(got, oracle, docs):
+    for i in range(len(docs)):
+        assert_identical(got[i], oracle[i], i)
+        heads = got[i].current_heads()
+        assert got[i].at(heads).hydrate() == oracle[i].at(heads).hydrate()
+        assert got[i].at([]).hydrate() == oracle[i].at([]).hydrate()
+
+
+# -- end-to-end differential: run-native vs eager-expand vs dense ------------
+
+
+@pytest.mark.parametrize("seed", [2, 17, 40])
+def test_differential_staged_launches(monkeypatch, seed):
+    docs, deltas = build_workload(seed, n_docs=4, cycles=4)
+    monkeypatch.setenv("AUTOMERGE_TPU_COMPRESSED", "1")
+    monkeypatch.setenv("AUTOMERGE_TPU_RUN_NATIVE", "1")
+    rn0 = obs.counter_values("device.kernel_launches", "path").get(
+        "run_native", 0)
+    native = _drive_staged(docs, deltas, 4)
+    rn1 = obs.counter_values("device.kernel_launches", "path").get(
+        "run_native", 0)
+    monkeypatch.setenv("AUTOMERGE_TPU_RUN_NATIVE", "0")
+    eager = _drive_staged(docs, deltas, 4)
+    monkeypatch.setenv("AUTOMERGE_TPU_COMPRESSED", "0")
+    dense = _drive_staged(docs, deltas, 4)
+    _check_same(native, dense, docs)
+    _check_same(eager, dense, docs)
+    # non-vacuous: the run-native dispatch path actually launched
+    assert rn1 > rn0
+
+
+@pytest.mark.parametrize("seed", [7, 29])
+def test_differential_per_doc_async_dispatch(monkeypatch, seed):
+    # the per-doc apply_batches path (DeviceDoc._dispatch_async →
+    # prepare_resolution), including its in-flight double buffering
+    docs, deltas = build_workload(seed, n_docs=2, cycles=4, dup=True)
+
+    def run():
+        devs = [DeviceDoc.resolve(OpLog.from_documents([d])) for d in docs]
+        for i, dv in enumerate(devs):
+            dv.apply_batches([deltas[i][c] for c in range(4)])
+        return devs
+
+    monkeypatch.setenv("AUTOMERGE_TPU_COMPRESSED", "1")
+    monkeypatch.setenv("AUTOMERGE_TPU_RUN_NATIVE", "1")
+    native = run()
+    monkeypatch.setenv("AUTOMERGE_TPU_COMPRESSED", "0")
+    dense = run()
+    _check_same(native, dense, docs)
+
+
+@pytest.mark.parametrize("seed", [5, 33])
+def test_differential_pipelined_drain(monkeypatch, seed):
+    # out-of-order + duplicate delivery through the double-buffered
+    # chunked drain (2-doc chunks → dispatch/stage/collect interleave)
+    docs, deltas = build_workload(seed, n_docs=5, cycles=4, dup=True,
+                                  shuffle=True)
+    monkeypatch.setenv("AUTOMERGE_TPU_COMPRESSED", "1")
+    monkeypatch.setenv("AUTOMERGE_TPU_RUN_NATIVE", "1")
+    piped = _drive_pipelined(docs, deltas, 4, step=2)
+    monkeypatch.setenv("AUTOMERGE_TPU_COMPRESSED", "0")
+    dense = _drive_pipelined(docs, deltas, 4, step=2)
+    monkeypatch.setenv("AUTOMERGE_TPU_COMPRESSED", "1")
+    serial = _drive_staged(docs, deltas, 4)
+    _check_same(piped, dense, docs)
+    _check_same(serial, dense, docs)
+
+
+def test_differential_gate_demoted_mixed_encodings(monkeypatch):
+    # high-entropy edits (many tiny objects, scattered splice points)
+    # drive some columns past the run gate: a MIX of run-native stacks
+    # and dense-demoted columns in one launch must still match the
+    # oracle, and the demotions must be counted per column
+    docs, deltas = build_workload(13, n_docs=3, cycles=4, shuffle=True)
+    monkeypatch.setenv("AUTOMERGE_TPU_COMPRESSED", "1")
+    monkeypatch.setenv("AUTOMERGE_TPU_RUN_NATIVE", "1")
+    fb0 = sum(obs.counter_values(
+        "device.run_native_fallback", "reason").values())
+    native = _drive_staged(docs, deltas, 4)
+    fb1 = sum(obs.counter_values(
+        "device.run_native_fallback", "reason").values())
+    monkeypatch.setenv("AUTOMERGE_TPU_COMPRESSED", "0")
+    dense = _drive_staged(docs, deltas, 4)
+    _check_same(native, dense, docs)
+    assert fb1 > fb0  # some column really did demote dense
+
+
+# -- staging-level properties -------------------------------------------------
+
+
+def _expand_plan(dense, stacks, plan, to_np=np.asarray):
+    """Host-side oracle for the in-jit expansion: w[j] (+ s*i)."""
+    out = {k: to_np(v) for k, v in dense.items()}
+    for (n, rcap, cls, names, bools), arrs in zip(plan, stacks):
+        i = np.arange(n)
+        for idx, name in enumerate(names):
+            w = to_np(arrs[0][idx])
+            cum = to_np(arrs[1][idx])
+            j = np.clip(np.searchsorted(cum, i, side="right"), 0, rcap - 1)
+            col = w[j]
+            if cls == "delta":
+                col = col + int(to_np(arrs[2][idx])) * i
+            out[name] = col.astype(bool) if bools[idx] else col
+    return out
+
+
+def test_staging_expansion_decodes_exactly(monkeypatch):
+    monkeypatch.setenv("AUTOMERGE_TPU_COMPRESSED", "1")
+    rng = np.random.default_rng(3)
+    n = 256
+    cols = {
+        "action": np.zeros(n, np.int32),                       # 1 run
+        "obj": np.repeat(np.arange(8, dtype=np.int32), 32),    # RLE
+        "elem_ref": (np.arange(n) - 1).astype(np.int32),       # delta
+        "insert": np.ones(n, bool),                            # bool RLE
+        "noise": rng.integers(0, 1 << 20, n).astype(np.int32),  # dense
+    }
+    dense, stacks, plan = merge.stage_cols_run_native(cols)
+    assert plan, "nothing run-encoded"
+    assert "noise" in dense  # past the gate → shipped dense
+    got = _expand_plan(dense, stacks, plan)
+    for k, v in cols.items():
+        assert np.array_equal(got[k], v), k
+    # input bytes genuinely undercut the dense image for this shape
+    run_bytes = sum(
+        a.nbytes for arrs in stacks for a in arrs
+    ) + sum(v.nbytes for v in dense.values())
+    assert run_bytes * 2 < sum(
+        np.asarray(v).nbytes for v in cols.values())
+
+
+def test_degenerate_columns_demote_with_reasons(monkeypatch):
+    monkeypatch.setenv("AUTOMERGE_TPU_COMPRESSED", "1")
+    rng = np.random.default_rng(9)
+    n = 128
+    cols = {
+        "action": rng.integers(0, 1 << 20, n).astype(np.int32),  # ratio
+        "wide": np.arange(n, dtype=np.int64),                    # dtype
+    }
+    def fallbacks():
+        # exact (column, reason) series — counter_values collapses
+        # multi-label families last-wins, so read the snapshot
+        return {
+            (e["labels"].get("column"), e["labels"].get("reason")):
+                e["value"]
+            for e in obs.snapshot()
+            if e["type"] == "counter"
+            and e["name"] == "device.run_native_fallback"
+        }
+
+    before = fallbacks()
+    dense, stacks, plan = merge.stage_cols_run_native(cols)
+    after = fallbacks()
+    assert not plan and set(dense) == {"action", "wide"}
+    assert after.get(("action", "ratio"), 0) == \
+        before.get(("action", "ratio"), 0) + 1
+    assert after.get(("wide", "dtype"), 0) == \
+        before.get(("wide", "dtype"), 0) + 1
+    # short columns never run-encode (run table would not pay for itself)
+    _, _, plan2 = merge.stage_cols_run_native(
+        {"action": np.zeros(8, np.int32)})
+    assert not plan2
+
+
+def test_run_native_disabled_restores_eager_staging(monkeypatch):
+    monkeypatch.setenv("AUTOMERGE_TPU_COMPRESSED", "1")
+    monkeypatch.setenv("AUTOMERGE_TPU_RUN_NATIVE", "0")
+    assert not merge.run_native_enabled()
+    monkeypatch.setenv("AUTOMERGE_TPU_RUN_NATIVE", "1")
+    assert merge.run_native_enabled()
+    monkeypatch.setenv("AUTOMERGE_TPU_COMPRESSED", "0")
+    assert not merge.run_native_enabled()  # dense oracle wins outright
