@@ -8,6 +8,11 @@ direction,
 * **drop** a read chunk (seeded probability — on a line-framed protocol
   this garbles at most the frames the chunk covered; both the router and
   the server tolerate garbled lines, and the retry layer owns the rest),
+* **corrupt** a read chunk (seeded probability): one byte of the chunk
+  is bit-flipped before forwarding — a payload that still parses as a
+  frame carries silently-wrong bytes, the wire analogue of disk bit rot
+  (a garbled frame is rejected at the framing layer; a *valid* frame
+  with corrupt content is what the integrity digests exist to catch),
 * **delay** every chunk by a fixed latency,
 * **throttle** to a byte rate,
 * **reorder** a chunk behind its successor (seeded probability),
@@ -61,16 +66,18 @@ class LinkPolicy:
     schedule flips them live); reads are lock-free snapshots of floats
     and bools, which Python assigns atomically."""
 
-    __slots__ = ("drop", "reorder", "delay_s", "throttle_bps", "blackhole")
+    __slots__ = ("drop", "reorder", "delay_s", "throttle_bps", "blackhole",
+                 "corrupt")
 
     def __init__(self, drop: float = 0.0, reorder: float = 0.0,
                  delay_s: float = 0.0, throttle_bps: float = 0.0,
-                 blackhole: bool = False):
+                 blackhole: bool = False, corrupt: float = 0.0):
         self.drop = drop
         self.reorder = reorder
         self.delay_s = delay_s
         self.throttle_bps = throttle_bps
         self.blackhole = blackhole
+        self.corrupt = corrupt
 
 
 class _Pipe:
@@ -119,6 +126,10 @@ class _Pipe:
         if pol.drop and self.rng.random() < pol.drop:
             _count("drop")
             return True
+        if pol.corrupt and self.rng.random() < pol.corrupt:
+            _count("corrupt")
+            i = self.rng.randrange(len(chunk))
+            chunk = chunk[:i] + bytes([chunk[i] ^ 0x40]) + chunk[i + 1:]
         if pol.delay_s:
             _count("delay")
             time.sleep(pol.delay_s)

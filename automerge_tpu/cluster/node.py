@@ -52,7 +52,9 @@ from .replication import (
 # which keeps follower states totally ordered for promotion
 REPL_SHARD_KEY = "__replication__"
 
-_REPL_METHODS = frozenset({"replApply", "replSnapshot", "migrateIn"})
+_REPL_METHODS = frozenset(
+    {"replApply", "replSnapshot", "replReset", "migrateIn"}
+)
 
 # what a follower will answer; everything else is NotLeader. The
 # durable-recovery and chaos-injection surfaces are follower-ok: a
@@ -68,6 +70,9 @@ _FOLLOWER_OK = frozenset({
     # residency is node-local: a follower's store demotes and hydrates
     # its replica copies independently of the leader's tiers
     "storeStatus", "storeDemote",
+    # integrity surface: the leader's anti-entropy scrub probes follower
+    # digests, resets diverged replicas, and CI forces follower rounds
+    "docDigest", "replReset", "scrubNow",
 })
 
 
@@ -111,6 +116,7 @@ class ClusterRpcServer(RpcServer):
     METHODS = RpcServer.METHODS | frozenset({
         "clusterStatus", "clusterPromote", "clusterReplicateTo",
         "replApply", "replSnapshot", "replPing", "replHarvest",
+        "replReset",
         "migrateOut", "migrateTail", "migrateIn", "migrateRelease",
     })
 
@@ -184,6 +190,12 @@ class ClusterRpcServer(RpcServer):
                 info["cursor"] = {"stream": stream, "lsn": lsn}
             if self.hub is not None:
                 info["lsn"] = self.hub.lsn(name)
+            try:
+                dg = doc.doc_digest()
+                info["digest"] = dg["digest"]
+                info["digestChanges"] = dg["changes"]
+            except Exception:  # noqa: BLE001 — racing close/demote
+                pass
             docs[name] = info
         out = {
             "nodeId": self.node_id,
@@ -265,6 +277,66 @@ class ClusterRpcServer(RpcServer):
         with doc.lock:
             data = doc._core.save()
         return {"snapshot": base64.b64encode(data).decode("ascii")}
+
+    # -- integrity surface (anti-entropy scrub, integrity.py) ----------------
+
+    def docDigest(self, p):
+        """Base digest plus replication coordinates, so the leader's
+        anti-entropy exchange can compare digests only when both sides
+        sit at the same ``(stream, lsn)`` — never against a lagging or
+        mid-apply replica."""
+        out = super().docDigest(p)
+        name = p.get("name")
+        if name is None:
+            return out
+        if self.hub is not None:
+            out["stream"] = self.hub.stream_id
+            out["lsn"] = self.hub.lsn(name)
+            return out
+        # follower: digest and cursor must describe one instant — a
+        # shipped batch landing between the two reads would pair a fresh
+        # digest with a stale LSN and false-positive the leader's scrub
+        with self._lock:
+            h = self._durable_names.get(name)
+            doc = self._docs.get(h) if h is not None else None
+        if (
+            doc is not None
+            and hasattr(doc, "journal")
+            and not getattr(doc, "_closed", False)
+        ):
+            with doc.lock:
+                out.update(doc.doc_digest())
+                cur = doc.replication_cursor
+            if cur is not None:
+                stream, lsn = decode_cursor(cur)
+                out["stream"] = stream
+                out["lsn"] = lsn
+        return out
+
+    def replReset(self, p):
+        """Wipe and rebuild one replica document from a leader snapshot
+        — the anti-entropy repair for a diverged copy. A catch-up
+        snapshot alone cannot heal a replica holding EXTRA changes (CRDT
+        merge is a union, it only ever adds), so the on-disk state is
+        deleted and the doc re-opened empty before the leader's save is
+        applied with its pinned cursor. The handle survives (same
+        aliasing as ``durableReopen``); the leader's ship loop recovers
+        from the cursor jump via its normal snapshot-resync fallback."""
+        name = p["name"]
+        res = self.durableReopen({"name": name, "wipe": True})
+        h = res["doc"]
+        doc = self._ensure_resident(h)
+        if doc is None:
+            doc = self._docs[h]
+        doc.apply_replicated_snapshot(
+            base64.b64decode(p["snapshot"]), base64.b64decode(p["cursor"]))
+        obs.count("cluster.repl_resets")
+        out = {"reset": True, "lsn": int(p.get("lsn", 0))}
+        try:
+            out["digest"] = doc.doc_digest()["digest"]
+        except Exception:  # noqa: BLE001 — digest echo is best-effort
+            pass
+        return out
 
     # -- role transitions ----------------------------------------------------
 

@@ -406,7 +406,8 @@ class ReplicationHub:
             while True:
                 n = sum(
                     1 for link in self._links.values()
-                    if link.durable_lsn.get(name, 0) >= target
+                    if not link.quarantined
+                    and link.durable_lsn.get(name, 0) >= target
                 )
                 if n >= self.ack_replicas:
                     return
@@ -505,6 +506,48 @@ class ReplicationHub:
                 for addr, link in self._links.items()
             }
 
+    def follower_addrs(self) -> List[str]:
+        """Addresses of followers currently trusted for quorum (the
+        anti-entropy scrub probes exactly this set)."""
+        with self._lock:
+            return [
+                addr for addr, link in self._links.items()
+                if not link.quarantined
+            ]
+
+    def quarantined_addrs(self) -> List[str]:
+        with self._lock:
+            return [
+                addr for addr, link in self._links.items()
+                if link.quarantined
+            ]
+
+    def quarantine(self, addr: str) -> bool:
+        """Drop a follower from the ack-gate quorum without detaching it.
+
+        The scrub loop calls this when a replica diverges AGAIN after a
+        repair — a disk or host that corrupts twice cannot be trusted to
+        hold acked writes, so its confirmations stop counting toward
+        ``ack_replicas``. Shipping continues (the replica may still
+        recover and serve reads); only its vote is revoked. Returns False
+        for unknown addresses."""
+        with self._lock:
+            link = self._links.get(addr)
+            if link is None:
+                return False
+            already = link.quarantined
+            link.quarantined = True
+            n = sum(1 for l in self._links.values() if l.quarantined)
+        if not already:
+            obs.count("cluster.quarantine", labels={"follower": addr})
+            obs.event("cluster.quarantine", follower=addr)
+        obs.gauge_set("cluster.quarantined", n)
+        # the quorum just shrank: wake ack waiters so they re-count
+        # against the reduced set instead of sleeping out their deadline
+        with self._acked:
+            self._acked.notify_all()
+        return True
+
     def close(self) -> None:
         with self._lock:
             self._closed = True
@@ -535,6 +578,7 @@ class _FollowerLink:
         self.hub = hub
         self.addr = addr
         self.durable_lsn: Dict[str, int] = {}  # follower's durable cursor
+        self.quarantined = False  # vote revoked (integrity divergence)
         self._sent_lsn: Dict[str, int] = {}
         self._needs_snapshot: Dict[str, bool] = {}
         self._wake = threading.Event()

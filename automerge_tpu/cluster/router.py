@@ -431,7 +431,9 @@ class ClusterRouter:
         # is (re)computed AFTER the wait — the whole point of waiting is
         # that the answer may change
         name = None
-        if method == "openDurable":
+        if method == "openDurable" or (
+            method == "docDigest" and isinstance(params.get("name"), str)
+        ):
             name = params.get("name")
             if not isinstance(name, str):
                 raise _RouteError("ValueError", "openDurable requires name")

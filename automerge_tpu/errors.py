@@ -72,6 +72,16 @@ class InvalidIndex(AutomergeError):
     (reference: error.rs InvalidIndex)."""
 
 
+class IntegrityError(AutomergeError):
+    """Stored or replicated state failed integrity verification — a
+    digest mismatch, a corrupt snapshot chunk, or a journal record whose
+    checksum no longer matches its bytes. Never retriable: retrying the
+    same read returns the same corrupt bytes; repair (scrub self-heal,
+    peer re-fetch, or salvage) has to happen first."""
+
+    retriable = False
+
+
 # parse-layer errors are defined with their codecs and resolved lazily so
 # importing this module never pulls the whole package; the static name map
 # keeps __getattr__ inert for every other lookup (dunder probes during
@@ -104,6 +114,7 @@ __all__ = [
     "ColumnLayoutError",
     "DuplicateSeqNumber",
     "ExtractError",
+    "IntegrityError",
     "InvalidActorId",
     "InvalidHash",
     "InvalidIndex",

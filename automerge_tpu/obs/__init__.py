@@ -191,7 +191,8 @@ def gauge_remove(name: str, labels: Optional[dict] = None) -> bool:
 # documents instead of filling with dead ones (past the cap, new docs
 # would collapse into {overflow=true} — exactly the admission signal
 # the tiered store cannot afford to lose)
-DOC_GAUGES = ("doc.journal_bytes", "doc.last_access_seconds")
+DOC_GAUGES = ("doc.journal_bytes", "doc.last_access_seconds",
+              "doc.digest_changes")
 DEVICE_DOC_GAUGES = ("doc.resident_ops", "doc.device_bytes",
                      "doc.compress_ratio")
 # per-queue gauges keyed by the serving layer's shard key (the integer

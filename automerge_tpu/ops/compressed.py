@@ -416,3 +416,35 @@ class CompressedOpColumns:
             name: (-1 if ent is _DENSE else ent.run_count)
             for name, ent in self.entries.items()
         }
+
+    # -- integrity (integrity.py device-mirror audit) ------------------------
+
+    def verify_against(self, log) -> list:
+        """Differential oracle check: decode every encoded column and
+        compare it to the dense host array it claims to represent.
+        Returns the names of mismatching columns (empty = faithful).
+        Read-only — verification must observe the bundle as consumers
+        would, not repair it."""
+        bad = []
+        n = log.n
+        q = len(log.pred_src)
+        for name, _mode, _item in ROW_SPEC + EDGE_SPEC:
+            ent = self.entries.get(name)
+            if ent is None or ent is _DENSE:
+                continue
+            arr = getattr(log, name)
+            if arr is None:
+                bad.append(name)  # encoded rows for a column the log lost
+                continue
+            rows = q if name in ("pred_src", "pred_tgt", "pred_key") else n
+            cov = self.covered.get(name, 0)
+            if cov > rows or ent.n != cov:
+                bad.append(name)
+                continue
+            if name in ("insert", "expand"):
+                arr = np.asarray(arr, np.bool_).view(np.int8)
+            want = np.asarray(arr[:cov]).astype(np.int64, copy=False)
+            if not np.array_equal(ent.decode().astype(np.int64, copy=False),
+                                  want):
+                bad.append(name)
+        return bad

@@ -473,6 +473,19 @@ class DeviceDoc:
         r = self.resident_nbytes()
         return (self.dense_nbytes() / r) if r else 1.0
 
+    def audit_columns(self) -> list:
+        """Integrity spot-check of the resident image: sync the
+        compressed bundle and verify every encoded column against the
+        dense host oracle (``CompressedOpColumns.verify_against``).
+        Returns mismatching column names — non-empty means this mirror
+        must not serve reads and should be dropped for rebuild. Call
+        from the thread that owns the document (the scrubber holds the
+        doc lock)."""
+        comp = self.log.compressed(sync=True)
+        if comp is None:
+            return []  # dense mode IS the oracle — nothing encoded to audit
+        return comp.verify_against(self.log)
+
     def _export_doc_gauges(self) -> None:
         if self.obs_name is None:
             return

@@ -257,6 +257,10 @@ class RpcServer:
         self.deadlines_enabled = (
             os.environ.get("AUTOMERGE_TPU_ADMISSION", "1") != "0")
         self.admission = None
+        # integrity scrubber (integrity.py): the serving layer installs
+        # and starts one per server; scrubNow lazily builds it so tests
+        # and CI can force a round on a bare RpcServer too
+        self.scrubber = None
         if durable_dir is not None:
             from .store import DocStore
 
@@ -531,6 +535,18 @@ class RpcServer:
                     # close may trip on its own poisoned journal; the
                     # reopen below re-establishes a clean state anyway
                     obs.count("rpc.reopen_close_error", error=str(e)[:200])
+            if p.get("wipe"):
+                # the replica-reset path (anti-entropy repair of a
+                # diverged copy): the fresh open must rebuild from
+                # nothing — salvaging the old bytes would keep the very
+                # corruption the reset is meant to remove
+                from .storage.durable import JOURNAL_NAME, SNAPSHOT_NAME
+
+                for fname in (SNAPSHOT_NAME, JOURNAL_NAME):
+                    try:
+                        os.remove(os.path.join(path, fname))
+                    except OSError:
+                        pass
             try:
                 res = self._open_durable_locked(name, path, p)
             except Exception:
@@ -573,9 +589,12 @@ class RpcServer:
         """Chaos-only fault injection (requires AUTOMERGE_TPU_CHAOS=1 in
         the server's environment): arm or clear a live disk fault on the
         named durable document's filesystem. ``op`` is one of write /
-        truncate / fsync / replace / sync_dir; ``err`` an errno name
-        (EIO, ENOSPC); ``count`` how many calls fail (-1 = until
-        cleared); ``clear: true`` disarms (``op`` optional)."""
+        truncate / fsync / replace / sync_dir / read; ``err`` an errno
+        name (EIO, ENOSPC) or — for ``read`` only — ``BITFLIP``, which
+        silently corrupts one bit of the bytes read instead of raising
+        (the bit-rot model the integrity scrub exists to catch);
+        ``count`` how many calls fail (-1 = until cleared);
+        ``clear: true`` disarms (``op`` optional)."""
         if not self.chaos_enabled:
             raise ValueError(
                 "chaosDisk requires AUTOMERGE_TPU_CHAOS=1 in the server "
@@ -590,6 +609,44 @@ class RpcServer:
         else:
             fs.arm(p["op"], p.get("err", "EIO"), int(p.get("count", -1)))
         return {"armed": {op: list(v) for op, v in fs.armed().items()}}
+
+    def docDigest(self, p):
+        """The verifiable state digest of one document: SHA-256 over
+        (change-hash XOR accumulator, change count, sorted heads) —
+        identical across residency modes and merge orders, so two nodes
+        agree iff they hold the same state (integrity.py). Address by
+        durable ``name`` (hydrates a cold doc; errors on names with no
+        on-disk directory) or by ``doc`` handle."""
+        name = p.get("name")
+        if name is not None:
+            path = self._durable_path(name)
+            with self._lock:
+                known = self._durable_names.get(name) is not None
+            if not known and not os.path.isdir(path):
+                raise ValueError(f"unknown durable doc {name!r}")
+            h = self.openDurable({"name": name})["doc"]
+            doc = self._ensure_resident(h)
+            if doc is None:
+                doc = self._docs[h]
+        else:
+            doc = self._doc(p)
+        if hasattr(doc, "doc_digest"):
+            return dict(doc.doc_digest())
+        from . import integrity
+
+        core = doc.doc if hasattr(doc, "doc") else doc
+        return dict(integrity.doc_digest(core))
+
+    def scrubNow(self, p):
+        """Force one synchronous scrub round (integrity.Scrubber) and
+        return its summary — the deterministic hook CI smokes use
+        instead of sleeping out the background cadence."""
+        s = self.scrubber
+        if s is None:
+            from .integrity import Scrubber
+
+            s = self.scrubber = Scrubber(self)
+        return s.run_round()
 
     # -- tiered residency mechanics (store/docstore.py drives these) ---------
 
@@ -1129,7 +1186,7 @@ class RpcServer:
         "syncSessionReceive", "syncSessionStats", "syncSessionEncode",
         "syncSessionFree", "syncSessionAttach",
         "openDurable", "durableCompact", "durableInfo", "durableReopen",
-        "chaosDisk",
+        "chaosDisk", "docDigest", "scrubNow",
         "storeStatus", "storeDemote", "docFence",
         "metrics", "perfStatus", "profileStart", "profileStop",
     })

@@ -249,6 +249,14 @@ class SocketRpcServer:
             target=self._accept_loop, name="rpc-accept", daemon=True
         )
         self._accept_thread.start()
+        # background integrity scrub (integrity.py): durable deployments
+        # only — a handle-only server has no on-disk state to verify.
+        # start() no-ops when AUTOMERGE_TPU_SCRUB=0 (the bench baseline)
+        if self.rpc.durable_dir and self.rpc.scrubber is None:
+            from ..integrity import Scrubber
+
+            self.rpc.scrubber = Scrubber(self.rpc)
+            self.rpc.scrubber.start()
 
     def serve_forever(self) -> None:
         """start() + block until a ``shutdown`` request (or ``stop()``)."""
@@ -284,6 +292,8 @@ class SocketRpcServer:
         return self._stopped.wait(timeout)
 
     def _stop_inner(self) -> None:
+        if self.rpc.scrubber is not None:
+            self.rpc.scrubber.stop()
         if self._listener is not None:
             try:
                 self._listener.close()

@@ -370,11 +370,19 @@ class FaultyFS:
         fs.arm("write", "ENOSPC", count=1)  # exactly the next write
         fs.clear()                      # all faults off
 
-    Ops: ``write``, ``truncate``, ``fsync``, ``replace``, ``sync_dir``.
-    Every injected fault counts ``chaos.injected{kind=disk_<op>}`` so a
-    chaos soak can assert its faults actually fired."""
+    Ops: ``write``, ``truncate``, ``fsync``, ``replace``, ``sync_dir``,
+    ``read``. Every injected fault counts
+    ``chaos.injected{kind=disk_<op>}`` so a chaos soak can assert its
+    faults actually fired.
 
-    FAULTABLE = ("write", "truncate", "fsync", "replace", "sync_dir")
+    ``read`` is special: armed with the sentinel err ``"BITFLIP"`` it
+    models silent bit rot — ``read_bytes`` returns the file's bytes with
+    one bit flipped instead of raising, which is exactly the fault class
+    only a checksum (the integrity scrub) can catch. Armed with a real
+    errno name it raises like any other op."""
+
+    FAULTABLE = ("write", "truncate", "fsync", "replace", "sync_dir",
+                 "read")
 
     def __init__(self, base=None):
         if base is None:
@@ -392,8 +400,10 @@ class FaultyFS:
         with the named errno (``"EIO"``, ``"ENOSPC"``, ...)."""
         if op not in self.FAULTABLE:
             raise ValueError(f"unknown faultable op {op!r}")
-        if not hasattr(_errno, err):
+        if err != "BITFLIP" and not hasattr(_errno, err):
             raise ValueError(f"unknown errno name {err!r}")
+        if err == "BITFLIP" and op != "read":
+            raise ValueError("BITFLIP is only meaningful on read")
         with self._lock:
             self._armed[op] = [err, int(count)]
 
@@ -429,6 +439,24 @@ class FaultyFS:
         code = getattr(_errno, err)
         raise OSError(code, f"injected {err} on {op}")
 
+    def _consume(self, op: str):
+        """Decrement and return the armed err name for ``op`` (None when
+        unarmed) WITHOUT raising — the BITFLIP read path corrupts the
+        returned bytes instead of failing the call."""
+        with self._lock:
+            entry = self._armed.get(op)
+            if entry is None:
+                return None
+            err, remaining = entry
+            if remaining == 0:
+                self._armed.pop(op, None)
+                return None
+            if remaining > 0:
+                entry[1] = remaining - 1
+                if entry[1] == 0:
+                    self._armed.pop(op, None)
+            return err
+
     # -- the OsFS interface ---------------------------------------------------
 
     def open(self, path: str, mode: str):
@@ -454,7 +482,21 @@ class FaultyFS:
         return self.base.getsize(path)
 
     def read_bytes(self, path: str) -> bytes:
-        return self.base.read_bytes(path)
+        err = self._consume("read") if self._armed else None
+        if err is not None and err != "BITFLIP":
+            from .. import obs
+
+            obs.count("chaos.injected", labels={"kind": "disk_read"})
+            raise OSError(getattr(_errno, err), f"injected {err} on read")
+        data = self.base.read_bytes(path)
+        if err == "BITFLIP" and data:
+            from .. import obs
+
+            obs.count("chaos.injected", labels={"kind": "disk_read_flip"})
+            # flip one mid-file bit: silent rot, not truncation
+            i = len(data) // 2
+            data = data[:i] + bytes([data[i] ^ 0x40]) + data[i + 1:]
+        return data
 
     def makedirs(self, path: str) -> None:
         self.base.makedirs(path)

@@ -51,6 +51,7 @@ from typing import Dict, List, Optional
 
 from .. import obs
 from ..degrade import brownout_active
+from ..integrity import DigestState, finalize_digest
 from ..utils.leb128 import decode_uleb, encode_uleb
 from .change import parse_change
 from .journal import (
@@ -167,6 +168,12 @@ class DurableDocument:
         self.last_access = obs.now()
         self._touch_exported = 0.0
         self.device_doc = None  # set by open(device=True)
+        # incremental state digest (integrity.py): the XOR-of-change-
+        # hashes accumulator tracks the in-memory HISTORY (fed by the
+        # change listener, rebuilt on open), so two documents agree on
+        # doc_digest() iff they hold the same change set + frontier —
+        # the anti-entropy scrubber's comparison unit
+        self._digest = DigestState()
         # cluster replication gate (cluster/replication.py): when set,
         # the OUTERMOST ack-scope exit blocks until enough followers
         # hold the batch durably — a raised gate converts the batch to
@@ -300,6 +307,9 @@ class DurableDocument:
             dev.obs_name = dd.obs_name
             dev._export_doc_gauges()
         dd._last_snapshot_bytes = snap_bytes
+        # full digest rebuild, once per open — every later change folds
+        # in incrementally through the listener below
+        dd._digest.recompute(a.stored.hash for a in core.history)
         core.change_listeners.append(dd._on_change)
         dd._export_doc_gauges()
         return dd
@@ -413,6 +423,8 @@ class DurableDocument:
                       labels=labels)
         obs.gauge_set("doc.last_access_seconds", self.last_access,
                       labels=labels)
+        obs.gauge_set("doc.digest_changes", self._digest.count,
+                      labels=labels)
 
     # touch() refreshes the exported gauge at most this often: the stamp
     # the eviction policy reads is the plain attribute (free), and a
@@ -488,6 +500,11 @@ class DurableDocument:
         call acks to its caller."""
         from .journal import JournalPoisoned
 
+        # the digest mirrors HISTORY, and this listener fires exactly
+        # once per change entering it — fold the hash in before any
+        # journaling outcome, so memory and digest never drift even on
+        # the broken (memory-ahead-of-disk) paths below
+        self._digest.add(stored.hash)
         if self._broken:
             # refusing BEFORE the append keeps every later change un-acked
             # while memory is ahead of disk — no silently stranded deps.
@@ -521,6 +538,17 @@ class DurableDocument:
             # compaction re-establishes disk >= memory.
             self._broken = True
             raise
+
+    def doc_digest(self) -> Dict[str, object]:
+        """The verifiable state digest: accumulator + change count +
+        sorted heads under one SHA-256 (integrity.finalize_digest).
+        Taken under the doc lock so heads and accumulator describe one
+        instant."""
+        with self.lock:
+            heads = self._core.get_heads()
+            acc, count = self._digest.value()
+        return {"digest": finalize_digest(acc, count, heads),
+                "changes": count}
 
     @property
     def journal(self) -> Journal:

@@ -1035,6 +1035,106 @@ def main():
     results["serve"] = serve_cfg
     note(f"serve: {results['serve']}")
 
+    # ---- config: serve scrub A/B (integrity scrub overhead) ----------------
+    # The SAME concurrent socket workload against two fresh servers in
+    # tight interleaved pairs: integrity scrub ON at an aggressive
+    # cadence (a round every 0.1s, ~150x hotter than the production
+    # default) vs AUTOMERGE_TPU_SCRUB=0. The exported goodput_ratio
+    # (best paired on/off rps) is the scrub's measured tax on serving
+    # goodput; the acceptance floor (>= 0.95 in run_bench_smoke, and a
+    # tracked perf_gate metric) enforces the "off the ack path" design —
+    # a scrub that grabs doc locks greedily or verifies synchronously
+    # lands well under it.
+    try:
+        if (env_flag("BENCH_SERVE", "1") != "0"
+                and env_flag("BENCH_SERVE_SCRUB", "1") != "0"
+                and "requests_per_sec" in serve_cfg):
+            scrub_reps = env_int("BENCH_SERVE_SCRUB_REPS", sv_reps)
+            tmp_on = tempfile.mkdtemp(prefix="amtpu_bench_scrub_on_")
+            tmp_off = tempfile.mkdtemp(prefix="amtpu_bench_scrub_off_")
+            on_proc = off_proc = None
+
+            def spawn_scrub(tmp, scrub_env):
+                proc = subprocess.Popen(
+                    [sys.executable, "-m", "automerge_tpu.rpc",
+                     "--socket", "127.0.0.1:0", "--durable", tmp],
+                    stderr=subprocess.PIPE, text=True,
+                    env=dict(sub_env, **scrub_env))
+                port = int(re.search(r"(\d+)\)",
+                                     proc.stderr.readline()).group(1))
+                threading.Thread(target=lambda: [None for _ in proc.stderr],
+                                 daemon=True).start()
+                return proc, port
+
+            def scrub_rep(port, tag):
+                all_blobs = [build_blobs(ci, tag) for ci in range(n_clients)]
+                counts = [0] * n_clients
+                barrier = threading.Barrier(n_clients + 1)
+
+                def go(ci):
+                    sock = socketmod.create_connection(("127.0.0.1", port))
+                    sock.setsockopt(socketmod.IPPROTO_TCP,
+                                    socketmod.TCP_NODELAY, 1)
+                    f = sock.makefile("r")
+                    barrier.wait()
+                    counts[ci] = client_workload(
+                        socket_pipeline(sock, f, [0]), ci, all_blobs[ci])
+                    sock.close()
+
+                ts = [threading.Thread(target=go, args=(ci,))
+                      for ci in range(n_clients)]
+                for t in ts:
+                    t.start()
+                barrier.wait()
+                t0 = time.perf_counter()
+                for t in ts:
+                    t.join()
+                return sum(counts), time.perf_counter() - t0
+
+            try:
+                on_proc, on_port = spawn_scrub(tmp_on, {
+                    "AUTOMERGE_TPU_SCRUB": "1",
+                    "AUTOMERGE_TPU_SCRUB_INTERVAL": "0.1",
+                    "AUTOMERGE_TPU_SCRUB_SAMPLE": "64",
+                })
+                off_proc, off_port = spawn_scrub(
+                    tmp_off, {"AUTOMERGE_TPU_SCRUB": "0"})
+                scrub_rep(on_port, "warm_on")
+                scrub_rep(off_port, "warm_off")
+                ratios = []
+                for rep in range(scrub_reps):
+                    on_n, on_t = scrub_rep(on_port, f"on{rep}")
+                    off_n, off_t = scrub_rep(off_port, f"off{rep}")
+                    assert on_n == off_n, (on_n, off_n)
+                    ratios.append((on_n / on_t) / (off_n / off_t))
+                for port in (on_port, off_port):
+                    sock = socketmod.create_connection(("127.0.0.1", port))
+                    sock.sendall(b'{"id":1,"method":"shutdown"}\n')
+                    sock.makefile("r").readline()
+                    sock.close()
+                on_proc.wait(timeout=60)
+                off_proc.wait(timeout=60)
+            finally:
+                for p_ in (on_proc, off_proc):
+                    if p_ is not None and p_.poll() is None:
+                        p_.kill()
+                        p_.wait(timeout=10)
+                shutil.rmtree(tmp_on, ignore_errors=True)
+                shutil.rmtree(tmp_off, ignore_errors=True)
+            serve_cfg["scrub"] = {
+                "reps": scrub_reps,
+                "scrub_interval_s": 0.1,
+                "rep_goodput_ratios": [round(r, 3) for r in ratios],
+                "goodput_ratio": round(max(ratios), 3),
+            }
+            note(f"serve scrub A/B: {serve_cfg['scrub']}")
+    except Exception as e:  # noqa: BLE001 — degrade, record, continue
+        import traceback
+
+        print(f"serve scrub config failed:\n{traceback.format_exc()}",
+              file=sys.stderr, flush=True)
+        serve_cfg["scrub_error"] = repr(e)[:500]
+
     # ---- config: serve_batched (cross-document batched device merge) -------
     # N resident documents drain one coalesced delta each per cycle — the
     # multi-document work a ShardPool drain hands the device layer. Two

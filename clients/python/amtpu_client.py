@@ -69,6 +69,19 @@ class Deadline(RpcError):
         self.attempts = attempts
 
 
+class IntegrityError(RpcError):
+    """The server found corrupt stored or replicated state (a digest
+    mismatch, a bad snapshot chunk, a journal CRC failure). NEVER
+    retriable — retrying re-reads the same damaged bytes — and distinct
+    from transient ``RpcError``s so callers can alert instead of loop:
+    the right response is operator attention (scrub/repair), not
+    backoff."""
+
+    def __init__(self, err: Dict[str, Any]):
+        super().__init__(err)
+        self.retriable = False
+
+
 class CallStats:
     """What the previous ``call`` cost: attempts sent and seconds spent
     blocked in backoff/redial (0.0 for a clean first-try success)."""
@@ -236,6 +249,8 @@ class RetryingClient:
                         stats.blocked_s = time.monotonic() - t_first_fail
                     return resp.get("result")
                 err = resp["error"]
+                if err.get("type") == "IntegrityError":
+                    raise IntegrityError(err)
                 if not is_retriable(err):
                     raise RpcError(err)
             except OSError as e:
