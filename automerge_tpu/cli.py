@@ -1,6 +1,7 @@
 """Command-line interface: export / import / merge / examine / examine-sync
 / change / journal-info / compact / metrics / serve / cluster-router /
-cluster-metrics / flight-merge / perf-report.
+cluster-metrics / cluster-history / cluster-top / flight-merge /
+perf-report.
 
 Mirrors the reference CLI's subcommands (reference:
 rust/automerge-cli/src/main.rs:81-161). Documents read and write the
@@ -58,6 +59,54 @@ def _load_doc(args) -> AutoDoc:
                 file=sys.stderr,
             )
     return doc
+
+
+def _watch_loop(seconds: float, emit) -> int:
+    """``--watch`` driver: clear the terminal, render once, sleep,
+    repeat. Ctrl-C is the intended exit and returns 0 (a clean status),
+    not a traceback."""
+    import time
+
+    try:
+        while True:
+            sys.stdout.write("\x1b[2J\x1b[H")
+            sys.stdout.flush()
+            rc = emit()
+            if rc != 0:
+                return rc
+            time.sleep(max(0.1, seconds))
+    except KeyboardInterrupt:
+        sys.stdout.write("\n")
+        return 0
+
+
+def _rpc_once(addr: str, method: str, params, tag: str):
+    """One-shot JSON-RPC request over a short-lived TCP connection (the
+    perf-report idiom). Returns ``(result, None)`` on success or
+    ``(None, exit_code)`` with the error already printed to stderr."""
+    import socket
+
+    host, _, port = addr.rpartition(":")
+    req = {"id": 1, "method": method}
+    if params:
+        req["params"] = params
+    try:
+        with socket.create_connection((host or "127.0.0.1", int(port)),
+                                      timeout=10) as sock:
+            sock.settimeout(30)
+            sock.sendall((json.dumps(req) + "\n").encode())
+            raw = sock.makefile("r").readline()
+    except (OSError, ValueError) as e:
+        print(f"{tag}: {addr}: {e}", file=sys.stderr)
+        return None, 1
+    if not raw:
+        print(f"{tag}: server closed the connection", file=sys.stderr)
+        return None, 1
+    resp = json.loads(raw)
+    if "error" in resp:
+        print(f"{tag}: {resp['error']}", file=sys.stderr)
+        return None, 1
+    return resp["result"], None
 
 
 def cmd_export(args) -> int:
@@ -369,18 +418,24 @@ def cmd_metrics(args) -> int:
             rep = doc.salvage_report
             if rep is not None and rep.dropped:
                 print(f"metrics: {rep.summary()}", file=sys.stderr)
-    if args.format == "json":
-        body = json.dumps(
-            {
-                "metrics": obs.snapshot(),
-                "counters": dict(obs.legacy_counters),
-                "timings": obs.timing_summary(),
-            },
-            indent=2,
-        ) + "\n"
-    else:
-        body = obs.render_prometheus()
-    _write(args.out, body.encode())
+    def emit() -> int:
+        if args.format == "json":
+            body = json.dumps(
+                {
+                    "metrics": obs.snapshot(),
+                    "counters": dict(obs.legacy_counters),
+                    "timings": obs.timing_summary(),
+                },
+                indent=2,
+            ) + "\n"
+        else:
+            body = obs.render_prometheus()
+        _write(args.out, body.encode())
+        return 0
+
+    if args.watch:
+        return _watch_loop(args.watch, emit)
+    emit()
     if args.trace_out:
         n_spans = obs.export_trace(args.trace_out)
         print(
@@ -396,35 +451,100 @@ def cmd_cluster_metrics(args) -> int:
     Prometheus exposition merged into one family set, each sample
     labeled ``node="<addr>"`` (the router itself is ``node="router"``).
     Unreachable nodes are reported on stderr, never fatal."""
-    import socket
 
-    host, _, port = args.router.rpartition(":")
-    try:
-        with socket.create_connection((host or "127.0.0.1", int(port)),
-                                      timeout=10) as sock:
-            sock.settimeout(30)
-            sock.sendall(b'{"id": 1, "method": "clusterMetrics"}\n')
-            raw = sock.makefile("r").readline()
-    except (OSError, ValueError) as e:
-        print(f"cluster-metrics: {args.router}: {e}", file=sys.stderr)
-        return 1
-    if not raw:
-        print("cluster-metrics: router closed the connection",
-              file=sys.stderr)
-        return 1
-    resp = json.loads(raw)
-    if "error" in resp:
-        print(f"cluster-metrics: {resp['error']}", file=sys.stderr)
-        return 1
-    result = resp["result"]
-    for bad in result.get("unreachable", ()):
-        print(f"cluster-metrics: unreachable {bad['node']}: {bad['error']}",
-              file=sys.stderr)
-    if args.format == "json":
-        _write(args.out, (json.dumps(result, indent=2) + "\n").encode())
-    else:
-        _write(args.out, result["body"].encode())
-    return 0
+    def emit() -> int:
+        result, rc = _rpc_once(args.router, "clusterMetrics", None,
+                               "cluster-metrics")
+        if result is None:
+            return rc
+        for bad in result.get("unreachable", ()):
+            print(f"cluster-metrics: unreachable {bad['node']}: "
+                  f"{bad['error']}", file=sys.stderr)
+        if args.format == "json":
+            _write(args.out, (json.dumps(result, indent=2) + "\n").encode())
+        else:
+            _write(args.out, result["body"].encode())
+        return 0
+
+    if args.watch:
+        return _watch_loop(args.watch, emit)
+    return emit()
+
+
+def cmd_cluster_history(args) -> int:
+    """Query a node's in-memory history rings (obs/history.py): the
+    1s/10s/60s downsampled recent past of the allowlisted metrics,
+    fetched over the ``historyStatus`` RPC. Works against any server or
+    cluster node address (followers answer too)."""
+    params = {}
+    if args.metric:
+        params["name"] = args.metric
+    if args.tier is not None:
+        params["tier"] = args.tier
+
+    def emit() -> int:
+        result, rc = _rpc_once(args.connect, "historyStatus", params,
+                               "cluster-history")
+        if result is None:
+            return rc
+        if args.format == "json":
+            _write(args.out, (json.dumps(result, indent=2) + "\n").encode())
+            return 0
+        lines = [
+            f"history: tiers {result.get('tiers')}  "
+            f"samples {result.get('samples', 0)}  "
+            f"series cap {result.get('cap')}  "
+            f"dropped {result.get('droppedSeries', 0)}"
+        ]
+        for s in result.get("series") or ():
+            lines.append(f"{s.get('name')} ({s.get('type')})")
+            tiers = s.get("tiers") or {}
+            for t in sorted(tiers, key=int):
+                slots = tiers[t][-args.last:]
+                if not slots:
+                    continue
+                if s.get("type") == "counter":
+                    body = "  ".join(
+                        f"{sl.get('delta', 0.0):g}" for sl in slots)
+                else:
+                    body = "  ".join(
+                        f"{sl.get('max', 0.0):g}" for sl in slots)
+                lines.append(f"  tier {t}: {body}")
+        _write(args.out, ("\n".join(lines) + "\n").encode())
+        return 0
+
+    if args.watch:
+        return _watch_loop(args.watch, emit)
+    return emit()
+
+
+def cmd_cluster_top(args) -> int:
+    """Live cluster heat view: the router's ``clusterAdvise`` RPC —
+    per-group load from the doc-heat tables, follower staleness, and
+    the placement advisor's ranked, explained, report-only
+    recommendations. ``--watch N`` turns it into a top(1)-style
+    redraw loop."""
+    from .cluster import advisor
+
+    params = {}
+    if args.snapshot:
+        params["snapshot"] = True
+
+    def emit() -> int:
+        result, rc = _rpc_once(args.router, "clusterAdvise", params,
+                               "cluster-top")
+        if result is None:
+            return rc
+        if args.format == "json":
+            _write(args.out, (json.dumps(result, indent=2) + "\n").encode())
+        else:
+            _write(args.out,
+                   advisor.render_text(result, top=args.top).encode())
+        return 0
+
+    if args.watch:
+        return _watch_loop(args.watch, emit)
+    return emit()
 
 
 def cmd_flight_merge(args) -> int:
@@ -636,6 +756,8 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--trace-out", default=None, metavar="PATH",
                     help="also export recorded spans as Perfetto/"
                          "Chrome-trace JSON to PATH")
+    sp.add_argument("--watch", type=float, default=None, metavar="SECONDS",
+                    help="redraw the dump every SECONDS until Ctrl-C")
 
     sp = sub.add_parser(
         "serve",
@@ -659,6 +781,36 @@ def build_parser() -> argparse.ArgumentParser:
                     help="router address to scrape")
     sp.add_argument("--format", choices=("prometheus", "json"),
                     default="prometheus")
+    sp.add_argument("--watch", type=float, default=None, metavar="SECONDS",
+                    help="re-scrape and redraw every SECONDS until Ctrl-C")
+
+    sp = add("cluster-history", cmd_cluster_history,
+             help="query a node's history rings: downsampled recent "
+                  "past of the allowlisted metrics")
+    sp.add_argument("connect", metavar="HOST:PORT",
+                    help="server or cluster node address")
+    sp.add_argument("--metric", default=None,
+                    help="restrict to one metric family name")
+    sp.add_argument("--tier", type=int, default=None,
+                    help="restrict to one tier index (0=1s, 1=10s, 2=60s)")
+    sp.add_argument("--last", type=int, default=20,
+                    help="slots shown per tier in text mode")
+    sp.add_argument("--format", choices=("text", "json"), default="text")
+    sp.add_argument("--watch", type=float, default=None, metavar="SECONDS",
+                    help="redraw every SECONDS until Ctrl-C")
+
+    sp = add("cluster-top", cmd_cluster_top,
+             help="live cluster heat view: group loads, staleness, and "
+                  "the placement advisor's report-only recommendations")
+    sp.add_argument("router", metavar="HOST:PORT",
+                    help="cluster router address")
+    sp.add_argument("--top", type=int, default=None,
+                    help="recommendations shown in text mode")
+    sp.add_argument("--snapshot", action="store_true",
+                    help="include the raw telemetry snapshot (json mode)")
+    sp.add_argument("--format", choices=("text", "json"), default="text")
+    sp.add_argument("--watch", type=float, default=None, metavar="SECONDS",
+                    help="redraw every SECONDS until Ctrl-C")
 
     sp = add("perf-report", cmd_perf_report,
              help="drain-cycle stage attribution: host/device split, "

@@ -73,6 +73,9 @@ _FOLLOWER_OK = frozenset({
     # integrity surface: the leader's anti-entropy scrub probes follower
     # digests, resets diverged replicas, and CI forces follower rounds
     "docDigest", "replReset", "scrubNow",
+    # read-only telemetry: a follower's heat table and history rings
+    # are its own (the advisor reads every node's view)
+    "heatStatus", "historyStatus",
 })
 
 
@@ -133,6 +136,15 @@ class ClusterRpcServer(RpcServer):
         # apply_replicated hands each doc's applied changes here instead
         # of leaving the device mirror untouched
         self._repl_device_feed = None
+        # follower staleness self-estimate, kept in the LEADER's
+        # monotonic frame: the last leader clock sample (leader now,
+        # local now at receipt — every replApply/replPing carries one),
+        # the per-doc applied LSN, and per doc the leader-frame instant
+        # at which this follower last held everything the leader had
+        self._stale_lock = threading.Lock()
+        self._leader_clock = None  # (leader_now, local_now)
+        self._applied_lsn: dict = {}
+        self._fresh_at: dict = {}
 
     # -- gating --------------------------------------------------------------
 
@@ -167,6 +179,50 @@ class ClusterRpcServer(RpcServer):
         h = self.openDurable({"name": name})["doc"]
         doc = self._ensure_resident(h)
         return doc if doc is not None else self._docs[h]
+
+    # -- follower staleness self-estimate ------------------------------------
+
+    def _note_leader_clock(self, leader_now) -> None:
+        if isinstance(leader_now, (int, float)):
+            with self._stale_lock:
+                self._leader_clock = (float(leader_now), obs.now())
+
+    def _est_leader_now(self):
+        """The leader's monotonic clock, extrapolated from the last
+        sample it shipped us (one-way, so off by up to one transit —
+        within the RTT bound the agreement assertion allows)."""
+        with self._stale_lock:
+            lc = self._leader_clock
+        if lc is None:
+            return None
+        return lc[0] + (obs.now() - lc[1])
+
+    def _note_applied(self, name, lsn, leader_now, leader_lsn) -> None:
+        """Record one applied batch/snapshot: our durable LSN for the
+        doc, and — when the batch brought us level with the leader's
+        latest — the leader-frame instant we became fresh at."""
+        self._note_leader_clock(leader_now)
+        with self._stale_lock:
+            self._applied_lsn[name] = int(lsn)
+            if (
+                isinstance(leader_now, (int, float))
+                and isinstance(leader_lsn, int)
+                and int(lsn) >= leader_lsn
+            ):
+                self._fresh_at[name] = float(leader_now)
+
+    def follower_staleness(self) -> dict:
+        """{doc: seconds} — this follower's own staleness estimate:
+        extrapolated leader-now minus the last instant we were level.
+        Empty until the first leader clock sample arrives."""
+        est_now = self._est_leader_now()
+        if est_now is None:
+            return {}
+        with self._stale_lock:
+            return {
+                name: max(0.0, est_now - t)
+                for name, t in self._fresh_at.items()
+            }
 
     # -- cluster status ------------------------------------------------------
 
@@ -208,6 +264,18 @@ class ClusterRpcServer(RpcServer):
         if self.hub is not None:
             out["stream"] = self.hub.stream_id
             out["followers"] = self.hub.followers()
+            # seconds-based lag, both leader-computed and
+            # follower-reported, refreshed (gauges included) on every
+            # status poll so whoever is looking sees current numbers
+            self.hub.publish_staleness()
+            out["staleness"] = self.hub.staleness_report()
+        else:
+            stale = self.follower_staleness()
+            if stale:
+                out["stalenessSeconds"] = stale
+                for name, s in stale.items():
+                    if name in docs:
+                        docs[name]["stalenessSeconds"] = s
         if self.leader_hint:
             out["leader"] = self.leader_hint
         # overload advertisement: the serving layer's admission
@@ -245,6 +313,8 @@ class ClusterRpcServer(RpcServer):
                 records, base64.b64decode(p["cursor"]),
                 device_feed=self._repl_device_feed)
         obs.count("cluster.records_applied", n=len(records))
+        self._note_applied(name, int(p["lsn"]),
+                           p.get("now"), p.get("leaderLsn"))
         return {"lsn": int(p["lsn"]), "applied": applied}
 
     def replSnapshot(self, p):
@@ -256,15 +326,41 @@ class ClusterRpcServer(RpcServer):
         doc.apply_replicated_snapshot(
             base64.b64decode(p["snapshot"]), base64.b64decode(p["cursor"]))
         obs.count("cluster.snapshots_applied")
+        self._note_applied(name, int(p["lsn"]),
+                           p.get("now"), p.get("leaderLsn"))
         return {"lsn": int(p["lsn"])}
 
     def replPing(self, p):
         self.last_leader_contact = time.monotonic()
+        # the ping's request half carries the leader's clock and per-doc
+        # latest LSNs: any doc we already hold in full is fresh as of
+        # the leader instant the ping left — that keeps an IDLE doc's
+        # staleness pinned near zero instead of growing since its last
+        # write. The response half reports our estimate back.
+        now_l = p.get("now")
+        docs = p.get("docs")
+        if isinstance(now_l, (int, float)):
+            self._note_leader_clock(now_l)
+            if isinstance(docs, dict):
+                with self._stale_lock:
+                    for name, llsn in docs.items():
+                        if (
+                            isinstance(llsn, int)
+                            and self._applied_lsn.get(name, -1) >= llsn
+                        ):
+                            self._fresh_at[name] = float(now_l)
+        out = {"nodeId": self.node_id, "role": self.cluster_role,
+               "now": obs.now()}
+        stale = self.follower_staleness()
+        if stale:
+            out["staleness"] = stale
+            obs.gauge_set("cluster.staleness_seconds",
+                          max(stale.values()),
+                          labels={"node": self.node_id})
         # "now" (this process's monotonic obs clock) turns every ping
         # into a clock-sync sample: the pinger records the RTT midpoint
         # and flight-merge aligns the two processes' span timelines
-        return {"nodeId": self.node_id, "role": self.cluster_role,
-                "now": obs.now()}
+        return out
 
     def replHarvest(self, p):
         """Hand out this node's full state for one document — the
@@ -345,6 +441,11 @@ class ClusterRpcServer(RpcServer):
         the number of durable directories opened."""
         self.cluster_role = "leader"
         self.leader_hint = None
+        with self._stale_lock:
+            # follower-frame staleness state is meaningless once leading
+            self._leader_clock = None
+            self._fresh_at.clear()
+            self._applied_lsn.clear()
         self.hub = ReplicationHub(self.node_id, ack_replicas=ack_replicas)
         self.on_durable_open = self._on_durable_open
         n = self._warm_open()

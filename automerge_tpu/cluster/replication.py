@@ -122,10 +122,10 @@ class _DocStream:
 
     __slots__ = (
         "name", "dd", "lsn", "synced_lsn", "pending", "buffer",
-        "buffer_bytes", "base_lsn",
+        "buffer_bytes", "base_lsn", "stamps",
     )
 
-    def __init__(self, name: str, dd):
+    def __init__(self, name: str, dd, stamp_cap: int = 4096):
         self.name = name
         self.dd = dd
         self.lsn = 0  # appended records (this hub incarnation)
@@ -138,6 +138,12 @@ class _DocStream:
         self.buffer: deque = deque()
         self.buffer_bytes = 0
         self.base_lsn = 0  # everything <= this has been trimmed
+        # (lsn, leader monotonic append time) — the seconds-based
+        # staleness base: a follower behind LSN f is stale by "now minus
+        # the append time of the first record it has not applied". The
+        # maxlen bound means a VERY deep backlog under-reports (oldest
+        # stamp wins), which only ever understates — never invents — lag.
+        self.stamps: deque = deque(maxlen=stamp_cap)
 
 
 class ReplicationHub:
@@ -176,6 +182,9 @@ class ReplicationHub:
         # (black-holed response path) must fail the request and recycle
         # the link rather than freeze the ship loop forever
         self.io_timeout = _env_float("AUTOMERGE_TPU_REPL_IO_TIMEOUT", 10.0)
+        # per-doc LSN->append-time stamp ring (staleness accounting)
+        self.stamp_cap = max(16, int(_env_float(
+            "AUTOMERGE_TPU_REPL_STAMPS", 4096)))
         self._lock = threading.Lock()
         self._acked = threading.Condition(self._lock)
         self._streams: Dict[str, _DocStream] = {}
@@ -230,7 +239,7 @@ class ReplicationHub:
                 reattached = True
                 links = list(self._links.values())
             else:
-                st = _DocStream(name, dd)
+                st = _DocStream(name, dd, stamp_cap=self.stamp_cap)
                 self._streams[name] = st
         if reattached:
             # the reopened document's recovered history may contain
@@ -270,6 +279,78 @@ class ReplicationHub:
             st = self._streams.get(name)
             return st.lsn if st is not None else 0
 
+    def doc_lsns(self) -> Dict[str, int]:
+        with self._lock:
+            return {name: st.lsn for name, st in self._streams.items()}
+
+    # -- seconds-based staleness ---------------------------------------------
+
+    @staticmethod
+    def _stamp_after(st: _DocStream, lsn: int) -> Optional[float]:
+        """Append time of the first retained record with LSN > ``lsn``
+        (the oldest write a follower at ``lsn`` has not applied);
+        falls back to the oldest stamp when the ring trimmed past it."""
+        for rec_lsn, t in st.stamps:
+            if rec_lsn > lsn:
+                return t
+        return st.stamps[0][1] if st.stamps else None
+
+    def staleness(self, now: Optional[float] = None) -> Dict[str, Dict[str, float]]:
+        """Leader-computed ``{follower_addr: {doc: seconds}}``: zero for
+        a caught-up follower, else how long ago the first record it is
+        missing was appended here (leader monotonic clock). A follower
+        with no cursor for a doc yet (mid-handshake) reports nothing for
+        it rather than a fake number."""
+        if now is None:
+            now = obs.now()
+        out: Dict[str, Dict[str, float]] = {}
+        with self._lock:
+            for addr, link in self._links.items():
+                per: Dict[str, float] = {}
+                for name, st in self._streams.items():
+                    f = link.durable_lsn.get(name)
+                    if f is None:
+                        continue
+                    if f >= st.lsn:
+                        per[name] = 0.0
+                        continue
+                    t = self._stamp_after(st, f)
+                    per[name] = max(0.0, now - t) if t is not None else 0.0
+                out[addr] = per
+        return out
+
+    def staleness_report(self, now: Optional[float] = None) -> dict:
+        """Both sides of the staleness picture per follower: what this
+        leader computes from its stamps, and what the follower last
+        self-reported over the ping exchange (its own estimate against
+        the RTT-aligned leader clock) — the agreement CI asserts on."""
+        computed = self.staleness(now=now)
+        out: Dict[str, dict] = {}
+        with self._lock:
+            links = dict(self._links)
+        for addr, per in computed.items():
+            link = links.get(addr)
+            out[addr] = {
+                "computed": per,
+                "reported": dict(link.reported_staleness) if link else {},
+            }
+        return out
+
+    def publish_staleness(self, now: Optional[float] = None) -> Dict[str, Dict[str, float]]:
+        """Export the computed view: one ``cluster.staleness_seconds
+        {node}`` gauge per follower (worst doc) plus one histogram
+        observation per (follower, doc). Called from each link's idle
+        ping cycle and from ``clusterStatus``, so the gauges are fresh
+        whenever anything looks."""
+        stale = self.staleness(now=now)
+        for addr, per in stale.items():
+            obs.gauge_set("cluster.staleness_seconds",
+                          max(per.values(), default=0.0),
+                          labels={"node": addr})
+            for s in per.values():
+                obs.observe("cluster.staleness_seconds", s)
+        return stale
+
     # -- journal hooks (leader write path) -----------------------------------
 
     def _on_record(self, name: str, rec_type: int, payload: bytes,
@@ -284,6 +365,7 @@ class ReplicationHub:
                 return
             st.lsn += 1
             st.pending.append((st.lsn, seq, rec_type, payload, ctx))
+            st.stamps.append((st.lsn, obs.now()))
 
     def _drain_pending_locked(self, st: _DocStream) -> bool:
         """Promote pending records covered by the journal's durable
@@ -579,6 +661,9 @@ class _FollowerLink:
         self.addr = addr
         self.durable_lsn: Dict[str, int] = {}  # follower's durable cursor
         self.quarantined = False  # vote revoked (integrity divergence)
+        # follower's last self-reported per-doc staleness estimate
+        # (seconds, from the ping exchange)
+        self.reported_staleness: Dict[str, float] = {}
         self._sent_lsn: Dict[str, int] = {}
         self._needs_snapshot: Dict[str, bool] = {}
         self._wake = threading.Event()
@@ -678,6 +763,7 @@ class _FollowerLink:
                 # its last acked values — it no longer counts
                 self.durable_lsn.clear()
                 self._sent_lsn.clear()
+                self.reported_staleness.clear()
                 self._stop.wait(backoff)
                 backoff = min(backoff * 2, 2.0)
 
@@ -722,13 +808,28 @@ class _FollowerLink:
                     # monotonic "now" is what flight-merge uses to put
                     # both processes' spans on one timeline
                     t0 = obs.now()
-                    res = self._request(f, "replPing",
-                                        {"stream": self.hub.stream_id})
+                    # the ping carries the leader clock and per-doc
+                    # latest LSNs out; the response carries the
+                    # follower's own staleness estimate back — the two
+                    # halves of the PR 8 RTT exchange the agreement
+                    # assertion in run_cluster compares
+                    res = self._request(f, "replPing", {
+                        "stream": self.hub.stream_id,
+                        "now": t0,
+                        "docs": self.hub.doc_lsns(),
+                    })
                     t1 = obs.now()
                     peer_now = res.get("now")
                     if isinstance(peer_now, (int, float)):
                         obs.flight.note_clock_sync(
                             res.get("nodeId") or self.addr, t0, t1, peer_now)
+                    rep = res.get("staleness")
+                    if isinstance(rep, dict):
+                        self.reported_staleness = {
+                            str(k): float(v) for k, v in rep.items()
+                            if isinstance(v, (int, float))
+                        }
+                    self.hub.publish_staleness(now=t1)
                     last_sent = time.monotonic()
             self._wake.clear()
 
@@ -741,6 +842,10 @@ class _FollowerLink:
             "lsn": lsn,
             "snapshot": base64.b64encode(data).decode("ascii"),
             "cursor": base64.b64encode(cursor).decode("ascii"),
+            # staleness base: a snapshot pinned to the leader's latest
+            # LSN makes the follower fresh as of this leader instant
+            "now": obs.now(),
+            "leaderLsn": lsn,
         })
         self._needs_snapshot[name] = False
         self._sent_lsn[name] = lsn
@@ -777,6 +882,11 @@ class _FollowerLink:
                     "data": base64.b64encode(
                         encode_batch(records)).decode("ascii"),
                     "cursor": base64.b64encode(cursor).decode("ascii"),
+                    # leader ship-time clock + latest LSN: the follower
+                    # marks itself fresh-as-of "now" when this batch
+                    # brings it level with leaderLsn
+                    "now": obs.now(),
+                    "leaderLsn": self.hub.lsn(name),
                 }
                 if traces:
                     params["traces"] = [[t, s] for t, s in traces]

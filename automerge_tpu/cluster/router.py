@@ -69,7 +69,7 @@ _HANDLE_PARAMS = ("doc", "other", "sync", "session")
 
 _ROUTER_METHODS = frozenset({
     "metrics", "clusterMetrics", "clusterInfo", "clusterMigrate",
-    "clusterJoin", "shutdown"})
+    "clusterJoin", "clusterAdvise", "shutdown"})
 
 
 class _VHandle:
@@ -883,6 +883,8 @@ class ClusterRouter:
             if method == "clusterJoin":
                 return {"id": rid, "result": self._join(
                     int(p["group"]), p["addr"])}
+            if method == "clusterAdvise":
+                return {"id": rid, "result": self._cluster_advise(p)}
             raise ValueError(f"unknown router method {method}")
         except Exception as e:  # noqa: BLE001 — answer, never die
             return {"id": rid, "error": {
@@ -939,6 +941,57 @@ class ClusterRouter:
             "nodes": sorted(bodies_snap),
             "unreachable": unreachable_snap,
         }
+
+    def _cluster_advise(self, p: dict) -> dict:
+        """Gather each group leader's heat table, staleness report and
+        per-doc store tiers, then run the pure placement advisor
+        (cluster/advisor.py) over the combined snapshot. Report-only:
+        the answer ranks and explains, actuation is the caller's call.
+        Unreachable or partial telemetry shrinks the rule set instead
+        of failing the request."""
+        from . import advisor
+
+        groups_out = []
+        for g in self._groups:
+            entry: dict = {"group": g.idx, "leader": g.leader}
+            try:
+                entry["heat"] = self._admin(
+                    g.leader, "heatStatus", {}, timeout=5.0)
+            except Exception as e:  # noqa: BLE001 — advise on what's up
+                entry["error"] = str(e)[:200]
+            try:
+                st = self._admin(
+                    g.leader, "clusterStatus", {}, timeout=5.0)
+                entry["staleness"] = st.get("staleness") or {}
+            except Exception:  # noqa: BLE001
+                pass
+            try:
+                ss = self._admin(
+                    g.leader, "storeStatus", {"docs": True}, timeout=5.0)
+                entry["tiers"] = {
+                    name: info.get("tier")
+                    for name, info in (ss.get("docs") or {}).items()
+                    if isinstance(info, dict)
+                }
+            except Exception:  # noqa: BLE001 — not every node runs a store
+                pass
+            groups_out.append(entry)
+        kwargs = {}
+        for key, snake, cast in (
+            ("maxRecommendations", "max_recommendations", int),
+            ("imbalanceRatio", "imbalance_ratio", float),
+            ("hotFrac", "hot_frac", float),
+            ("stalenessThreshold", "staleness_threshold", float),
+        ):
+            v = p.get(key)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                kwargs[snake] = cast(v)
+        advice = advisor.advise({"groups": groups_out}, **kwargs)
+        if p.get("snapshot"):
+            advice["snapshot"] = {"groups": groups_out}
+        if p.get("format") == "text":
+            advice["text"] = advisor.render_text(advice)
+        return advice
 
     def _join(self, gidx: int, addr: str) -> dict:
         """Admit a (re)joined node into a group as a follower: future

@@ -40,6 +40,7 @@ import atexit
 import json
 import os
 import re
+import signal
 import statistics
 import threading
 import time
@@ -74,6 +75,11 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._seq = 0
         self._installed = False
+        self._signal_installed = False
+        # optional callable returning the history-ring status dict
+        # (obs/history.py sets it when the sampler starts) so every
+        # dump carries the metric trend that led up to it
+        self.history_provider = None
 
     # -- recording (hot-ish paths: one deque append, no locks) ---------------
 
@@ -102,14 +108,29 @@ class FlightRecorder:
     def install(self, directory: str, node_id: str) -> None:
         """Dump into ``directory`` as ``flight-<node_id>-<pid>-<n>.json``
         on process exit (atexit covers clean exits, handled SIGTERM and
-        crash-unwinds); explicit ``dump()`` calls (failover) also land
-        there. Idempotent."""
+        crash-unwinds) and on SIGUSR2 (a wedged-but-alive node can be
+        snapshotted without killing it); explicit ``dump()`` calls
+        (failover) also land there. Idempotent."""
         os.makedirs(directory, exist_ok=True)
         self.dir = directory
         self.node_id = _SAFE_NAME.sub("_", str(node_id))[:64] or "proc"
         if not self._installed:
             self._installed = True
             atexit.register(self._atexit_dump)
+        if not self._signal_installed:
+            # only the main thread may set handlers; an embedding that
+            # installs from a worker thread just skips the signal hook
+            try:
+                signal.signal(signal.SIGUSR2, self._on_sigusr2)
+                self._signal_installed = True
+            except (ValueError, AttributeError, OSError):
+                pass
+
+    def _on_sigusr2(self, signum, frame) -> None:
+        try:
+            self.dump(reason="signal")
+        except Exception:  # noqa: BLE001 — a probe must not kill the node
+            pass
 
     def _atexit_dump(self) -> None:
         try:
@@ -155,6 +176,11 @@ class FlightRecorder:
             "metrics": self._registry.snapshot(),
             "clock_sync": list(self.clock_sync),
         }
+        if self.history_provider is not None:
+            try:
+                doc["history"] = self.history_provider()
+            except Exception:  # noqa: BLE001 — trend data is best-effort
+                pass
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(doc, f)

@@ -376,6 +376,10 @@ def _fmt_num(v) -> str:
     if isinstance(v, float):
         if v == math.inf:
             return "+Inf"
+        if v == -math.inf:
+            return "-Inf"
+        if v != v:
+            return "NaN"
         if v == int(v) and abs(v) < 1e15:
             return str(int(v))
         return repr(v)

@@ -73,6 +73,7 @@ from time import perf_counter
 from typing import Dict, List, Optional
 
 import automerge_tpu.obs as _obs
+from . import heat as _heat
 
 # -- stage taxonomy -----------------------------------------------------------
 
@@ -371,6 +372,11 @@ class CycleProfiler:
                     self._doc_costs.items(), key=lambda kv: -kv[1]
                 )[: self.top_k]
                 self._doc_costs = dict(keep)
+        # attributed drain seconds are the cost half of the heat signal:
+        # a doc can be request-cold but drain-expensive (huge merges),
+        # and the advisor needs to see that
+        for d, s in report["doc_costs"].items():
+            _heat.note(d, "drain_s", s)
         _obs.observe("drain.attributed_fraction", report["attributed_frac"])
         _obs.observe("drain.overlap_fraction", report.get("overlap_frac", 0.0))
         for k, v in report["stages"].items():
@@ -449,6 +455,9 @@ class CycleProfiler:
                 round(hits / (hits + misses), 4) if (hits + misses) else None
             ),
         }
+        # the heat observatory rides along so one perfStatus answer (and
+        # one offline perf-report) shows cost AND demand per document
+        out["heat"] = _heat.snapshot(top=top or self.top_k)
         out["drain_cycle_seconds"] = {
             f"p{int(q * 100)}": round(v, 6)
             for q, v in _obs.percentiles("drain.cycle_seconds").items()
@@ -717,6 +726,17 @@ def render_text(summary: dict, top: Optional[int] = None) -> str:
         lines.append("top docs by attributed seconds:")
         for e in td[: top or len(td)]:
             lines.append(f"  {e['doc']:<32} {e['seconds']:.4f}s")
+    he = (summary.get("heat") or {}).get("entries") or []
+    if he:
+        lines.append("doc heat (decayed per-second rates):")
+        for e in he[: top or len(he)]:
+            rates = "  ".join(
+                f"{k} {v:.2f}/s"
+                for k, v in sorted((e.get("rates") or {}).items())
+            )
+            lines.append(
+                f"  {e['doc']:<32} rank {e.get('rank', 0.0):.2f}  {rates}"
+            )
     jp = summary.get("jax_profiler")
     if jp and jp.get("active"):
         lines.append(f"jax profiler capture ACTIVE -> {jp.get('dir')}")

@@ -66,6 +66,7 @@ from typing import Dict, Optional
 from . import obs
 from .api import AutoDoc
 from .degrade import brownout_active
+from .obs import heat as _heat
 from .sync import SessionConfig, SyncSession, SyncState
 from .types import ActorId, ObjType, ScalarValue
 
@@ -1168,6 +1169,25 @@ class RpcServer:
 
         return prof.jax_profile_stop()
 
+    def heatStatus(self, p):
+        """The doc-heat table (obs/heat.py): ranked per-document
+        read/write/sync/bytes/drain rates. ``{"top": n}`` bounds the
+        entry list. Scraping also refreshes the ``doc.heat`` gauges."""
+        top = p.get("top")
+        _heat.table.publish_gauges()
+        return _heat.snapshot(top=int(top) if top is not None else None)
+
+    def historyStatus(self, p):
+        """The history rings (obs/history.py): downsampled trend slots
+        per allowlisted metric family. ``{"name": fam}`` filters to one
+        family, ``{"tier": 0|1|2}`` to one resolution tier."""
+        from .obs import history
+
+        tier = p.get("tier")
+        return history.status(
+            name=p.get("name"),
+            tier=int(tier) if tier is not None else None)
+
     # -- dispatch -----------------------------------------------------------
 
     # explicit allowlist: getattr dispatch must never reach serve/handle or
@@ -1189,7 +1209,58 @@ class RpcServer:
         "chaosDisk", "docDigest", "scrubNow",
         "storeStatus", "storeDemote", "docFence",
         "metrics", "perfStatus", "profileStart", "profileStop",
+        "heatStatus", "historyStatus",
     })
+
+    # heat-kind classification for the dispatch hook: which methods
+    # count as read / write / sync load against their target document.
+    # Fixed at class scope so the per-request cost is one dict lookup.
+    _HEAT_KINDS = {
+        **dict.fromkeys(
+            ("put", "putObject", "insert", "insertObject", "delete",
+             "increment", "spliceText", "mark", "unmark", "commit",
+             "applyChanges", "merge"), "write"),
+        **dict.fromkeys(
+            ("get", "getAll", "keys", "length", "text", "marks",
+             "getCursor", "getCursorPosition", "materialize",
+             "popPatches", "heads", "save", "saveIncremental"), "read"),
+        **dict.fromkeys(
+            ("generateSyncMessage", "receiveSyncMessage",
+             "syncSessionPoll", "syncSessionReceive",
+             "syncSessionAttach"), "sync"),
+    }
+
+    def _note_heat(self, kind: str, p: dict) -> None:
+        """Attribute one request (and its payload bytes) to its target
+        document's heat entry. Only NAMED durable documents are
+        tracked — the advisor reasons about placeable docs; anonymous
+        handles have nothing to place. Never raises: load accounting
+        must not be able to fail a request."""
+        try:
+            name = None
+            h = p.get("doc")
+            if h is None:
+                s = p.get("session")
+                if s is not None:
+                    h = self._session_docs.get(s)
+            if h is not None:
+                name = self._handle_names.get(h)
+            elif isinstance(p.get("name"), str):
+                name = p["name"]
+            if not name:
+                return
+            _heat.note(name, kind)
+            nb = 0
+            m = p.get("message")
+            if isinstance(m, str):
+                nb += len(m)
+            d = p.get("data")
+            if isinstance(d, str):
+                nb += len(d)
+            if nb:
+                _heat.note(name, "bytes", nb)
+        except Exception:  # noqa: BLE001
+            pass
 
     def handle(self, req: dict) -> dict:
         rid = req.get("id")
@@ -1222,6 +1293,10 @@ class RpcServer:
         return self._dispatch(rid, method, req)
 
     def _dispatch(self, rid, method: str, req: dict) -> dict:
+        if _heat.table.enabled:
+            kind = self._HEAT_KINDS.get(method)
+            if kind is not None:
+                self._note_heat(kind, req.get("params") or {})
         # the span doubles as the per-method request counter (histogram
         # count) and latency distribution (rpc.request{method=...})
         with obs.span("rpc.request", labels={"method": method}):

@@ -257,6 +257,14 @@ class SocketRpcServer:
 
             self.rpc.scrubber = Scrubber(self.rpc)
             self.rpc.scrubber.start()
+        # history rings (obs/history.py): fixed-memory downsampled recent
+        # past of the allowlisted gauges/counters, served by the
+        # historyStatus RPC and dumped with flight recordings. start() is
+        # idempotent and a no-op under AUTOMERGE_TPU_HISTORY=0
+        from ..obs import history
+
+        if history.enabled():
+            history.start()
 
     def serve_forever(self) -> None:
         """start() + block until a ``shutdown`` request (or ``stop()``)."""

@@ -115,6 +115,18 @@ def main():
     reps = env_int("BENCH_REPS", 3)  # best-of-N, one knob for every config
     results = {}
 
+    # per-config wall clock: elapsed seconds between consecutive marks,
+    # summed to a total at the end — the additive number perf_gate tracks
+    # so a config that quietly doubles its setup cost is caught even when
+    # its headline throughput metric holds steady
+    wall_s = {}
+    _wall_prev = [time.perf_counter()]
+
+    def wall_mark(config):
+        now = time.perf_counter()
+        wall_s[config] = round(now - _wall_prev[0], 3)
+        _wall_prev[0] = now
+
     def note(msg):
         if verbose:
             print(msg, file=sys.stderr, flush=True)
@@ -174,6 +186,7 @@ def main():
             "text_len": len(txt),
         }
     note(f"replay: {results['replay']}")
+    wall_mark("replay")
     del doc, doc_b
 
     # ---- config 2: N-way fan-in merge (primary) ----------------------------
@@ -443,6 +456,7 @@ def main():
         "vs_pin": round(dev_rate / RUST_PIN_APPLY, 3),
     }
     note(f"fanin: {results['fanin']}")
+    wall_mark("fanin")
 
     # ---- config 2b: incremental device merge (persistent DeviceDoc) --------
     # K small deltas (one live replica typing against a large resident doc)
@@ -529,6 +543,7 @@ def main():
         print(f"incremental config failed:\n{tb}", file=sys.stderr, flush=True)
     results["incremental"] = inc
     note(f"incremental: {results['incremental']}")
+    wall_mark("incremental")
 
     # ---- config 3: Map+Counter commutative merge ---------------------------
     # BASELINE.json size: 10k actors x 1k increments = ~10M ops
@@ -565,6 +580,7 @@ def main():
         "vs_baseline": round(mc_rate / RUST_PIN_APPLY, 3),
     }
     note(f"mapcounter: {results['mapcounter']}")
+    wall_mark("mapcounter")
     del mlog, mres, mdev, mc_changes, all_mc
 
     # ---- config 4: RGA stress ---------------------------------------------
@@ -595,6 +611,7 @@ def main():
         "vs_pin": round(rga_rate / RUST_PIN_APPLY, 3),
     }
     note(f"rga: {results['rga']}")
+    wall_mark("rga")
     del rlog, rres, rdev, rga_changes, all_rga
 
     # ---- config 5: sync catch-up ------------------------------------------
@@ -675,6 +692,7 @@ def main():
         "vs_baseline": round(sync_rate / RUST_PIN_APPLY, 4),
     }
     note(f"sync: {results['sync']}")
+    wall_mark("sync")
 
     # ---- micro-bench guard: map put/save/load/apply + range iteration ------
     # (reference: rust/automerge/benches/map.rs:48-263, benches/range.rs —
@@ -732,6 +750,7 @@ def main():
     }
     results["micro"] = micro
     note(f"micro: {micro}")
+    wall_mark("micro")
 
     # ---- config: durable write path (journal + compaction + recovery) ------
     # N commits through a DurableDocument: journal append overhead per
@@ -792,6 +811,7 @@ def main():
         shutil.rmtree(tmpd, ignore_errors=True)
     results["durable"] = dur
     note(f"durable: {results['durable']}")
+    wall_mark("durable")
 
     # ---- config: concurrent serving (socket transport + doc shards) --------
     # The serving-layer headline: N concurrent socket clients pipeline a
@@ -1034,6 +1054,7 @@ def main():
         print(f"serve config failed:\n{tb}", file=sys.stderr, flush=True)
     results["serve"] = serve_cfg
     note(f"serve: {results['serve']}")
+    wall_mark("serve")
 
     # ---- config: serve scrub A/B (integrity scrub overhead) ----------------
     # The SAME concurrent socket workload against two fresh servers in
@@ -1335,6 +1356,7 @@ def main():
               flush=True)
     results["serve_batched"] = sb_cfg
     note(f"serve_batched: {results['serve_batched']}")
+    wall_mark("serve_batched")
 
     # ---- config: cluster (replicated serving + leader failover) ------------
     # Three node subprocesses (leader + 2 followers, quorum acks) behind
@@ -1475,6 +1497,7 @@ def main():
         print(f"cluster config failed:\n{tb}", file=sys.stderr, flush=True)
     results["cluster"] = cluster_cfg
     note(f"cluster: {results['cluster']}")
+    wall_mark("cluster")
 
     # ---- config: tiered (bounded-memory residency at many-doc scale) -------
     # N durable documents created and Zipfian-accessed through the REAL
@@ -1740,6 +1763,7 @@ def main():
         print(f"tiered config failed:\n{tb}", file=sys.stderr, flush=True)
     results["tiered"] = tiered_cfg
     note(f"tiered: {results['tiered']}")
+    wall_mark("tiered")
 
     # ---- config: compressed (compute-on-compressed resident columns) -------
     # The same synthetic text+counter workload drained through the
@@ -1868,6 +1892,7 @@ def main():
               flush=True)
     results["compressed"] = comp_cfg
     note(f"compressed: {results['compressed']}")
+    wall_mark("compressed")
 
     # ---- config: overload (admission control + deadline propagation) -------
     # Drive a concurrent durable server far past its saturation point
@@ -2231,6 +2256,8 @@ def main():
         print(f"overload config failed:\n{tb}", file=sys.stderr, flush=True)
     results["overload"] = ol_cfg
     note(f"overload: {results['overload']}")
+    wall_mark("overload")
+    wall_s["total"] = round(sum(wall_s.values()), 3)
 
     out = {
         "metric": "edit_trace_fanin_merge_ops_per_sec",
@@ -2251,6 +2278,10 @@ def main():
         "max_rss_bytes": _resource.getrusage(
             _resource.RUSAGE_SELF).ru_maxrss * 1024,
         "configs": results,
+        # per-config wall clock + total: the additive cost view — a
+        # config whose setup quietly doubles shows up here even when its
+        # headline throughput number holds
+        "wall_s": wall_s,
         # cumulative device-phase attribution across the whole run
         # (trace.time spans: device.extract / h2d / kernel / readback /
         # materialize, merge.host)

@@ -1,0 +1,146 @@
+"""Placement advisor (cluster/advisor.py): a pure, deterministic
+function from a telemetry snapshot to ranked explained report-only
+recommendations — unit-tested on synthetic skew."""
+
+from automerge_tpu.cluster import advisor
+
+
+def _heat(entries):
+    return {"entries": [{"doc": d, "rank": r} for d, r in entries]}
+
+
+def test_empty_snapshot_no_recommendations():
+    out = advisor.advise({})
+    assert out["recommendations"] == []
+    assert out["groups"] == [] and out["groupLoads"] == {}
+
+
+def test_balanced_groups_no_recommendations():
+    snap = {"groups": [
+        {"group": 0, "leader": "a:1", "heat": _heat([("d1", 5.0)])},
+        {"group": 1, "leader": "b:1", "heat": _heat([("d2", 5.0)])},
+    ]}
+    out = advisor.advise(snap)
+    assert out["recommendations"] == []
+    assert out["groupLoads"] == {"0": 5.0, "1": 5.0}
+
+
+def test_imbalance_migrates_cold_ballast():
+    snap = {"groups": [
+        {"group": 0, "leader": "a:1",
+         "heat": _heat([("big", 6.0), ("mid", 5.0), ("small", 1.0),
+                        ("tiny", 0.5)])},
+        {"group": 1, "leader": "b:1", "heat": _heat([("idle", 1.0)])},
+    ]}
+    out = advisor.advise(snap)
+    kinds = [r["kind"] for r in out["recommendations"]]
+    assert kinds and set(kinds) == {"migrate"}
+    # cold ballast moves, never the hottest doc
+    moved = [r["doc"] for r in out["recommendations"]]
+    assert "big" not in moved
+    assert moved[0] in ("tiny", "small", "mid")
+    r = out["recommendations"][0]
+    assert r["group"] == 0 and r["to"] == 1
+    assert "cold ballast" in r["reason"]
+
+
+def test_hot_doc_recommends_replica_not_migration():
+    snap = {"groups": [
+        {"group": 0, "leader": "a:1",
+         "heat": _heat([("viral", 9.0), ("small", 1.0)])},
+        {"group": 1, "leader": "b:1", "heat": _heat([("idle", 1.0)])},
+    ]}
+    out = advisor.advise(snap)
+    recs = out["recommendations"]
+    assert recs[0]["kind"] == "replicate" and recs[0]["doc"] == "viral"
+    assert "read replica" in recs[0]["reason"]
+    assert not any(r["kind"] == "migrate" for r in recs)
+
+
+def test_staleness_attention():
+    snap = {"groups": [
+        {"group": 0, "leader": "a:1", "heat": _heat([("d", 1.0)]),
+         "staleness": {
+             "f1:2": {"computed": {"d": 4.5, "e": 0.1}},
+             "f2:3": {"computed": {"d": 0.0}},
+         }},
+    ]}
+    out = advisor.advise(snap, staleness_threshold=1.0)
+    recs = [r for r in out["recommendations"] if r["kind"] == "staleness"]
+    assert len(recs) == 1
+    r = recs[0]
+    assert r["node"] == "f1:2" and r["doc"] == "d" and r["score"] == 4.5
+    assert "replication" in r["reason"]
+
+
+def test_tier_mismatch_promotes_hot_cold_doc():
+    snap = {"groups": [
+        {"group": 0, "leader": "a:1",
+         "heat": _heat([("hotcold", 8.0), ("ok", 3.0)]),
+         "tiers": {"hotcold": "cold", "ok": "hot"}},
+    ]}
+    out = advisor.advise(snap)
+    recs = out["recommendations"]
+    assert len(recs) == 1
+    assert recs[0]["kind"] == "promote" and recs[0]["doc"] == "hotcold"
+    assert "hydration" in recs[0]["reason"]
+
+
+def test_deterministic_ranking_and_truncation():
+    snap = {"groups": [
+        {"group": 0, "leader": "a:1",
+         "heat": _heat([("viral", 9.0), ("small", 1.0)]),
+         "tiers": {"viral": "warm"},
+         "staleness": {"f:1": {"computed": {"x": 2.0}}}},
+        {"group": 1, "leader": "b:1", "heat": _heat([("idle", 1.0)])},
+    ]}
+    out1 = advisor.advise(snap)
+    out2 = advisor.advise(snap)
+    assert out1 == out2  # pure function, stable ordering
+    scores = [r["score"] for r in out1["recommendations"]]
+    assert scores == sorted(scores, reverse=True)
+    capped = advisor.advise(snap, max_recommendations=1)
+    assert len(capped["recommendations"]) == 1
+    assert capped["recommendations"][0] == out1["recommendations"][0]
+
+
+def test_every_recommendation_has_a_readable_reason():
+    snap = {"groups": [
+        {"group": 0, "leader": "a:1",
+         "heat": _heat([("v", 9.0), ("s", 1.0), ("t", 0.2)]),
+         "tiers": {"v": "cold"},
+         "staleness": {"f:1": {"computed": {"v": 3.0}}}},
+        {"group": 1, "leader": "b:1", "heat": _heat([])},
+    ]}
+    out = advisor.advise(snap)
+    assert out["recommendations"]
+    for r in out["recommendations"]:
+        assert isinstance(r["reason"], str) and len(r["reason"]) > 20
+        assert r["kind"] in ("migrate", "replicate", "staleness", "promote")
+
+
+def test_render_text_shapes():
+    snap = {"groups": [
+        {"group": 0, "leader": "a:1",
+         "heat": _heat([("viral", 9.0), ("small", 1.0)])},
+        {"group": 1, "leader": "b:1", "heat": _heat([("idle", 1.0)])},
+    ]}
+    text = advisor.render_text(advisor.advise(snap))
+    assert "group" in text and "a:1" in text
+    assert "report-only" in text
+    assert "1. [replicate]" in text
+    empty = advisor.render_text(advisor.advise({}))
+    assert "no recommendations" in empty
+
+
+def test_malformed_telemetry_never_raises():
+    snap = {"groups": [
+        {"group": 0, "leader": "a:1", "error": "unreachable"},
+        {"group": 1, "heat": {"entries": None}},
+        {"group": 2, "heat": _heat([("d", 2.0)]),
+         "staleness": {"f": None, "g": {"computed": None}},
+         "tiers": None},
+        "not-a-dict",
+    ]}
+    out = advisor.advise(snap)
+    assert isinstance(out["recommendations"], list)

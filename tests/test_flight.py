@@ -231,3 +231,47 @@ def test_cli_flight_merge_subcommand(tmp_path, capsys):
     # no dumps -> clean failure
     rc = main(["flight-merge", str(tmp_path / "empty_dir_nope")])
     assert rc == 1
+
+
+def test_sigusr2_dumps_flight_recording(tmp_path):
+    import glob
+    import signal
+    import time
+
+    rec = FlightRecorder(obs.recorder, obs.registry)
+    rec.install(str(tmp_path), "sig-node")
+    os.kill(os.getpid(), signal.SIGUSR2)
+    # the handler runs at the next bytecode boundary of the main thread
+    deadline = time.monotonic() + 5.0
+    dumps = []
+    while time.monotonic() < deadline:
+        dumps = glob.glob(str(tmp_path / "flight-sig-node-*.json"))
+        if dumps:
+            break
+        time.sleep(0.01)
+    assert dumps, "SIGUSR2 produced no flight dump"
+    d = json.load(open(dumps[0]))
+    assert d["reason"] == "signal"
+    assert d["node_id"] == "sig-node"
+
+
+def test_dump_carries_history_rings(tmp_path):
+    from automerge_tpu.obs.history import HistoryRing
+    from automerge_tpu.obs.metrics import MetricsRegistry as _Reg
+
+    reg = _Reg()
+    reg.counter("rpc.bytes_in").inc(7)
+    ring = HistoryRing(allowlist=("rpc.bytes_in",), slots=4, registry=reg)
+    ring.sample(now=1.0)
+    ring.sample(now=2.0)
+    rec = FlightRecorder(obs.recorder, obs.registry)
+    rec.install(str(tmp_path), "hist-node")
+    rec.history_provider = ring.status
+    d = json.load(open(rec.dump(reason="test")))
+    hist = d["history"]
+    assert hist["samples"] == 2
+    assert [s["name"] for s in hist["series"]] == ["rpc.bytes_in"]
+    # a broken provider never breaks the dump itself
+    rec.history_provider = lambda: (_ for _ in ()).throw(RuntimeError())
+    d2 = json.load(open(rec.dump(reason="test2")))
+    assert "history" not in d2

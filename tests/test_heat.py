@@ -1,0 +1,122 @@
+"""Doc-heat accounting (obs/heat.py): bounded space-saving table of
+per-document decayed rates, deterministic under explicit clocks, and
+gauge-publication hygiene (stale series removed)."""
+
+import math
+
+from automerge_tpu import obs
+from automerge_tpu.obs import heat
+from automerge_tpu.obs.heat import HeatTable
+
+
+def test_note_and_snapshot_rates():
+    t = HeatTable(cap=8, half_life=60.0, enabled=True)
+    for _ in range(10):
+        t.note("a", "read", now=100.0)
+    t.note("a", "bytes", 4096, now=100.0)
+    snap = t.snapshot(now=100.0)
+    assert snap["docs"] == 1 and snap["evictions"] == 0
+    e = snap["entries"][0]
+    assert e["doc"] == "a"
+    # 10 undecayed read events -> rank 10, rate 10 * ln2 / half_life
+    assert e["rank"] == 10.0
+    assert math.isclose(e["rates"]["read"], 10 * math.log(2) / 60.0)
+    assert e["totals"]["read"] == 10.0 and e["totals"]["bytes"] == 4096.0
+    # bytes do not contribute to rank (unit mismatch would drown counts)
+    t.note("b", "bytes", 1e9, now=100.0)
+    snap = t.snapshot(now=100.0)
+    assert [x["doc"] for x in snap["entries"]] == ["a", "b"]
+    assert snap["entries"][1]["rank"] == 0.0
+
+
+def test_decay_half_life():
+    t = HeatTable(cap=8, half_life=10.0, enabled=True)
+    t.note("a", "write", 8.0, now=0.0)
+    e = t.snapshot(now=10.0)["entries"][0]
+    assert math.isclose(e["rank"], 4.0)  # one half-life
+    e = t.snapshot(now=30.0)["entries"][0]
+    assert math.isclose(e["rank"], 1.0)  # three half-lives
+    # totals never decay
+    assert e["totals"]["write"] == 8.0
+
+
+def test_cap_is_bounded_and_space_saving_eviction():
+    t = HeatTable(cap=4, half_life=60.0, enabled=True)
+    # one genuinely hot doc, then a stream of cold one-shot docs
+    for _ in range(100):
+        t.note("hot", "read", now=0.0)
+    for i in range(50):
+        t.note(f"cold{i}", "read", now=0.0)
+    snap = t.snapshot(now=0.0)
+    assert snap["docs"] <= 4  # bounded by construction
+    assert snap["evictions"] > 0
+    # the hot doc survives the cold stream (the space-saving guarantee)
+    assert snap["entries"][0]["doc"] == "hot"
+    assert snap["entries"][0]["rank"] >= 100.0
+    # a late newcomer inherits the victim's rank as its error bound
+    late = [e for e in snap["entries"] if e["doc"] != "hot"]
+    assert all(e["err"] >= 1.0 for e in late)
+
+
+def test_disabled_table_records_nothing():
+    t = HeatTable(cap=4, enabled=False)
+    t.note("a", "read", now=0.0)
+    assert t.snapshot(now=0.0)["entries"] == []
+    assert t.snapshot(now=0.0)["enabled"] is False
+
+
+def test_unknown_kind_and_empty_doc_ignored():
+    t = HeatTable(cap=4, enabled=True)
+    t.note("", "read", now=0.0)
+    t.note("a", "nonsense", now=0.0)
+    assert t.snapshot(now=0.0)["entries"] == []
+
+
+def test_forget_and_reset():
+    t = HeatTable(cap=4, enabled=True)
+    t.note("a", "read", now=0.0)
+    t.note("b", "read", now=0.0)
+    assert t.forget("a") is True
+    assert t.forget("a") is False
+    assert [e["doc"] for e in t.snapshot(now=0.0)["entries"]] == ["b"]
+    t.reset()
+    assert t.snapshot(now=0.0)["docs"] == 0
+
+
+def test_snapshot_deterministic_order_and_top():
+    t = HeatTable(cap=8, half_life=60.0, enabled=True)
+    for d in ("z", "m", "a"):
+        t.note(d, "read", 5.0, now=0.0)  # identical ranks
+    docs = [e["doc"] for e in t.snapshot(now=0.0)["entries"]]
+    assert docs == ["a", "m", "z"]  # ties broken by name
+    t.note("hotter", "read", 9.0, now=0.0)
+    snap = t.snapshot(now=0.0, top=2)
+    assert [e["doc"] for e in snap["entries"]] == ["hotter", "a"]
+    assert snap["docs"] == 4  # top= truncates entries, not the count
+
+
+def test_publish_gauges_removes_stale_series():
+    obs.reset_all()
+    t = HeatTable(cap=8, half_life=60.0, enabled=True)
+    t.note("a", "read", 10.0, now=0.0)
+    t.note("b", "read", 5.0, now=0.0)
+    assert t.publish_gauges(top=2, now=0.0) == 2
+    names = {(e["labels"].get("doc"), e["labels"].get("kind"))
+             for e in obs.snapshot() if e["name"] == "doc.heat"}
+    assert names == {("a", "read"), ("b", "read")}
+    # b falls out of the top set -> its series must disappear
+    t.note("c", "write", 20.0, now=0.0)
+    t.publish_gauges(top=2, now=0.0)
+    names = {(e["labels"].get("doc"), e["labels"].get("kind"))
+             for e in obs.snapshot() if e["name"] == "doc.heat"}
+    assert names == {("a", "read"), ("c", "write")}
+    obs.reset_all()
+
+
+def test_global_table_hooks():
+    heat.reset()
+    heat.note("gdoc", "sync", now=0.0)
+    snap = heat.snapshot(now=0.0)
+    assert any(e["doc"] == "gdoc" for e in snap["entries"])
+    heat.reset()
+    assert heat.snapshot(now=0.0)["docs"] == 0

@@ -1,0 +1,136 @@
+"""History rings (obs/history.py): fixed-memory downsampled metric
+trends — counter deltas stay additive across tiers, gauge max/last
+envelopes stay true, and nothing any input does can grow the rings."""
+
+from automerge_tpu.obs.history import TIERS, HistoryRing
+from automerge_tpu.obs.metrics import MetricsRegistry
+
+
+def _ring(allowlist, slots=8, cap=64):
+    reg = MetricsRegistry()
+    return reg, HistoryRing(allowlist=allowlist, slots=slots, cap=cap,
+                            registry=reg)
+
+
+def test_counter_deltas_per_slot():
+    reg, ring = _ring(("c.x",))
+    c = reg.counter("c.x")
+    ring.sample(now=1.0)          # baseline: first sample's delta is 0
+    c.inc(3)
+    ring.sample(now=2.0)
+    c.inc(2)
+    ring.sample(now=3.0)
+    slots = ring.series("c.x", tier=0)
+    assert [s["delta"] for s in slots] == [0.0, 3.0, 2.0]
+    assert [s["t"] for s in slots] == [1.0, 2.0, 3.0]
+
+
+def test_counter_reset_protection():
+    reg, ring = _ring(("c.x",))
+    c = reg.counter("c.x")
+    c.inc(10)
+    ring.sample(now=1.0)
+    reg.reset()                   # process restart: total drops to 0
+    reg.counter("c.x").inc(4)
+    ring.sample(now=2.0)
+    deltas = [s["delta"] for s in ring.series("c.x", tier=0)]
+    assert deltas[-1] >= 0.0      # never a negative rate
+
+
+def test_counter_aggregates_across_label_sets():
+    reg, ring = _ring(("c.x",))
+    reg.counter("c.x", k="a").inc(2)
+    reg.counter("c.x", k="b").inc(5)
+    ring.sample(now=1.0)
+    reg.counter("c.x", k="a").inc(1)
+    ring.sample(now=2.0)
+    assert ring.series("c.x", tier=0)[-1]["delta"] == 1.0
+
+
+def test_gauge_max_and_last():
+    reg, ring = _ring(("g.x",))
+    reg.gauge("g.x", n="1").set(5.0)
+    reg.gauge("g.x", n="2").set(9.0)
+    ring.sample(now=1.0)
+    s = ring.series("g.x", tier=0)[-1]
+    assert s["max"] == 9.0 and s["last"] == 9.0
+
+
+def test_downsampling_preserves_delta_sums_and_max_envelope():
+    reg, ring = _ring(("c.x", "g.x"), slots=200)
+    c = reg.counter("c.x")
+    g = reg.gauge("g.x")
+    per1 = int(round(TIERS[1] / TIERS[0]))
+    per2 = int(round(TIERS[2] / TIERS[1]))
+    n = per1 * per2               # exactly one tier-2 slot's worth
+    total = 0
+    peak = 0.0
+    for i in range(n):
+        c.inc(i % 3)
+        total += i % 3
+        val = float((i * 7) % 11)
+        peak = max(peak, val)
+        g.set(val)
+        ring.sample(now=float(i + 1))
+    t1 = ring.series("c.x", tier=1)
+    assert len(t1) == per2
+    # additivity: the coarse deltas sum to everything except the first
+    # sample's baseline (delta 0), i.e. to the true total
+    assert sum(s["delta"] for s in t1) == float(total)
+    t2 = ring.series("c.x", tier=2)
+    assert len(t2) == 1 and t2[0]["delta"] == float(total)
+    # the gauge spike envelope survives both downsampling folds
+    assert ring.series("g.x", tier=1)[0]["max"] <= peak
+    assert ring.series("g.x", tier=2)[0]["max"] == peak
+
+
+def test_rings_are_bounded():
+    reg, ring = _ring(("c.x",), slots=4)
+    c = reg.counter("c.x")
+    for i in range(1000):
+        c.inc()
+        ring.sample(now=float(i))
+    for tier in range(len(TIERS)):
+        assert len(ring.series("c.x", tier=tier)) <= 4
+    assert ring.samples == 1000
+
+
+def test_series_cap_counts_dropped():
+    reg, ring = _ring(tuple(f"m{i}" for i in range(8)), cap=3)
+    for i in range(8):
+        reg.counter(f"m{i}").inc()
+    ring.sample(now=1.0)
+    st = ring.status()
+    assert len(st["series"]) == 3
+    assert st["droppedSeries"] == 5
+
+
+def test_allowlist_filters():
+    reg, ring = _ring(("wanted",))
+    reg.counter("wanted").inc()
+    reg.counter("unwanted").inc()
+    reg.gauge("also.unwanted").set(1)
+    ring.sample(now=1.0)
+    assert [s["name"] for s in ring.status()["series"]] == ["wanted"]
+
+
+def test_status_filters_and_reset():
+    reg, ring = _ring(("a", "b"))
+    reg.counter("a").inc()
+    reg.gauge("b").set(2)
+    ring.sample(now=1.0)
+    st = ring.status(name="b")
+    assert [s["name"] for s in st["series"]] == ["b"]
+    st = ring.status(tier=1)
+    assert all(list(s["tiers"].keys()) == ["1"] for s in st["series"])
+    ring.reset()
+    assert ring.status()["series"] == [] and ring.samples == 0
+
+
+def test_background_sampler_start_stop():
+    reg, ring = _ring(("c.x",))
+    reg.counter("c.x").inc()
+    assert ring.start() is True
+    assert ring.start() is False  # idempotent
+    ring.stop()
+    assert ring._thread is None

@@ -691,3 +691,70 @@ def test_disabled_path_overhead_is_bounded():
     assert t < 500e-6, f"span cost {t * 1e6:.1f}us"
     t = timeit.timeit(lambda: obs.count("ovh.count"), number=n) / n
     assert t < 200e-6, f"count cost {t * 1e6:.1f}us"
+
+
+# -- parse/merge edge cases: empty families, non-finite values, buckets ------
+
+
+def test_parse_prometheus_zero_sample_family():
+    from automerge_tpu.obs.metrics import merge_prometheus
+
+    text = "# HELP lonely no samples yet\n# TYPE lonely counter\n"
+    assert parse_prometheus(text) == {}
+    # a zero-sample family merges away without crashing the scrape
+    merged = merge_prometheus({"n1": text})
+    assert parse_prometheus(merged) == {}
+
+
+def test_nonfinite_gauges_render_parse_and_merge():
+    from automerge_tpu.obs.metrics import merge_prometheus
+
+    reg = MetricsRegistry()
+    reg.gauge("g", k="nan").set(float("nan"))
+    reg.gauge("g", k="pinf").set(float("inf"))
+    reg.gauge("g", k="ninf").set(float("-inf"))
+    reg.gauge("g", k="fin").set(1.5)
+    text = reg.render_prometheus()
+    # the Prometheus exposition spellings, not Python's repr
+    assert 'g{k="pinf"} +Inf' in text
+    assert 'g{k="ninf"} -Inf' in text
+    assert 'g{k="nan"} NaN' in text
+    parsed = parse_prometheus(text)
+    assert parsed[("g", (("k", "pinf"),))] == math.inf
+    assert parsed[("g", (("k", "ninf"),))] == -math.inf
+    assert math.isnan(parsed[("g", (("k", "nan"),))])
+    assert parsed[("g", (("k", "fin"),))] == 1.5
+    # and the multi-node merge keeps them intact under the node label
+    merged = merge_prometheus({"a": text})
+    parsed = parse_prometheus(merged)
+    assert parsed[("g", (("k", "pinf"), ("node", "a")))] == math.inf
+    assert math.isnan(parsed[("g", (("k", "nan"), ("node", "a")))])
+
+
+def test_merged_histogram_buckets_stay_cumulative_monotone():
+    from automerge_tpu.obs.metrics import merge_prometheus
+
+    a, b = MetricsRegistry(), MetricsRegistry()
+    for v in (0.0005, 0.002, 0.9):
+        a.histogram("lat").observe(v)
+    for v in (40.0, 150.0, 151.0, 0.001):
+        b.histogram("lat").observe(v)
+    merged = merge_prometheus({"a": a.render_prometheus(),
+                               "b": b.render_prometheus()})
+    parsed = parse_prometheus(merged)
+    for node, n_obs in (("a", 3), ("b", 4)):
+        rows = []
+        for (name, labels), v in parsed.items():
+            if name != "lat_bucket" or ("node", node) not in labels:
+                continue
+            le = dict(labels)["le"]
+            rows.append((math.inf if le == "+Inf" else float(le), v))
+        rows.sort()
+        assert rows, f"no buckets for node {node}"
+        # cumulative-monotone: counts never decrease with the bound
+        counts = [v for _, v in rows]
+        assert counts == sorted(counts)
+        # the +Inf bucket equals the series count exactly
+        assert rows[-1][0] == math.inf
+        assert rows[-1][1] == float(n_obs)
+        assert parsed[("lat_count", (("node", node),))] == float(n_obs)
