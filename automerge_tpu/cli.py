@@ -318,6 +318,14 @@ def cmd_journal_info(args) -> int:
             os.path.getsize(spath) if os.path.exists(spath) else None
         ),
     }
+    if os.path.exists(spath):
+        from .storage.runsnap import MAGIC as _ARSN_MAGIC
+
+        with open(spath, "rb") as f:
+            head = f.read(len(_ARSN_MAGIC))
+        info["snapshot_codec"] = (
+            "runsnap" if head == _ARSN_MAGIC else "chunk"
+        )
     rc = 0
     if getattr(args, "verify", False):
         # deep read-back scan (the scrubber's own core): every journal

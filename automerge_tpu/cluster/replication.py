@@ -526,7 +526,10 @@ class ReplicationHub:
         if not st.dd.lock.acquire(timeout=self.ack_timeout):
             raise ReplicationError(f"snapshot of {name!r}: doc lock busy")
         try:
-            data = st.dd._core.save()
+            # the on-disk codec verbatim (run-coded when enabled): the
+            # follower hydrates the same bytes the leader's disk holds,
+            # no encode here / no re-encode there
+            data = st.dd.snapshot_bytes()
             with self._lock:
                 lsn = st.lsn
         finally:

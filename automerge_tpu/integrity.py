@@ -227,8 +227,21 @@ def verify_snapshot_bytes(data: bytes) -> VerifyReport:
     """Strict sequential chunk walk over snapshot bytes: every chunk
     must parse at the exact expected offset and carry a valid checksum —
     no resynchronisation (``scan_chunks``'s carving tolerance is a
-    recovery posture; verification wants the first bad byte)."""
+    recovery posture; verification wants the first bad byte).
+
+    Run-coded (ARSN) snapshots verify section-by-section instead: a
+    per-section CRC walk plus a chunk-checksum walk over the embedded
+    change chunks and a full structural decode, reporting the offset of
+    the first bad section (units = sections)."""
+    from .storage import runsnap
     from .storage.chunk import parse_chunk
+
+    if runsnap.is_runsnap(data):
+        r = runsnap.verify_container(data)
+        return VerifyReport(
+            r["ok"], "snapshot", r["total_bytes"], r["valid_bytes"],
+            r["first_bad_offset"], r["units"], r["reason"] or "",
+        )
 
     pos = 0
     units = 0

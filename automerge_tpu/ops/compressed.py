@@ -307,6 +307,33 @@ class CompressedOpColumns:
         self.covered: Dict[str, int] = {}
         self.demoted: Dict[str, str] = {}
 
+    # -- introspection -------------------------------------------------------
+
+    def all_dense(self, names) -> bool:
+        """True when every tracked column in ``names`` is dense-demoted —
+        the snapshot writer's short-circuit signal: there are no run
+        tables to serialize, so the compressed-encode walk can be skipped
+        entirely (storage/runsnap.py counts ``compact.dense_shortcut``).
+        Zero-row run entries (e.g. empty pred columns) count as dense-
+        compatible: they hold no runs either way."""
+        seen_dense = False
+        for nm in names:
+            e = self.entries.get(nm)
+            if e is _DENSE:
+                seen_dense = True
+            elif e is None or e.n:
+                return False
+        return seen_dense
+
+    def runs_for(self, name: str, rows: int):
+        """The live StrideRuns for ``name`` iff it covers exactly
+        ``rows`` rows; None for dense-demoted / stale / untracked
+        columns (callers then serialize the dense array verbatim)."""
+        ent = self.entries.get(name)
+        if ent is None or ent is _DENSE or ent.n != rows:
+            return None
+        return ent
+
     # -- maintenance ---------------------------------------------------------
 
     def _sync_col(self, name: str, mode: str, arr, total: int,

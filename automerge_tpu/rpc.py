@@ -498,6 +498,7 @@ class RpcServer:
 
     def durableInfo(self, p):
         doc = self._durable_doc(p)
+        img = getattr(doc, "_run_image", None)
         return {
             "path": doc.path,
             "journalRecords": doc.journal.record_count,
@@ -505,6 +506,11 @@ class RpcServer:
             "fsync": doc.journal.fsync_policy,
             "degraded": doc.degraded,
             "poisoned": doc.journal.poisoned_reason,
+            # run-coded persistence surface: which codec the doc's
+            # snapshot/image currently speaks, and the retained image's
+            # host footprint (0 = legacy/chunk, no image retained)
+            "snapshotCodec": "runsnap" if img is not None else "chunk",
+            "runImageBytes": 0 if img is None else img.nbytes,
         }
 
     def durableReopen(self, p):

@@ -168,6 +168,12 @@ class DurableDocument:
         self.last_access = obs.now()
         self._touch_exported = 0.0
         self.device_doc = None  # set by open(device=True)
+        # the parsed run-coded snapshot image (storage/runsnap.py), when
+        # the on-disk snapshot is ARSN: a valid prefix of the history
+        # forever (history is append-only), so warm→hot promotion and the
+        # next compaction rebuild their OpLog from run tables + a tail
+        # append instead of re-extracting columns from every change
+        self._run_image = None
         # incremental state digest (integrity.py): the XOR-of-change-
         # hashes accumulator tracks the in-memory HISTORY (fed by the
         # change listener, rebuilt on open), so two documents agree on
@@ -247,12 +253,35 @@ class DurableDocument:
                  background_compact=False,
                  compact_cost_ratio=0.0) -> "DurableDocument":
         """Snapshot load + journal replay, under the already-held lock."""
+        from . import runsnap
+
         snap_path = posixpath.join(path, SNAPSHOT_NAME)
         snap_bytes = 0
+        run_image = None
         if fs.exists(snap_path):
             snap = fs.read_bytes(snap_path)
             snap_bytes = len(snap)
-            core.load_incremental(snap, on_partial="salvage")
+            if runsnap.is_runsnap(snap):
+                try:
+                    run_image = runsnap.parse(snap)
+                    core.apply_changes(run_image.changes)
+                except runsnap.RunSnapError:
+                    # corrupt ARSN container: the embedded change chunks
+                    # are magic-prefixed, so the legacy salvage scan
+                    # carves whatever survives — same degradation as a
+                    # damaged chunk snapshot
+                    run_image = None
+                    core.load_incremental(snap, on_partial="salvage")
+            else:
+                core.load_incremental(snap, on_partial="salvage")
+            obs.count(
+                "store.hydrate_bytes", n=snap_bytes,
+                labels={"codec": "runsnap" if run_image is not None else "chunk"},
+            )
+        if run_image is not None and run_image.n_changes != len(core.history):
+            # partial apply (causally incomplete container): the image no
+            # longer names a history prefix, drop it
+            run_image = None
         dev = None
         if device:
             from ..ops.device_doc import DeviceDoc
@@ -261,9 +290,21 @@ class DurableDocument:
             # an empty history still gets a resident DeviceDoc: a fresh
             # device-mode doc starts tracking from its first sync feed
             with obs.span("device.recover", phase="snapshot"):
-                dev = DeviceDoc.resolve(
-                    OpLog.from_changes([a.stored for a in core.history])
-                )
+                log = None
+                if run_image is not None:
+                    try:
+                        log = run_image.to_oplog(
+                            [a.stored for a in core.history]
+                        )
+                    except Exception:
+                        log = None
+                if log is None:
+                    if core.history:
+                        obs.count("oplog.hydrate_reencode")
+                    log = OpLog.from_changes(
+                        [a.stored for a in core.history]
+                    )
+                dev = DeviceDoc.resolve(log)
         meta: Dict[str, bytes] = {}
         replayed: List = []
         for rec in records:
@@ -307,6 +348,7 @@ class DurableDocument:
             dev.obs_name = dd.obs_name
             dev._export_doc_gauges()
         dd._last_snapshot_bytes = snap_bytes
+        dd._run_image = run_image
         # full digest rebuild, once per open — every later change folds
         # in incrementally through the listener below
         dd._digest.recompute(a.stored.hash for a in core.history)
@@ -459,6 +501,22 @@ class DurableDocument:
         self.device_doc = None
         if dev is not None:
             obs.remove_doc_gauges(self.obs_name, device_only=True)
+            # retain the run-coded column image of the dropped mirror: the
+            # next warm→hot promotion (or compaction) rebuilds from run
+            # tables instead of re-extracting every change — zero-encode
+            # residency transitions even before any compact() has written
+            # an ARSN snapshot
+            from . import runsnap
+
+            if runsnap.enabled():
+                try:
+                    idx = self._core.history_index
+                    if len(dev.log.changes) == len(self._core.history) and all(
+                        c.hash in idx for c in dev.log.changes
+                    ):
+                        self._run_image = runsnap.RunImage.from_log(dev.log)
+                except Exception:
+                    pass
         return dev
 
     def build_device_mirror(self):
@@ -472,9 +530,16 @@ class DurableDocument:
 
         with self.lock:
             with obs.span("device.recover", phase="promote"):
-                dev = DeviceDoc.resolve(
-                    OpLog.from_changes([a.stored for a in self._core.history])
-                )
+                hist = [a.stored for a in self._core.history]
+                # the retained run image makes promotion decode-only:
+                # run tables expand (np.repeat) and the journal tail
+                # splices in — no per-change column re-extraction
+                log = self._image_log(hist)
+                if log is None:
+                    if hist:
+                        obs.count("oplog.hydrate_reencode")
+                    log = OpLog.from_changes(hist)
+                dev = DeviceDoc.resolve(log)
             dev.obs_name = self.obs_name
             self.device_doc = dev
             dev._export_doc_gauges()
@@ -688,12 +753,95 @@ class DurableDocument:
             except Exception as e:  # noqa: BLE001 — background must not die
                 obs.count("compact.background_error", error=str(e)[:200])
 
+    def _image_log(self, hist):
+        """An OpLog covering ``hist`` rebuilt from the retained run image
+        (decode + tail append — zero re-encode of covered changes), or
+        None when no image applies."""
+        img = self._run_image
+        if img is None or img.n_changes > len(hist):
+            return None
+        try:
+            hset = set(img.change_hashes())
+            idx = self._core.history_index
+            if len(hset) != img.n_changes or not all(h in idx for h in hset):
+                return None
+            log = img.to_oplog()
+            tail = [c for c in hist if c.hash not in hset]
+            if len(tail) != len(hist) - img.n_changes:
+                return None
+            if tail and log.append_changes(tail) is None:
+                return None
+            return log
+        except Exception:
+            return None
+
+    def _snapshot_log(self):
+        """An OpLog of exactly the committed history, preferring sources
+        that already hold the run-coded columns: the resident device
+        mirror, then the retained snapshot image plus a journal-tail
+        append (the incremental merge — only the fresh changes are
+        extracted and spliced), and only as a last resort a full
+        ``from_changes`` rebuild (counted: ``compact.image_rebuild``)."""
+        hist = [a.stored for a in self._core.history]
+        dev = self.device_doc
+        if dev is not None:
+            try:
+                idx = self._core.history_index
+                if len(dev.log.changes) == len(hist) and all(
+                    c.hash in idx for c in dev.log.changes
+                ):
+                    return dev.log
+            except Exception:
+                pass
+        log = self._image_log(hist)
+        if log is not None:
+            return log
+        from ..ops.oplog import OpLog
+
+        obs.count("compact.image_rebuild")
+        return OpLog.from_changes(hist)
+
+    def _build_snapshot(self):
+        """The snapshot file bytes for the current committed history:
+        ``(data, image)`` where ``image`` is the parsed run-coded image
+        (retained for future hydrations), or ``(legacy bytes, None)``
+        when run-coded persistence is disabled or inapplicable."""
+        from . import runsnap
+
+        if runsnap.enabled():
+            try:
+                log = self._snapshot_log()
+                data = runsnap.encode_snapshot(log, self._core.get_heads())
+                return data, runsnap.parse(data)
+            except runsnap.RunSnapError:
+                obs.count("compact.runsnap_fallback")
+        return self._core.save(), None
+
+    def snapshot_bytes(self) -> bytes:
+        """The full-history snapshot in the on-disk codec — the same
+        bytes ``compact()`` would write, shipped verbatim by replication
+        catch-up (``replSnapshot``/``replReset``) and cold migration so
+        the receiver hydrates without a re-encode on either end."""
+        with self.lock:
+            data, image = self._build_snapshot()
+            if image is not None:
+                self._run_image = image
+            return data
+
     def compact(self) -> bool:
         """Snapshot-then-truncate: write the full save to a temp file,
         fsync it, atomically rename over the snapshot, fsync the
         directory entry, then truncate the journal (metadata records are
         re-appended so they survive). Every step durable before the next
-        — the orderings the crash suite proves are exactly these."""
+        — the orderings the crash suite proves are exactly these.
+
+        The snapshot is the run-coded image (storage/runsnap.py) unless
+        ``AUTOMERGE_TPU_RUNSNAP=0``; successive compactions merge only
+        the journal tail into the retained image (incremental, column-
+        by-column) instead of re-extracting the whole history, and the
+        ``maybe_compact`` cost gate (``compact_cost_ratio``) bounds
+        write amplification: ``compact.bytes_written`` vs
+        ``compact.tail_bytes_retired`` is the model's measured ratio."""
         with self.lock:
             if (
                 self._compacting
@@ -712,7 +860,8 @@ class DurableDocument:
                     # must cover — and a background compaction must not
                     # side-effect-commit a half-built autocommit tx out
                     # from under a mutating thread (host.save() would)
-                    data = self._core.save()
+                    tail_bytes = self._journal.size_bytes
+                    data, image = self._build_snapshot()
                     snap = posixpath.join(self.path, SNAPSHOT_NAME)
                     tmp = snap + ".tmp"
                     with obs.span("compact.snapshot", bytes=len(data)):
@@ -740,6 +889,13 @@ class DurableDocument:
                             )
                         self._journal.sync()
                 obs.count("compact.runs")
+                # write-amplification accounting: bytes rewritten vs the
+                # journal tail this compaction retired — the cost model's
+                # two sides, summable across a run
+                obs.count("compact.bytes_written", n=len(data))
+                obs.count("compact.tail_bytes_retired", n=tail_bytes)
+                if image is not None:
+                    self._run_image = image
                 self._last_snapshot_bytes = len(data)
                 # the snapshot carries the FULL in-memory history, so disk
                 # is caught up even if a journal append had failed earlier
@@ -824,9 +980,29 @@ class DurableDocument:
         """Catch-up path for a new or lagging follower: load a full
         leader snapshot (known changes deduplicate on the history index,
         so re-snapshotting after failover converges instead of erroring)
-        and persist the new cursor under the same ack scope."""
+        and persist the new cursor under the same ack scope.
+
+        A run-coded (ARSN) snapshot applies through its verbatim change
+        chunks — the same bytes the leader's disk holds — and, when this
+        follower was empty, the decoded image is adopted so the follower's
+        own hydrations and compactions start run-coded too. Corruption
+        raises (``on_partial="error"`` semantics: a shipped snapshot is
+        never silently partial)."""
+        from . import runsnap
+
         with self.lock, self.ack_scope():
-            self.load_incremental(data, on_partial="error")
+            if runsnap.is_runsnap(data):
+                image = runsnap.parse(data)  # RunSnapError on corruption
+                was_empty = not self._core.history
+                self.apply_changes(image.changes)
+                obs.count("store.hydrate_bytes", n=len(data),
+                          labels={"codec": "runsnap"})
+                if was_empty and len(self._core.history) == image.n_changes:
+                    self._run_image = image
+            else:
+                obs.count("store.hydrate_bytes", n=len(data),
+                          labels={"codec": "chunk"})
+                self.load_incremental(data, on_partial="error")
             if cursor is not None:
                 self.set_meta(REPL_CURSOR_KEY, cursor)
 

@@ -55,6 +55,7 @@ from .policy import (
     TIER_WARM,
     DocStats,
     StoreBudgets,
+    compact_on_demote,
     current_rss_bytes,
     device_resident_bytes,
     pick_demotions,
@@ -323,10 +324,14 @@ class DocStore:
                 obs.count("store.demotions", labels={
                     "from": TIER_HOT, "to": TIER_WARM, "reason": reason})
             if to == TIER_COLD:
-                jb = 0
+                compact = False
                 if e.doc is not None:
-                    jb = e.doc.journal.size_bytes
-                compact = jb >= self.budgets.cold_compact_min_bytes
+                    compact = compact_on_demote(
+                        e.doc.journal.size_bytes,
+                        getattr(e.doc, "_run_image", None) is not None,
+                        len(e.doc._core.history),
+                        self.budgets,
+                    )
                 self.ops.close_cold(name, compact=compact)
                 with self._lock:
                     self._counts[e.tier] -= 1
@@ -501,6 +506,11 @@ def _resident_bytes(dd) -> int:
     try:
         n = (DOC_OVERHEAD_BYTES + getattr(dd, "_last_snapshot_bytes", 0)
              + dd.journal.size_bytes)
+        # the retained run-coded image (storage/runsnap.py) is real host
+        # memory a warm doc holds to make promotion/compaction decode-only
+        img = getattr(dd, "_run_image", None)
+        if img is not None:
+            n += img.nbytes
     except Exception:  # closed mid-estimate
         return 0
     dev = getattr(dd, "device_doc", None)

@@ -127,6 +127,24 @@ class Demotion:
     reason: str
 
 
+def compact_on_demote(journal_bytes: int, has_run_image: bool,
+                      history_len: int, budgets: StoreBudgets) -> bool:
+    """Should a warm→cold demotion compact before closing?
+
+    The cost side of the tiering model: a journal smaller than
+    ``cold_compact_min_bytes`` is cheaper to replay on the next hydrate
+    than to re-snapshot now — UNLESS the document has no run-coded image
+    yet (legacy-format or absent snapshot), in which case one compaction
+    here converts the cold copy to the run-coded format and every later
+    hydration becomes decode-only. Write-hot docs therefore keep short
+    tails; read-mostly docs converge to a pure image."""
+    if journal_bytes >= budgets.cold_compact_min_bytes:
+        return True
+    from ..storage import runsnap
+
+    return runsnap.enabled() and not has_run_image and history_len > 0
+
+
 def device_resident_bytes(dev) -> int:
     """Device-path footprint of one resident ``DeviceDoc`` mirror, as
     the admission/demotion policy should see it: TRUE resident bytes —

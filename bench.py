@@ -2257,6 +2257,181 @@ def main():
     results["overload"] = ol_cfg
     note(f"overload: {results['overload']}")
     wall_mark("overload")
+
+    # ---- config: persistence (run-coded snapshot codec vs legacy chunk) ----
+    # One column format from disk to device. A: cold-open latency of the
+    # SAME document persisted as a run-coded ARSN image (the default
+    # writer) vs the legacy chunk codec (AUTOMERGE_TPU_RUNSNAP=0) —
+    # percentiles over repeated from-disk opens, plus hydrate-to-first-
+    # read (open + first value read) for each codec. The zero-re-encode
+    # contract rides along: a device-mirror build after a run-coded open
+    # must not advance oplog.hydrate_reencode, and the chunk path MUST
+    # (non-vacuous counter). B: compaction write amplification — the
+    # cost-gated compactor (compact_cost_ratio: defer while the journal
+    # tail is cheaper than the image rewrite) vs full-rewrite-at-every-
+    # threshold, in snapshot bytes written per committed op.
+    ps_cfg = {}
+    try:
+        if env_flag("BENCH_PERSISTENCE", "1") != "0":
+            import shutil
+            import tempfile
+
+            from automerge_tpu.storage.durable import SNAPSHOT_NAME
+
+            ps_ops = env_int("BENCH_PS_OPS", 100_000)
+            ps_opens = env_int("BENCH_PS_OPENS", 12)
+            ps_commits = env_int("BENCH_PS_COMMITS", 600)
+            ps_every = env_int("BENCH_PS_COMPACT_EVERY", 48)
+            ps_ratio = float(env_flag("BENCH_PS_COST_RATIO", "4.0"))
+
+            ps_dir = tempfile.mkdtemp(prefix="amtpu_bench_ps_")
+            try:
+                run_path = os.path.join(ps_dir, "run")
+                chunk_path = os.path.join(ps_dir, "chunk")
+                dd = AutoDoc.open(
+                    run_path, fsync="never",
+                    actor=ActorId(bytes([21]) * 16),
+                )
+                tob = dd.put_object("_root", "text", ObjType.TEXT)
+                dd.put("_root", "probe", 1)
+                dd.commit()
+                edits = trace[:ps_ops]
+                step = max(1, min(2000, max(1, len(edits) // 64)))
+                for lo in range(0, len(edits), step):
+                    W.apply_edits(dd, tob, edits[lo:lo + step])
+                    dd.commit()
+                dd.compact()
+                heads_a = sorted(dd.get_heads())
+                dd.close()
+
+                # the SAME document re-persisted through the legacy chunk
+                # writer: copy the doc dir, rewrite its snapshot with the
+                # run-coded writer disabled
+                shutil.copytree(run_path, chunk_path)
+                prior = os.environ.get("AUTOMERGE_TPU_RUNSNAP")
+                os.environ["AUTOMERGE_TPU_RUNSNAP"] = "0"
+                try:
+                    d2 = AutoDoc.open(chunk_path, fsync="never")
+                    assert d2.compact(), "legacy snapshot rewrite refused"
+                    heads_b = sorted(d2.get_heads())
+                    d2.close()
+                finally:
+                    if prior is None:
+                        os.environ.pop("AUTOMERGE_TPU_RUNSNAP", None)
+                    else:
+                        os.environ["AUTOMERGE_TPU_RUNSNAP"] = prior
+
+                def cold_open_stats(path, hist_name):
+                    """Repeated from-disk opens of a fully-compacted doc:
+                    per-open latency, open+first-read, the re-encode
+                    counter across one device-mirror build, and the
+                    hydrate byte counters by codec label."""
+                    lats = []
+                    first_read = None
+                    re0 = T.counters.get("oplog.hydrate_reencode", 0)
+                    hb0 = dict(obs.counter_values(
+                        "store.hydrate_bytes", "codec"))
+                    for i in range(ps_opens):
+                        t0 = time.perf_counter()
+                        d_ = AutoDoc.open(path, fsync="never")
+                        t_open = time.perf_counter() - t0
+                        v = d_.get("_root", "probe")
+                        t_read = time.perf_counter() - t0
+                        assert v is not None, v
+                        lats.append(t_open)
+                        if first_read is None:
+                            first_read = t_read
+                            # cold -> hot: the device mirror must source
+                            # the retained run image (legacy: re-extract,
+                            # which the counter charges)
+                            d_.build_device_mirror()
+                        d_.close()
+                    hb1 = dict(obs.counter_values(
+                        "store.hydrate_bytes", "codec"))
+                    return {
+                        "snapshot_bytes": os.path.getsize(
+                            os.path.join(path, SNAPSHOT_NAME)),
+                        "hydrate_to_first_read_s": round(first_read, 4),
+                        "hydrate_reencode": T.counters.get(
+                            "oplog.hydrate_reencode", 0) - re0,
+                        "hydrate_bytes": {
+                            k: hb1.get(k, 0) - hb0.get(k, 0)
+                            for k in hb1
+                            if hb1.get(k, 0) != hb0.get(k, 0)
+                        },
+                        **_latency_percentiles(hist_name, lats),
+                    }
+
+                rs = cold_open_stats(
+                    run_path, "bench.persistence.cold_open_runsnap")
+                cs = cold_open_stats(
+                    chunk_path, "bench.persistence.cold_open_chunk")
+
+                def write_amp(tag, cost_ratio):
+                    """ps_commits small commits against aggressive
+                    compaction thresholds; the bytes the compactor
+                    rewrote per committed op is the write-amp figure."""
+                    b0 = T.counters.get("compact.bytes_written", 0)
+                    r0 = T.counters.get("compact.runs", 0)
+                    d_ = AutoDoc.open(
+                        os.path.join(ps_dir, f"wa_{tag}"), fsync="never",
+                        compact_max_records=ps_every,
+                        compact_max_bytes=1 << 30,
+                        compact_cost_ratio=cost_ratio,
+                        actor=ActorId(bytes([22]) * 16),
+                    )
+                    pay = "v" * 160
+                    t0 = time.perf_counter()
+                    for i in range(ps_commits):
+                        d_.put("_root", f"k{i % 256:04}", f"{pay}{i}")
+                        d_.commit()
+                    dt = time.perf_counter() - t0
+                    d_.close()
+                    written = T.counters.get(
+                        "compact.bytes_written", 0) - b0
+                    return {
+                        "cost_ratio": cost_ratio,
+                        "compactions": T.counters.get(
+                            "compact.runs", 0) - r0,
+                        "snapshot_bytes_written": written,
+                        "bytes_per_op": round(written / ps_commits, 1),
+                        "commits_per_sec": round(ps_commits / dt, 1),
+                    }
+
+                wa_full = write_amp("full", 0.0)
+                wa_gated = write_amp("gated", ps_ratio)
+
+                ps_cfg = {
+                    "edits": len(edits),
+                    "opens": ps_opens,
+                    "commits": ps_commits,
+                    "heads_identical": heads_a == heads_b,
+                    "runsnap": rs,
+                    "chunk": cs,
+                    "cold_open_p50_speedup": round(
+                        cs["latency_p50_s"] / max(rs["latency_p50_s"],
+                                                  1e-9), 2),
+                    "cold_open_p99_speedup": round(
+                        cs["latency_p99_s"] / max(rs["latency_p99_s"],
+                                                  1e-9), 2),
+                    "full_rewrite": wa_full,
+                    "cost_gated": wa_gated,
+                    "write_amp_reduction": round(
+                        wa_full["bytes_per_op"]
+                        / max(wa_gated["bytes_per_op"], 1e-9), 2),
+                }
+            finally:
+                shutil.rmtree(ps_dir, ignore_errors=True)
+    except Exception as e:  # noqa: BLE001 — degrade, record, continue
+        import traceback
+
+        tb = traceback.format_exc()
+        ps_cfg = {"persistence_error": repr(e)[:500]}
+        print(f"persistence config failed:\n{tb}", file=sys.stderr,
+              flush=True)
+    results["persistence"] = ps_cfg
+    note(f"persistence: {results['persistence']}")
+    wall_mark("persistence")
     wall_s["total"] = round(sum(wall_s.values()), 3)
 
     out = {

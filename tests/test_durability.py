@@ -316,6 +316,88 @@ def test_crash_point_sweep_full(seed):
         _check_crash_point(k, seed=seed)
 
 
+def _run_incremental_compact_workload(fs):
+    """Commit → compact → commit → compact …: after the first compaction
+    the doc holds a run-coded image, so every later compact() exercises
+    the INCREMENTAL path (retained image + journal-tail merge). Crashing
+    at every write boundary of this workload proves a torn incremental
+    merge never leaves a half-spliced snapshot on disk."""
+    acked = []
+    try:
+        dd = AutoDoc.open(
+            DIR, fs=fs, fsync="always", actor=actor(1),
+            compact_max_records=1 << 30,  # only the explicit compacts below
+        )
+        for r in range(3):
+            for i in range(3):
+                dd.put("_root", f"r{r}k{i}", i)
+                acked.append(dd.commit())
+            dd.compact()
+        return acked
+    except CrashPoint as e:
+        e.acked = acked
+        raise
+
+
+def _check_incremental_crash_point(k, seed):
+    from automerge_tpu.integrity import verify_snapshot_bytes
+    from automerge_tpu.storage.durable import SNAPSHOT_NAME
+
+    fs = SimFS(crash_at=k)
+    try:
+        acked = _run_incremental_compact_workload(fs)
+    except CrashPoint as e:
+        acked = e.acked
+    rng = random.Random(seed * 100_003 + k)
+    for si, state in enumerate(fs.crash_states(rng)):
+        fs2 = SimFS.from_disk(state)
+        snap_path = DIR + "/" + SNAPSHOT_NAME
+        if fs2.exists(snap_path):
+            # the visible snapshot is atomic-rename-protected: whatever
+            # boundary the crash hit, it must verify clean end to end —
+            # a half-spliced image would surface exactly here
+            rep = verify_snapshot_bytes(fs2.read_bytes(snap_path))
+            assert rep.ok, (
+                f"crash at boundary {k} state {si}: torn snapshot "
+                f"({rep.reason} at {rep.first_bad_offset})"
+            )
+        dd = AutoDoc.open(DIR, fs=fs2)
+        try:
+            have = set(dd.doc.history_index)
+            missing = [h for h in acked if h not in have]
+            assert not missing, (
+                f"crash at boundary {k} state {si}: {len(missing)} acked "
+                f"changes lost after incremental compaction"
+            )
+            dd.hydrate()
+        finally:
+            dd.close()
+
+
+def _incremental_total_boundaries():
+    fs = SimFS()
+    _run_incremental_compact_workload(fs)
+    return fs.ops
+
+
+def test_incremental_compact_crash_sweep_sampled():
+    """Tier-1: every 4th write boundary (plus both ends) of the
+    compact-heavy workload, all crash-state variants."""
+    total = _incremental_total_boundaries()
+    assert total > 20
+    for k in sorted(set(range(1, total + 1, 4)) | {1, total}):
+        _check_incremental_crash_point(k, seed=0)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(3))
+def test_incremental_compact_crash_sweep_full(seed):
+    """Every write boundary of the compact-heavy workload."""
+    total = _incremental_total_boundaries()
+    for k in range(1, total + 1):
+        _check_incremental_crash_point(k, seed=seed)
+
+
 def test_crash_sweep_reports_truncated_tails():
     """Across a sweep, at least one torn state exercises the journal
     tail-truncation counter (the observability the ISSUE demands)."""

@@ -1,0 +1,408 @@
+"""Run-coded persistence (storage/runsnap.py): codec round-trips, digest
+bit-identity across representation modes and the legacy→run-coded format
+upgrade, zero-re-encode hydration counters, the all-dense compaction
+shortcut at the ratio-gate boundary, snapshot shipping (replication /
+migration catch-up), and forged-corruption detection down to the
+``journal-info --verify`` exit code."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from automerge_tpu import obs, trace
+from automerge_tpu.api import AutoDoc
+from automerge_tpu.integrity import verify_snapshot_bytes
+from automerge_tpu.ops.oplog import OpLog
+from automerge_tpu.storage import runsnap
+from automerge_tpu.storage.durable import SNAPSHOT_NAME
+from automerge_tpu.types import ActorId, ObjType
+
+COLS = (
+    "id_key", "obj_key", "prop", "elem_key", "action", "insert",
+    "value_tag", "value_int", "width", "expand", "mark_name_idx",
+    "elem_ref", "obj_dense", "pred_src", "pred_tgt", "pred_key",
+)
+
+
+def actor(i):
+    return ActorId(bytes([i]) * 16)
+
+
+def build_doc(n_changes=25, with_text=True):
+    d = AutoDoc(actor=actor(1))
+    if with_text:
+        t = d.put_object("_root", "t", ObjType.TEXT)
+    for i in range(n_changes):
+        if with_text:
+            d.splice_text(t, d.length(t), 0, f"w{i} ")
+        d.put("_root", f"k{i % 7}", i)
+        d.commit()
+    d.put("_root", "pi", 3.25)
+    d.put("_root", "s", "str-value")
+    d.commit()
+    return d
+
+
+def assert_logs_equal(a: OpLog, b: OpLog):
+    for name in COLS:
+        x, y = getattr(a, name), getattr(b, name)
+        if x is None:
+            assert y is None, name
+            continue
+        assert y.dtype == x.dtype, (name, x.dtype, y.dtype)
+        assert np.array_equal(x, y), name
+    assert np.array_equal(a.obj_table, b.obj_table)
+    assert a.n_miss_elem == b.n_miss_elem
+    assert a.n_miss_pred == b.n_miss_pred
+    assert [a.values[i] for i in range(a.n)] == [b.values[i] for i in range(b.n)]
+
+
+# -- codec round trips --------------------------------------------------------
+
+
+def test_codec_round_trip_compressed():
+    d = build_doc()
+    hist = [ac.stored for ac in d.doc.history]
+    log = OpLog.from_changes(hist)
+    log.compressed(sync=True)
+    data = runsnap.encode_snapshot(log, d.get_heads())
+    assert runsnap.is_runsnap(data)
+
+    img = runsnap.parse(data)
+    assert img.n_changes == len(hist)
+    assert [c.hash for c in img.changes] == [c.hash for c in hist]
+    # raw chunk bytes ship verbatim — digests and sync frames bit-identical
+    assert [c.raw_bytes for c in img.changes] == [c.raw_bytes for c in hist]
+    assert sorted(img.heads) == sorted(d.get_heads())
+    assert_logs_equal(log, img.to_oplog())
+
+
+def test_codec_round_trip_dense_mode(monkeypatch):
+    monkeypatch.setenv("AUTOMERGE_TPU_COMPRESSED", "0")
+    d = build_doc(n_changes=8)
+    hist = [ac.stored for ac in d.doc.history]
+    log = OpLog.from_changes(hist)
+    data = runsnap.encode_snapshot(log, d.get_heads())
+    img = runsnap.parse(data)
+    assert img.flags & runsnap.FLAG_COMPRESSED == 0
+    log2 = img.to_oplog()
+    assert log2._comp is None  # dense file → no compressed image installed
+    assert_logs_equal(log, log2)
+
+
+def test_encode_requires_raw_bytes():
+    d = build_doc(n_changes=2)
+    hist = [ac.stored for ac in d.doc.history]
+    log = OpLog.from_changes(hist)
+    log.changes[0].raw_bytes = None
+    with pytest.raises(runsnap.RunSnapError):
+        runsnap.encode_snapshot(log, d.get_heads())
+
+
+# -- durable wiring: digest identity across modes and the format upgrade -----
+
+
+def roundtrip_digest(tmp_path, name, env=None):
+    """Open→write→compact→close→reopen; returns (digest@close,
+    digest@reopen, snapshot bytes)."""
+    for k, v in (env or {}).items():
+        os.environ[k] = v
+    try:
+        p = str(tmp_path / name)
+        d = AutoDoc.open(p, actor=actor(2))
+        t = d.put_object("_root", "t", ObjType.TEXT)
+        for i in range(12):
+            d.splice_text(t, d.length(t), 0, f"x{i} ")
+            d.commit()
+        assert d.compact()
+        d.put("_root", "tail", 1)  # journal tail beyond the snapshot
+        d.commit()
+        dig = d.doc_digest()["digest"]
+        text = d.text(t)
+        d.close()
+        snap = open(os.path.join(p, SNAPSHOT_NAME), "rb").read()
+        d2 = AutoDoc.open(p)
+        dig2 = d2.doc_digest()["digest"]
+        assert d2.text(t) == text
+        d2.close()
+        return dig, dig2, snap
+    finally:
+        for k in (env or {}):
+            os.environ.pop(k, None)
+
+
+def test_digest_identity_all_modes(tmp_path):
+    """The same workload digests identically whether persisted run-coded
+    (compressed or run-native demoted off), dense-mode, or legacy-chunk —
+    the codec never changes the change set."""
+    a = roundtrip_digest(tmp_path, "runsnap")
+    b = roundtrip_digest(tmp_path, "dense", {"AUTOMERGE_TPU_COMPRESSED": "0"})
+    c = roundtrip_digest(tmp_path, "legacy", {"AUTOMERGE_TPU_RUNSNAP": "0"})
+    assert a[0] == a[1] == b[0] == b[1] == c[0] == c[1]
+    assert runsnap.is_runsnap(a[2])
+    assert runsnap.is_runsnap(b[2])  # dense-demoted columns still ship ARSN
+    assert not runsnap.is_runsnap(c[2])
+
+
+def test_legacy_snapshot_upgrade(tmp_path):
+    """A doc written entirely under the legacy knob reopens with the new
+    reader and upgrades to ARSN on its next compaction, digest unchanged."""
+    p = str(tmp_path / "up")
+    os.environ["AUTOMERGE_TPU_RUNSNAP"] = "0"
+    try:
+        d = AutoDoc.open(p, actor=actor(3))
+        for i in range(6):
+            d.put("_root", f"k{i}", i)
+            d.commit()
+        assert d.compact()
+        dig = d.doc_digest()["digest"]
+        d.close()
+    finally:
+        os.environ.pop("AUTOMERGE_TPU_RUNSNAP", None)
+    assert not runsnap.is_runsnap(
+        open(os.path.join(p, SNAPSHOT_NAME), "rb").read())
+
+    d2 = AutoDoc.open(p)
+    assert d2.doc_digest()["digest"] == dig
+    assert d2.compact()
+    d2.close()
+    assert runsnap.is_runsnap(
+        open(os.path.join(p, SNAPSHOT_NAME), "rb").read())
+    d3 = AutoDoc.open(p)
+    assert d3.doc_digest()["digest"] == dig
+    d3.close()
+
+
+def test_cold_open_zero_reencode(tmp_path):
+    """Device-mode cold open from an ARSN snapshot never re-encodes run
+    tables from changes (the counter the CI gate asserts); the legacy
+    knob makes the same assertion non-vacuous."""
+    p = str(tmp_path / "zero")
+    d = AutoDoc.open(p, actor=actor(4))
+    for i in range(10):
+        d.put("_root", f"k{i}", i)
+        d.commit()
+    assert d.compact()
+    d.close()
+
+    trace.reset_counters()
+    d2 = AutoDoc.open(p, device=True)
+    assert trace.counters.get("oplog.hydrate_reencode", 0) == 0
+    assert d2.device_doc is not None
+    d2.close()
+
+    # warm→hot promotion off the retained image: still zero
+    trace.reset_counters()
+    d3 = AutoDoc.open(p)
+    d3.build_device_mirror()
+    d3.drop_device_mirror()
+    d3.build_device_mirror()
+    assert trace.counters.get("oplog.hydrate_reencode", 0) == 0
+    d3.close()
+
+    # non-vacuous: the legacy-format path DOES re-encode
+    os.environ["AUTOMERGE_TPU_RUNSNAP"] = "0"
+    try:
+        d4 = AutoDoc.open(p)
+        assert d4.compact()  # rewrites the snapshot legacy-format
+        d4.close()
+        trace.reset_counters()
+        d5 = AutoDoc.open(p, device=True)
+        assert trace.counters.get("oplog.hydrate_reencode", 0) > 0
+        d5.close()
+    finally:
+        os.environ.pop("AUTOMERGE_TPU_RUNSNAP", None)
+
+
+def _codec_bytes():
+    return dict(obs.counter_values("store.hydrate_bytes", "codec"))
+
+
+def test_hydrate_bytes_codec_labels(tmp_path):
+    p = str(tmp_path / "lab")
+    d = AutoDoc.open(p, actor=actor(5))
+    d.put("_root", "k", 1)
+    d.commit()
+    assert d.compact()
+    d.close()
+    before = _codec_bytes()
+    AutoDoc.open(p).close()
+    after = _codec_bytes()
+    assert after.get("runsnap", 0) > before.get("runsnap", 0)
+    assert after.get("chunk", 0) == before.get("chunk", 0)
+
+    os.environ["AUTOMERGE_TPU_RUNSNAP"] = "0"
+    try:
+        d2 = AutoDoc.open(p)
+        assert d2.compact()
+        d2.close()
+    finally:
+        os.environ.pop("AUTOMERGE_TPU_RUNSNAP", None)
+    before = _codec_bytes()
+    AutoDoc.open(p).close()
+    after = _codec_bytes()
+    assert after.get("chunk", 0) > before.get("chunk", 0)
+
+
+# -- the all-dense compaction shortcut at the ratio-gate boundary -------------
+
+
+def test_dense_shortcut_at_ratio_gate(tmp_path, monkeypatch):
+    """With the compression gate at 0.0 every column demotes; the
+    snapshot writer must short-circuit to the dense path (counted) and
+    the file must still round-trip. At the default gate the same doc
+    keeps run tables and the shortcut must NOT fire."""
+    d = build_doc(n_changes=6)
+    hist = [ac.stored for ac in d.doc.history]
+
+    # boundary side A: gate 0.0 → run_gate(n_runs, n_rows) fails for all
+    monkeypatch.setenv("AUTOMERGE_TPU_COMPRESS_GATE", "0.0")
+    log = OpLog.from_changes(hist)
+    log.compressed(sync=True)  # demotes every column
+    live = [nm for nm in COLS if getattr(log, nm, None) is not None]
+    assert log._comp.all_dense(live)
+    trace.reset_counters()
+    data = runsnap.encode_snapshot(log, d.get_heads())
+    assert trace.counters.get("compact.dense_shortcut", 0) == 1
+    img = runsnap.parse(data)
+    log_rt = img.to_oplog()
+    assert_logs_equal(log, log_rt)
+    # demotion decisions survive hydration (sticky: no re-encode retry)
+    assert log_rt._comp is not None and log_rt._comp.all_dense(live)
+
+    # boundary side B: default gate → runs survive, no shortcut
+    monkeypatch.delenv("AUTOMERGE_TPU_COMPRESS_GATE")
+    log2 = OpLog.from_changes(hist)
+    log2.compressed(sync=True)
+    trace.reset_counters()
+    runsnap.encode_snapshot(log2, d.get_heads())
+    assert trace.counters.get("compact.dense_shortcut", 0) == 0
+
+
+# -- snapshot shipping (replication / migration catch-up) ---------------------
+
+
+def test_replicated_snapshot_ships_arsn_verbatim(tmp_path):
+    """snapshot_bytes() → apply_replicated_snapshot moves the run-coded
+    image verbatim; the receiver's digest matches bit-for-bit and its
+    own hydrations start run-coded (image adopted)."""
+    p1 = str(tmp_path / "leader")
+    d1 = AutoDoc.open(p1, actor=actor(6))
+    t = d1.put_object("_root", "t", ObjType.TEXT)
+    for i in range(9):
+        d1.splice_text(t, d1.length(t), 0, f"s{i} ")
+        d1.commit()
+    blob = d1.snapshot_bytes()
+    assert runsnap.is_runsnap(blob)
+    dig = d1.doc_digest()["digest"]
+
+    p2 = str(tmp_path / "follower")
+    d2 = AutoDoc.open(p2, actor=actor(7))
+    before = _codec_bytes()
+    d2.apply_replicated_snapshot(blob, b"cursor-1")
+    after = _codec_bytes()
+    assert d2.doc_digest()["digest"] == dig
+    assert d2.text(t) == d1.text(t)
+    assert d2._run_image is not None  # adopted, not re-derived
+    assert after.get("runsnap", 0) - before.get("runsnap", 0) == len(blob)
+
+    # corruption must raise (on_partial="error" semantics), not degrade
+    bad = bytearray(blob)
+    bad[len(blob) // 2] ^= 0xFF
+    p3 = str(tmp_path / "f2")
+    d3 = AutoDoc.open(p3, actor=actor(8))
+    with pytest.raises(runsnap.RunSnapError):
+        d3.apply_replicated_snapshot(bytes(bad), None)
+    d3.close()
+    d1.close()
+    d2.close()
+
+
+def test_corrupt_arsn_salvages_embedded_changes(tmp_path):
+    """A bit-flipped ARSN snapshot opens in salvage mode: the embedded
+    change chunks are magic-prefixed, so the legacy carve recovers them
+    — same degradation story as a damaged chunk snapshot."""
+    p = str(tmp_path / "sal")
+    d = AutoDoc.open(p, actor=actor(9))
+    for i in range(5):
+        d.put("_root", f"k{i}", i)
+        d.commit()
+    assert d.compact()
+    n_changes = len(d.doc.history)
+    d.close()
+
+    sp = os.path.join(p, SNAPSHOT_NAME)
+    blob = bytearray(open(sp, "rb").read())
+    blob[8] ^= 0xFF  # corrupt the meta section, changes stay intact
+    open(sp, "wb").write(bytes(blob))
+
+    d2 = AutoDoc.open(p)
+    assert len(d2.doc.history) == n_changes
+    assert d2._run_image is None  # salvage path, no image
+    d2.close()
+
+
+# -- verification & the journal-info exit code --------------------------------
+
+
+def _forge(data: bytes, offset: int) -> bytes:
+    bad = bytearray(data)
+    bad[offset] ^= 0xFF
+    return bytes(bad)
+
+
+def test_verify_reports_first_bad_section(tmp_path):
+    d = build_doc(n_changes=6)
+    hist = [ac.stored for ac in d.doc.history]
+    log = OpLog.from_changes(hist)
+    data = runsnap.encode_snapshot(log, d.get_heads())
+
+    rep = verify_snapshot_bytes(data)
+    assert rep.ok and rep.kind == "snapshot" and rep.units >= 7
+
+    # forge every section in turn: each must flag at (or before) its own
+    # frame, never report ok, and parse() must refuse
+    offsets, pos = [], 6
+    while pos < len(data):
+        from automerge_tpu.utils.leb128 import decode_uleb
+
+        plen, body = decode_uleb(data, pos + 1)
+        offsets.append((pos, body))
+        pos = body + plen + 4
+    assert len(offsets) >= 7
+    for start, body in offsets:
+        bad = _forge(data, body)  # flip the first payload byte
+        r = verify_snapshot_bytes(bad)
+        assert not r.ok
+        assert r.first_bad_offset is not None and r.first_bad_offset <= start + 1
+        with pytest.raises(runsnap.RunSnapError):
+            runsnap.parse(bad)
+
+
+def test_journal_info_verify_rc1_on_forged_arsn(tmp_path, capsys):
+    from automerge_tpu.cli import main as cli_main
+
+    p = str(tmp_path / "ji")
+    d = AutoDoc.open(p, actor=actor(10))
+    d.put("_root", "k", "v")
+    d.commit()
+    assert d.compact()
+    d.close()
+
+    assert cli_main(["journal-info", p, "--verify"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["snapshot_codec"] == "runsnap"
+    snap_report = [r for r in out["verify"] if r["kind"] == "snapshot"]
+    assert snap_report and snap_report[0]["ok"]
+
+    sp = os.path.join(p, SNAPSHOT_NAME)
+    blob = open(sp, "rb").read()
+    open(sp, "wb").write(_forge(blob, len(blob) - 10))
+    assert cli_main(["journal-info", p, "--verify"]) == 1
+    captured = capsys.readouterr()
+    out = json.loads(captured.out)
+    bad = [r for r in out["verify"] if r["kind"] == "snapshot"][0]
+    assert not bad["ok"] and bad["first_bad_offset"] is not None
+    assert "corrupt" in captured.err
